@@ -1,0 +1,10 @@
+"""d9d_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the d9d
+reference framework: 6D parallelism (PP x DP-replicate x DP-shard x CP x TP
+with an expert-parallel overlay), pipeline schedules (GPipe .. ZeroBubble),
+MoE with ragged all-to-all dispatch, DAG-based streaming checkpoints, and a
+composable training loop.
+"""
+
+__version__ = "0.1.0"

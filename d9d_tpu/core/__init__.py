@@ -1,0 +1,41 @@
+from d9d_tpu.core.mesh import (
+    AXIS_CP_REPLICATE,
+    AXIS_CP_SHARD,
+    AXIS_DP_REPLICATE,
+    AXIS_DP_SHARD,
+    AXIS_PP,
+    AXIS_TP,
+    MESH_AXIS_NAMES,
+    MeshContext,
+    MeshParameters,
+)
+from d9d_tpu.core.tree_sharding import (
+    SpecReplicate,
+    SpecShard,
+    shard_spec_on_dim,
+    shard_tree,
+    unshard_tree,
+)
+from d9d_tpu.core.types import Array, ArrayTree, CollateFn, PyTree, ScalarTree
+
+__all__ = [
+    "AXIS_CP_REPLICATE",
+    "AXIS_CP_SHARD",
+    "AXIS_DP_REPLICATE",
+    "AXIS_DP_SHARD",
+    "AXIS_PP",
+    "AXIS_TP",
+    "MESH_AXIS_NAMES",
+    "MeshContext",
+    "MeshParameters",
+    "SpecReplicate",
+    "SpecShard",
+    "shard_spec_on_dim",
+    "shard_tree",
+    "unshard_tree",
+    "Array",
+    "ArrayTree",
+    "CollateFn",
+    "PyTree",
+    "ScalarTree",
+]

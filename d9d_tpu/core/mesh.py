@@ -1,0 +1,267 @@
+"""6D device-mesh topology for TPU SPMD training.
+
+This is the TPU-native equivalent of the reference's DeviceMesh "domains"
+(reference: d9d/core/dist_context/device_mesh_domains.py:39-180 and
+d9d/core/dist_context/params.py:9-105). Where the reference builds five
+separate torch ``DeviceMesh`` objects over one topology (regular / dense /
+expert / batch / flat), on TPU a *single* ``jax.sharding.Mesh`` with named
+axes is enough: "fused" dims are expressed as tuples of axis names inside a
+``PartitionSpec`` (e.g. the reference's ``dp_cp_shard`` fused dim is simply
+``P(('dp_s', 'cp_s'))``), and the expert-parallel overlay is a suffix of the
+flattened non-pp axes (validated here, like the reference validates
+``dp*cp*tp % ep == 0`` at params.py:81-97).
+
+Axis order is ``(pp, dp_r, dp_s, cp_s, cp_r, tp)`` — row-major, so ``tp``
+varies fastest across physically-adjacent devices (ICI neighbours), which is
+what you want: TP collectives are the most latency-sensitive, EP all-to-alls
+ride the fast suffix, and PP crosses the slowest (possibly DCN) dimension.
+"""
+
+import dataclasses
+import functools
+import math
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, slowest-varying first.
+AXIS_PP = "pp"
+AXIS_DP_REPLICATE = "dp_r"
+AXIS_DP_SHARD = "dp_s"
+AXIS_CP_SHARD = "cp_s"
+AXIS_CP_REPLICATE = "cp_r"
+AXIS_TP = "tp"
+
+MESH_AXIS_NAMES: tuple[str, ...] = (
+    AXIS_PP,
+    AXIS_DP_REPLICATE,
+    AXIS_DP_SHARD,
+    AXIS_CP_SHARD,
+    AXIS_CP_REPLICATE,
+    AXIS_TP,
+)
+
+
+def _suffix_axes_covering(
+    size: int, axes: Sequence[tuple[str, int]]
+) -> tuple[str, ...]:
+    """Find the fastest-varying (suffix) axes whose sizes multiply to ``size``.
+
+    Raises if ``size`` does not align with whole-axis boundaries: the expert
+    axis must factor exactly into mesh axes so that expert-parallel
+    collectives can name real mesh axes.
+    """
+    if size == 1:
+        return ()
+    prod = 1
+    chosen: list[str] = []
+    for name, s in reversed(list(axes)):
+        if prod >= size:
+            break
+        if s == 1:
+            continue
+        prod *= s
+        chosen.append(name)
+    if prod != size:
+        raise ValueError(
+            f"expert-shard size {size} does not factor into a suffix of mesh "
+            f"axes {list(axes)}; got partial product {prod}"
+        )
+    return tuple(reversed(chosen))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshParameters:
+    """Sizes of every parallelism dimension.
+
+    Parity: reference ``DeviceMeshParameters`` (core/dist_context/params.py:9).
+    ``ep_shard`` overlays the ``dp_r*dp_s*cp_s*cp_r*tp`` product exactly like the
+    reference's ExpertDomain (device_mesh_domains.py:69-93); divisibility is
+    validated in ``__post_init__`` (reference params.py:81-97).
+    """
+
+    pp: int = 1
+    dp_replicate: int = 1
+    dp_shard: int = 1
+    cp_shard: int = 1
+    cp_replicate: int = 1
+    tp: int = 1
+    ep_shard: int = 1
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"{f.name} must be a positive int, got {v!r}")
+        non_pp = (
+            self.dp_replicate
+            * self.dp_shard
+            * self.cp_shard
+            * self.cp_replicate
+            * self.tp
+        )
+        if non_pp % self.ep_shard != 0:
+            raise ValueError(
+                f"ep_shard={self.ep_shard} must divide "
+                f"dp_replicate*dp_shard*cp_shard*cp_replicate*tp={non_pp}"
+            )
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.pp
+            * self.dp_replicate
+            * self.dp_shard
+            * self.cp_shard
+            * self.cp_replicate
+            * self.tp
+        )
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (
+            self.pp,
+            self.dp_replicate,
+            self.dp_shard,
+            self.cp_shard,
+            self.cp_replicate,
+            self.tp,
+        )
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> "MeshContext":
+        """Build the mesh over ``devices`` (default: all visible devices).
+
+        With no explicit device list, ``jax.make_mesh`` computes a
+        topology-aware device assignment so the fastest-varying axes (tp, ep
+        suffix) land on ICI neighbours and pp crosses the slowest links.
+        An explicit list (tests, custom layouts) is used in the given order.
+        """
+        if devices is None:
+            if len(jax.devices()) != self.world_size:
+                raise ValueError(
+                    f"mesh needs {self.world_size} devices "
+                    f"({dict(zip(MESH_AXIS_NAMES, self.axis_sizes))}), "
+                    f"got {len(jax.devices())}"
+                )
+            mesh = jax.make_mesh(self.axis_sizes, MESH_AXIS_NAMES)
+        else:
+            if len(devices) != self.world_size:
+                raise ValueError(
+                    f"mesh needs {self.world_size} devices "
+                    f"({dict(zip(MESH_AXIS_NAMES, self.axis_sizes))}), "
+                    f"got {len(devices)}"
+                )
+            dev_array = np.asarray(devices).reshape(self.axis_sizes)
+            mesh = Mesh(dev_array, MESH_AXIS_NAMES)
+        return MeshContext(params=self, mesh=mesh)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshContext:
+    """A built mesh plus the axis-group vocabulary of the framework.
+
+    The reference's five mesh *domains* (device_mesh_domains.py:174-180)
+    become properties returning axis-name tuples, usable directly inside
+    ``PartitionSpec``s and as ``axis_name`` arguments to collectives.
+    """
+
+    params: MeshParameters
+    mesh: Mesh
+
+    # --- axis groups (the "domains") -------------------------------------
+
+    @property
+    def dp_axes(self) -> tuple[str, ...]:
+        """All data-parallel axes (batch 'dp' dim of the reference's batch domain)."""
+        return (AXIS_DP_REPLICATE, AXIS_DP_SHARD)
+
+    @property
+    def cp_axes(self) -> tuple[str, ...]:
+        """All context-parallel axes (batch 'cp' dim)."""
+        return (AXIS_CP_SHARD, AXIS_CP_REPLICATE)
+
+    @property
+    def batch_axes(self) -> tuple[str, ...]:
+        """Axes over which the global batch dim is split (dp, incl. fsdp)."""
+        return self.dp_axes
+
+    @property
+    def sequence_axes(self) -> tuple[str, ...]:
+        """Axes over which the sequence dim is split (context parallel)."""
+        return (AXIS_CP_SHARD,)
+
+    @property
+    def fsdp_axes(self) -> tuple[str, ...]:
+        """Parameter-shard axes — the reference's fused ``dp_cp_shard`` dense dim
+        (device_mesh_domains.py:99-121)."""
+        return (AXIS_DP_SHARD, AXIS_CP_SHARD)
+
+    @property
+    def grad_reduce_axes(self) -> tuple[str, ...]:
+        """Axes across which gradients of replicated params must be summed."""
+        return (
+            AXIS_DP_REPLICATE,
+            AXIS_DP_SHARD,
+            AXIS_CP_SHARD,
+            AXIS_CP_REPLICATE,
+        )
+
+    @functools.cached_property
+    def ep_shard_axes(self) -> tuple[str, ...]:
+        """Mesh axes forming the expert-shard dim (fastest-varying suffix)."""
+        non_pp = list(zip(MESH_AXIS_NAMES[1:], self.params.axis_sizes[1:]))
+        return _suffix_axes_covering(self.params.ep_shard, non_pp)
+
+    @functools.cached_property
+    def ep_replicate_axes(self) -> tuple[str, ...]:
+        """Non-pp axes not part of the expert shard (the ep_replicate dim)."""
+        shard = set(self.ep_shard_axes)
+        return tuple(
+            n
+            for n, s in zip(MESH_AXIS_NAMES[1:], self.params.axis_sizes[1:])
+            if n not in shard
+        )
+
+    # --- sizes -----------------------------------------------------------
+
+    def axis_size(self, *axes: str) -> int:
+        return math.prod(self.mesh.shape[a] for a in axes)
+
+    @property
+    def world_size(self) -> int:
+        return self.params.world_size
+
+    @property
+    def pp_size(self) -> int:
+        return self.params.pp
+
+    # --- sharding helpers ------------------------------------------------
+
+    def spec(self, *dims: str | tuple[str, ...] | None) -> P:
+        return P(*dims)
+
+    def sharding(self, *dims: str | tuple[str, ...] | None) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*dims))
+
+    def batch_sharding(self, extra: P | None = None) -> NamedSharding:
+        """Sharding for a [batch, seq, ...] array: batch over dp, seq over cp."""
+        dims: list = [self.batch_axes, self.sequence_axes]
+        if extra is not None:
+            dims.extend(extra)
+        return NamedSharding(self.mesh, P(*dims))
+
+    # --- process info ----------------------------------------------------
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def is_main_process(self) -> bool:
+        return jax.process_index() == 0

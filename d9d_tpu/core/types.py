@@ -1,0 +1,24 @@
+"""Common type aliases used across the framework.
+
+Parity: mirrors the role of ``d9d/core/types`` (reference: core/types/pytree.py:7,
+core/types/data.py:8) — but typed against JAX arrays instead of torch tensors.
+"""
+
+from collections.abc import Callable
+from typing import Any, TypeAlias
+
+import jax
+
+# An arbitrary JAX pytree (nested dict/list/tuple of leaves).
+PyTree: TypeAlias = Any
+
+# A pytree whose leaves are jax.Array.
+ArrayTree: TypeAlias = Any
+
+# A pytree whose leaves are python scalars / 0-d arrays.
+ScalarTree: TypeAlias = Any
+
+Array: TypeAlias = jax.Array
+
+# Collate function: list of per-sample pytrees -> one batched pytree.
+CollateFn: TypeAlias = Callable[[list[PyTree]], PyTree]

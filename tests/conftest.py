@@ -1,0 +1,39 @@
+"""Global test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the TPU analogue of the
+reference's 8-process `torchrun` rig — reference Makefile:9-12). The env
+vars must be set before jax initializes its backends.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Must use config.update (not the env var): the environment may have already
+# imported jax and registered an accelerator plugin at interpreter startup.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    import random
+
+    import numpy as np
+
+    random.seed(0)
+    np.random.seed(0)
+    yield

@@ -1,0 +1,88 @@
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from d9d_tpu.core import MeshContext, MeshParameters
+
+
+def test_world_size_validation(devices):
+    with pytest.raises(ValueError):
+        MeshParameters(pp=3).build(devices)
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        MeshParameters(pp=0)
+    with pytest.raises(ValueError):
+        MeshParameters(dp_shard=4, ep_shard=3)  # 3 does not divide 4
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        MeshParameters(dp_replicate=8),
+        MeshParameters(pp=2, dp_replicate=2, dp_shard=2),
+        MeshParameters(pp=2, dp_shard=2, tp=2, cp_replicate=1, dp_replicate=1, ep_shard=2),
+        MeshParameters(dp_shard=4, tp=2, ep_shard=8),
+    ],
+)
+def test_build_mesh_shapes(devices, params):
+    ctx = params.build(devices)
+    assert ctx.world_size == 8
+    assert ctx.mesh.shape["pp"] == params.pp
+    assert ctx.mesh.shape["tp"] == params.tp
+
+
+def test_ep_overlay_suffix(devices):
+    # ep_shard=4 over (dp_s=2, cp_s=1, cp_r=1, tp=2): suffix must be (dp_s, tp)
+    ctx = MeshParameters(pp=2, dp_shard=2, tp=2, ep_shard=4).build(devices)
+    assert ctx.ep_shard_axes == ("dp_s", "tp")
+    assert "dp_s" not in ctx.ep_replicate_axes
+    assert ctx.axis_size(*ctx.ep_shard_axes) == 4
+
+
+def test_ep_overlay_misaligned(devices):
+    # ep_shard=2 over tp=4 is fine? 2 does not cover whole tp axis -> error
+    ctx = MeshParameters(dp_shard=2, tp=4, ep_shard=2).build(devices)
+    with pytest.raises(ValueError):
+        _ = ctx.ep_shard_axes
+
+
+def test_ep_trivial(devices):
+    ctx = MeshParameters(dp_replicate=8).build(devices)
+    assert ctx.ep_shard_axes == ()
+    assert set(ctx.ep_replicate_axes) == {"dp_r", "dp_s", "cp_s", "cp_r", "tp"}
+
+
+def test_sharding_placement(devices):
+    ctx = MeshParameters(dp_replicate=2, dp_shard=2, cp_shard=2).build(devices)
+    x = jnp.arange(16.0).reshape(8, 2)
+    sharded = jax.device_put(x, ctx.batch_sharding())
+    assert sharded.sharding.spec == P(("dp_r", "dp_s"), ("cp_s",))
+    # value round-trips
+    assert jnp.allclose(jax.device_get(sharded), x)
+
+
+def test_fsdp_axes_fused(devices):
+    ctx = MeshParameters(dp_shard=2, cp_shard=2, dp_replicate=2).build(devices)
+    assert ctx.fsdp_axes == ("dp_s", "cp_s")
+    assert ctx.axis_size(*ctx.fsdp_axes) == 4
+
+
+def test_psum_over_axis_groups(devices):
+    ctx = MeshParameters(dp_replicate=2, dp_shard=2, tp=2).build(devices)
+
+    def f(x):
+        return jax.lax.psum(x, axis_name=ctx.grad_reduce_axes)
+
+    out = jax.shard_map(
+        f, mesh=ctx.mesh, in_specs=P(ctx.grad_reduce_axes), out_specs=P()
+    )(jnp.ones(4))
+    assert out.item() == 4.0
+
+
+def test_context_is_hashable_for_jit(devices):
+    ctx = MeshParameters(dp_replicate=8).build(devices)
+    assert isinstance(hash(ctx.mesh), int)
+    assert isinstance(ctx, MeshContext)

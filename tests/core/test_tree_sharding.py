@@ -1,0 +1,89 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.core import (
+    SpecReplicate,
+    SpecShard,
+    shard_spec_on_dim,
+    shard_tree,
+    unshard_tree,
+)
+
+
+def test_shard_and_unshard_roundtrip():
+    tree = {
+        "x": jnp.arange(8.0).reshape(4, 2),
+        "meta": {"y": jnp.ones((4,)), "z": jnp.array(3.0)},
+    }
+    spec = {
+        "x": SpecShard(0),
+        "meta": {"y": SpecShard(0), "z": SpecReplicate()},
+    }
+    shards = shard_tree(tree, spec, 2)
+    assert len(shards) == 2
+    assert shards[0]["x"].shape == (2, 2)
+    assert shards[1]["meta"]["z"].item() == 3.0
+    merged = unshard_tree(shards, spec)
+    assert jnp.allclose(merged["x"], tree["x"])
+    assert jnp.allclose(merged["meta"]["y"], tree["meta"]["y"])
+
+
+def test_single_spec_broadcasts():
+    tree = [jnp.arange(4.0), jnp.arange(8.0).reshape(4, 2)]
+    shards = shard_tree(tree, SpecShard(0), 4)
+    assert shards[2][0].shape == (1,)
+    assert shards[2][1].shape == (1, 2)
+
+
+def test_shard_on_dim1():
+    x = jnp.arange(12.0).reshape(2, 6)
+    shards = shard_tree({"x": x}, {"x": SpecShard(1)}, 3)
+    assert shards[0]["x"].shape == (2, 2)
+    merged = unshard_tree(shards, {"x": SpecShard(1)})
+    assert jnp.allclose(merged["x"], x)
+
+
+def test_uneven_shard_raises():
+    with pytest.raises(ValueError):
+        shard_tree({"x": jnp.ones((5, 2))}, SpecShard(0), 2)
+
+
+def test_auto_spec():
+    tree = {"a": jnp.ones((4, 2)), "b": jnp.array(1.0)}
+    spec = shard_spec_on_dim(tree, 0)
+    assert isinstance(spec["a"], SpecShard)
+    assert isinstance(spec["b"], SpecReplicate)
+    shards = shard_tree(tree, spec, 2)
+    assert shards[0]["a"].shape == (2, 2)
+    assert shards[0]["b"].item() == 1.0
+
+
+def test_numpy_leaves():
+    tree = {"x": np.arange(8).reshape(4, 2)}
+    shards = shard_tree(tree, SpecShard(0), 2)
+    assert shards[0]["x"].shape == (2, 2)
+
+
+def test_list_leaf_sharding():
+    batch = {"ids": jnp.arange(8).reshape(8, 1), "texts": [f"t{i}" for i in range(8)]}
+    spec = shard_spec_on_dim(batch, 0)
+    assert isinstance(spec["texts"], SpecShard)
+    shards = shard_tree(batch, spec, 4)
+    assert shards[1]["texts"] == ["t2", "t3"]
+    assert shards[1]["ids"].shape == (2, 1)
+    merged = unshard_tree(shards, spec)
+    assert merged["texts"] == batch["texts"]
+
+
+def test_negative_dim_scalar_replicates():
+    spec = shard_spec_on_dim({"a": jnp.ones((4, 2)), "b": jnp.array(1.0)}, -1)
+    assert isinstance(spec["b"], SpecReplicate)
+    shard_tree({"a": jnp.ones((4, 2)), "b": jnp.array(1.0)}, spec, 2)
+
+
+def test_numpy_unshard_stays_numpy():
+    tree = {"x": np.arange(8).reshape(4, 2)}
+    shards = shard_tree(tree, SpecShard(0), 2)
+    merged = unshard_tree(shards, SpecShard(0))
+    assert isinstance(merged["x"], np.ndarray)
