@@ -1,0 +1,141 @@
+"""Benchmark: dense-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md), so vs_baseline
+is measured against this repo's own recorded north-star target once MoE
+lands; until then it reports 1.0 (self-established baseline).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# v5e (v5 lite) peak bf16 TFLOPs per chip; v5p would be 459.
+PEAK_FLOPS = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v4": 275e12}
+
+
+def main():
+    from d9d_tpu.core import MeshParameters
+    from d9d_tpu.loop import (
+        AdamWProvider,
+        CausalLMTask,
+        DatasetProvider,
+        ModelProvider,
+        Trainer,
+        TrainerConfig,
+    )
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+    from d9d_tpu.nn.sdpa import build_sdpa_backend
+    from d9d_tpu.parallel import replicate_plan
+
+    cfg = Qwen3DenseConfig(
+        vocab_ranges=(("default", 32_768),),
+        hidden_size=1024,
+        num_layers=12,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=64,
+        intermediate_size=4096,
+        remat=True,
+    )
+    seq_len, batch = 2048, 8
+    steps_measure = 10
+
+    class Provider(ModelProvider):
+        def build_module(self, stage):
+            return Qwen3DenseCausalLM(
+                config=cfg, sdpa=build_sdpa_backend(), stage=stage,
+                dtype=jnp.bfloat16,
+            )
+
+        def build_plan(self, c):
+            return replicate_plan(c)
+
+        def sample_inputs(self, batch_size, seq_len):
+            z = jnp.zeros((batch_size, seq_len), jnp.int32)
+            return (z, z, z)
+
+    class Data(DatasetProvider):
+        def build(self):
+            rng = np.random.RandomState(0)
+            while True:
+                yield {
+                    "input_ids": rng.randint(
+                        0, cfg.vocab_size, size=(batch, seq_len + 1)
+                    )
+                }
+
+    ctx = MeshParameters().build(jax.devices()[:1])
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=batch,
+            microbatch_size=batch,
+            seq_len=seq_len,
+            total_steps=3 + steps_measure,
+            log_every=10_000,
+        ),
+        model_provider=Provider(),
+        dataset_provider=Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(weight_decay=0.0),
+    )
+
+    data_iter = iter(trainer.dataset.build())
+
+    def one_step():
+        raw = next(data_iter)
+        b = trainer._stage_batch(raw)
+        rng = jax.random.fold_in(trainer.step_rng, trainer.stepper.step)
+        trainer.params, trainer.opt_state, m = trainer.step_fn(
+            trainer.params, trainer.opt_state, b, rng
+        )
+        return m
+
+    # warmup (compile)
+    for _ in range(3):
+        m = one_step()
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps_measure):
+        m = one_step()
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps_measure * batch * seq_len
+    tok_per_s = tokens / dt
+
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(trainer.params)
+    )
+    # fwd+bwd ≈ 6*N per token (+remat fwd ≈ 8*N) + attention 12*L*D*T/2 causal
+    flops_per_token = 8 * n_params + 6 * cfg.num_layers * cfg.hidden_size * seq_len
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), 197e12)
+    mfu = tok_per_s * flops_per_token / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "dense_lm_tokens_per_sec_per_chip",
+                "value": round(tok_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": 1.0,
+                "detail": {
+                    "mfu": round(mfu, 4),
+                    "params": n_params,
+                    "seq_len": seq_len,
+                    "batch": batch,
+                    "device": jax.devices()[0].device_kind,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -145,7 +145,13 @@ class MeshParameters:
                     f"({dict(zip(MESH_AXIS_NAMES, self.axis_sizes))}), "
                     f"got {len(jax.devices())}"
                 )
-            mesh = jax.make_mesh(self.axis_sizes, MESH_AXIS_NAMES)
+            # axis_types must be Auto: jax 0.9's make_mesh defaults to
+            # Explicit (sharding-in-types), which rejects plain jit use.
+            mesh = jax.make_mesh(
+                self.axis_sizes,
+                MESH_AXIS_NAMES,
+                axis_types=(jax.sharding.AxisType.Auto,) * len(MESH_AXIS_NAMES),
+            )
         else:
             if len(devices) != self.world_size:
                 raise ValueError(

@@ -1,0 +1,31 @@
+from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.components.stepper import StepActionPeriod, Stepper
+from d9d_tpu.loop.config import InferenceConfig, TrainerConfig
+from d9d_tpu.loop.control.providers import (
+    AdamWProvider,
+    DatasetProvider,
+    ModelProvider,
+    OptimizerProvider,
+)
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.model_factory import init_sharded_params
+from d9d_tpu.loop.tasks import CausalLMTask
+from d9d_tpu.loop.train import Trainer
+from d9d_tpu.loop.train_step import build_train_step
+
+__all__ = [
+    "BatchMaths",
+    "StepActionPeriod",
+    "Stepper",
+    "InferenceConfig",
+    "TrainerConfig",
+    "AdamWProvider",
+    "DatasetProvider",
+    "ModelProvider",
+    "OptimizerProvider",
+    "TrainTask",
+    "init_sharded_params",
+    "CausalLMTask",
+    "Trainer",
+    "build_train_step",
+]

@@ -1,0 +1,48 @@
+"""Global-batch ↔ microbatch arithmetic.
+
+Reference: d9d/loop/component/batch_maths.py:5. One place owns the
+divisibility rules between global batch size, microbatch size, and the
+data-parallel world so every component agrees on counts.
+"""
+
+import dataclasses
+
+from d9d_tpu.core.mesh import MeshContext
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMaths:
+    global_batch_size: int
+    microbatch_size: int
+    dp_size: int
+
+    def __post_init__(self) -> None:
+        if self.global_batch_size % self.microbatch_size != 0:
+            raise ValueError(
+                f"global_batch_size {self.global_batch_size} not divisible by "
+                f"microbatch_size {self.microbatch_size}"
+            )
+        if self.microbatch_size % self.dp_size != 0:
+            raise ValueError(
+                f"microbatch_size {self.microbatch_size} (global across DP) not "
+                f"divisible by dp_size {self.dp_size}"
+            )
+
+    @staticmethod
+    def from_context(
+        ctx: MeshContext, global_batch_size: int, microbatch_size: int
+    ) -> "BatchMaths":
+        return BatchMaths(
+            global_batch_size=global_batch_size,
+            microbatch_size=microbatch_size,
+            dp_size=ctx.axis_size(*ctx.batch_axes),
+        )
+
+    @property
+    def num_microbatches(self) -> int:
+        """Gradient-accumulation steps per optimizer step."""
+        return self.global_batch_size // self.microbatch_size
+
+    @property
+    def microbatch_size_per_dp_rank(self) -> int:
+        return self.microbatch_size // self.dp_size

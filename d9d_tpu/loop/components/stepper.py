@@ -1,0 +1,41 @@
+"""Step counting + periodic-action predicates.
+
+Reference: d9d/loop/component/stepper.py:8 (``Stepper``, ``StepActionPeriod``).
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class StepActionPeriod:
+    """Fire every ``period`` steps (and optionally on the final step)."""
+
+    period: int
+    on_last: bool = True
+
+    def should_fire(self, step: int, total_steps: int | None = None) -> bool:
+        if self.period > 0 and (step + 1) % self.period == 0:
+            return True
+        if self.on_last and total_steps is not None and step + 1 == total_steps:
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class Stepper:
+    total_steps: int | None = None
+    step: int = 0
+
+    def advance(self) -> int:
+        self.step += 1
+        return self.step
+
+    @property
+    def finished(self) -> bool:
+        return self.total_steps is not None and self.step >= self.total_steps
+
+    def state_dict(self) -> dict:
+        return {"step": self.step}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.step = int(state["step"])

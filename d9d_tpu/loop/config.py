@@ -1,0 +1,24 @@
+"""Trainer configuration (reference: d9d/loop/config/config.py:169)."""
+
+import pydantic
+
+
+class TrainerConfig(pydantic.BaseModel):
+    model_config = pydantic.ConfigDict(extra="forbid")
+
+    global_batch_size: int
+    microbatch_size: int
+    seq_len: int
+    total_steps: int
+    learning_rate: float = 3e-4
+    max_grad_norm: float | None = 1.0
+    seed: int = 0
+    log_every: int = 10
+
+
+class InferenceConfig(pydantic.BaseModel):
+    model_config = pydantic.ConfigDict(extra="forbid")
+
+    batch_size: int
+    seq_len: int
+    seed: int = 0

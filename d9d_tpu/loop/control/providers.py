@@ -1,0 +1,67 @@
+"""Provider protocols wiring user code into the trainer.
+
+Reference: d9d/loop/control/{model_provider.py:97, dataset_provider.py:41,
+optimizer_provider} — providers keep the loop generic over model family,
+data source, and optimizer.
+"""
+
+import abc
+from collections.abc import Iterable
+
+import flax.linen as nn
+import optax
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.parallel.plan import ParallelPlan
+from d9d_tpu.pipelining import PipelineStageInfo
+
+
+class ModelProvider(abc.ABC):
+    """Builds (stage-aware) model modules and their parallelism plan."""
+
+    @abc.abstractmethod
+    def build_module(self, stage: PipelineStageInfo) -> nn.Module: ...
+
+    @abc.abstractmethod
+    def build_plan(self, ctx: MeshContext) -> ParallelPlan: ...
+
+    @abc.abstractmethod
+    def sample_inputs(self, batch_size: int, seq_len: int) -> tuple:
+        """Abstract sample inputs for shape/param initialization."""
+
+
+class DatasetProvider(abc.ABC):
+    @abc.abstractmethod
+    def build(self) -> Iterable[PyTree]:
+        """Yield raw (host) batches of the *global* batch size."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class OptimizerProvider(abc.ABC):
+    @abc.abstractmethod
+    def build(
+        self, learning_rate: optax.ScalarOrSchedule
+    ) -> optax.GradientTransformation: ...
+
+
+class AdamWProvider(OptimizerProvider):
+    def __init__(
+        self,
+        b1: float = 0.9,
+        b2: float = 0.95,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        self.b1, self.b2, self.eps, self.weight_decay = b1, b2, eps, weight_decay
+
+    def build(self, learning_rate) -> optax.GradientTransformation:
+        return optax.adamw(
+            learning_rate,
+            b1=self.b1,
+            b2=self.b2,
+            eps=self.eps,
+            weight_decay=self.weight_decay,
+        )

@@ -1,0 +1,48 @@
+"""User-facing task protocol.
+
+Reference: d9d/loop/control/task.py:180 (``TrainTask``) — the user supplies
+(1) host-side batch preparation and (2) the per-microbatch loss. The TPU
+redesign makes ``loss_fn`` a *pure* function (params in, loss out) so the
+engine can jit/scan/pipeline it freely; the weighted-loss contract is the
+reference's: return (loss_sum, weight), the engine divides by the global
+Σweight after accumulation (loop/component/gradient_manager.py:16).
+"""
+
+import abc
+from typing import Any
+
+import flax.linen as nn
+
+from d9d_tpu.core.types import Array, PyTree
+
+
+class TrainTask(abc.ABC):
+    """Defines what is being optimized, independent of how it is parallelized."""
+
+    @abc.abstractmethod
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        """Host-side: raw loader batch → device-ready pytree of arrays.
+
+        Runs outside jit (numpy ok). The result's leading dim is the global
+        batch; the engine splits it into microbatches.
+        """
+
+    @abc.abstractmethod
+    def loss_fn(
+        self,
+        module: nn.Module,
+        params: PyTree,
+        microbatch: PyTree,
+        rng: Array,
+    ) -> tuple[Array, Array, dict[str, Array]]:
+        """Pure: → (loss_sum, weight, metrics). Runs under jit.
+
+        ``loss_sum`` is the *sum* of per-example losses in this microbatch;
+        ``weight`` its total weight (e.g. unmasked token count). The engine
+        computes grads of Σ loss_sum and scales by 1/Σ weight — sum-then-
+        scale, not mean-of-means.
+        """
+
+    def metrics_postprocess(self, metrics: dict[str, Any]) -> dict[str, Any]:
+        """Optional host-side metric transformation before logging."""
+        return metrics

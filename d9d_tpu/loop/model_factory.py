@@ -1,0 +1,41 @@
+"""Sharded model initialization.
+
+The TPU equivalent of the reference's meta-device init → parallelize →
+``to_empty`` → ``reset_parameters`` flow
+(d9d/loop/component/model_stage_factory.py:215-255): shapes are inferred
+abstractly with ``jax.eval_shape`` (free "meta device"), the parallel plan
+maps flax logical-axis metadata to NamedShardings, and a jitted init
+materializes every parameter directly into its shard — no full-model
+host copy ever exists.
+"""
+
+import functools
+
+import flax.linen as nn
+import jax
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.parallel.plan import ParallelPlan, logical_to_mesh_sharding
+
+
+def init_sharded_params(
+    module: nn.Module,
+    sample_inputs: tuple,
+    rng: jax.Array,
+    ctx: MeshContext,
+    plan: ParallelPlan,
+) -> tuple[PyTree, PyTree]:
+    """Returns (params, shardings); params are unboxed jax.Arrays already
+    placed according to ``plan``."""
+    init_fn = functools.partial(module.init, rng, *sample_inputs)
+    abstract = jax.eval_shape(init_fn)
+    logical_spec = nn.get_partition_spec(abstract)
+    shardings = logical_to_mesh_sharding(logical_spec, ctx.mesh, plan.rules)
+    boxed = jax.jit(init_fn, out_shardings=shardings)()
+    params = nn.unbox(boxed)
+    return params, jax.tree.map(lambda x: x.sharding, params)
+
+
+def abstract_param_shapes(module: nn.Module, sample_inputs: tuple, rng: jax.Array) -> PyTree:
+    return jax.eval_shape(functools.partial(module.init, rng, *sample_inputs))

@@ -1,0 +1,41 @@
+"""Built-in task implementations."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from d9d_tpu.core.types import Array, PyTree
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.ops import LM_IGNORE_INDEX
+
+
+class CausalLMTask(TrainTask):
+    """Next-token prediction with token-count loss weighting.
+
+    Equivalent of the reference example's SFT task
+    (example/qwen3_moe/pretrain.py): expects batches with ``input_ids``
+    [B, T+1] (and optional ``loss_mask`` [B, T+1]); shifts internally.
+    The model must be a CausalLM returning per-token loss given
+    (tokens, positions, labels).
+    """
+
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        input_ids = np.asarray(batch["input_ids"])
+        tokens = input_ids[:, :-1]
+        labels = input_ids[:, 1:].copy()
+        if "loss_mask" in batch:
+            labels = np.where(
+                np.asarray(batch["loss_mask"])[:, 1:] != 0, labels, LM_IGNORE_INDEX
+            )
+        b, t = tokens.shape
+        positions = np.broadcast_to(np.arange(t, dtype=np.int32), (b, t)).copy()
+        return {"tokens": tokens, "labels": labels, "positions": positions}
+
+    def loss_fn(
+        self, module: nn.Module, params: PyTree, mb: PyTree, rng: Array
+    ) -> tuple[Array, Array, dict[str, Array]]:
+        per_token = module.apply(params, mb["tokens"], mb["positions"], mb["labels"])
+        valid = (mb["labels"] != LM_IGNORE_INDEX).astype(jnp.float32)
+        loss_sum = per_token.sum()
+        weight = valid.sum()
+        return loss_sum, weight, {"tokens": weight}

@@ -1,0 +1,140 @@
+"""Trainer: wires providers into the compiled step and runs the loop.
+
+Reference: d9d/loop/run/train.py:71,251 (TrainingConfigurator/Trainer).
+The configure step builds mesh→model→optimizer→step-fn; ``train()`` is a
+thin host loop around the jitted step — data staging and metric readback
+are the only per-step host work (hot path is one XLA program).
+"""
+
+import logging
+import time
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.components.stepper import Stepper
+from d9d_tpu.loop.config import TrainerConfig
+from d9d_tpu.loop.control.providers import (
+    DatasetProvider,
+    ModelProvider,
+    OptimizerProvider,
+)
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.model_factory import init_sharded_params
+from d9d_tpu.loop.train_step import build_eval_step, build_train_step
+from d9d_tpu.pipelining import PipelineStageInfo
+
+logger = logging.getLogger("d9d_tpu.trainer")
+
+
+class Trainer:
+    def __init__(
+        self,
+        *,
+        ctx: MeshContext,
+        config: TrainerConfig,
+        model_provider: ModelProvider,
+        dataset_provider: DatasetProvider,
+        task: TrainTask,
+        optimizer_provider: OptimizerProvider,
+        learning_rate: optax.ScalarOrSchedule | None = None,
+    ):
+        self.ctx = ctx
+        self.config = config
+        self.task = task
+        self.batch_maths = BatchMaths.from_context(
+            ctx, config.global_batch_size, config.microbatch_size
+        )
+        self.stepper = Stepper(total_steps=config.total_steps)
+
+        self.module = model_provider.build_module(PipelineStageInfo())
+        plan = model_provider.build_plan(ctx)
+        rng = jax.random.PRNGKey(config.seed)
+        self.init_rng, self.step_rng = jax.random.split(rng)
+        sample = model_provider.sample_inputs(
+            self.batch_maths.microbatch_size, config.seq_len
+        )
+        self.params, self.param_shardings = init_sharded_params(
+            self.module, sample, self.init_rng, ctx, plan
+        )
+
+        self.optimizer = optimizer_provider.build(
+            learning_rate if learning_rate is not None else config.learning_rate
+        )
+        self.opt_state = jax.jit(self.optimizer.init)(self.params)
+
+        self.step_fn = build_train_step(
+            module=self.module,
+            task=self.task,
+            optimizer=self.optimizer,
+            ctx=ctx,
+            num_microbatches=self.batch_maths.num_microbatches,
+            max_grad_norm=config.max_grad_norm,
+        )
+        self.dataset = dataset_provider
+        self._batch_sharding = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
+        self._eval_fn = None
+
+    # ------------------------------------------------------------------
+
+    def _stage_batch(self, raw_batch: PyTree) -> PyTree:
+        """prepare → microbatch-reshape → device_put with dp sharding."""
+        batch = self.task.prepare_batch(raw_batch)
+        n_mb = self.batch_maths.num_microbatches
+        mb = self.batch_maths.microbatch_size
+
+        def reshape(x):
+            x = np.asarray(x)
+            if x.shape[0] != n_mb * mb:
+                raise ValueError(
+                    f"batch leading dim {x.shape[0]} != global batch {n_mb * mb}"
+                )
+            return x.reshape(n_mb, mb, *x.shape[1:])
+
+        batch = jax.tree.map(reshape, batch)
+        return jax.device_put(batch, self._batch_sharding)
+
+    def train(self) -> list[dict]:
+        """Run until total_steps or data exhaustion; returns metric history."""
+        history: list[dict] = []
+        t0 = time.perf_counter()
+        data_iter = iter(self.dataset.build())
+        while not self.stepper.finished:
+            try:
+                raw = next(data_iter)
+            except StopIteration:
+                break
+            batch = self._stage_batch(raw)
+            rng = jax.random.fold_in(self.step_rng, self.stepper.step)
+            self.params, self.opt_state, metrics = self.step_fn(
+                self.params, self.opt_state, batch, rng
+            )
+            step = self.stepper.advance()
+            if step % self.config.log_every == 0 or self.stepper.finished:
+                host_metrics = {
+                    k: float(np.asarray(v)) for k, v in metrics.items()
+                }
+                host_metrics = self.task.metrics_postprocess(host_metrics)
+                host_metrics["step"] = step
+                host_metrics["wall_s"] = time.perf_counter() - t0
+                history.append(host_metrics)
+                logger.info("step %d: %s", step, host_metrics)
+        return history
+
+    # convenience for tests / evaluation -------------------------------
+
+    def loss_on_batch(self, raw_batch: PyTree) -> float:
+        if self._eval_fn is None:
+            self._eval_fn = build_eval_step(
+                module=self.module,
+                task=self.task,
+                num_microbatches=self.batch_maths.num_microbatches,
+            )
+        batch = self._stage_batch(raw_batch)
+        rng = jax.random.fold_in(self.step_rng, 10**9)
+        return float(self._eval_fn(self.params, batch, rng))

@@ -1,0 +1,15 @@
+from d9d_tpu.models.qwen3.config import Qwen3DenseConfig
+from d9d_tpu.models.qwen3.dense import (
+    Qwen3DenseBackbone,
+    Qwen3DenseCausalLM,
+    Qwen3DenseForClassification,
+    Qwen3DenseForEmbedding,
+)
+
+__all__ = [
+    "Qwen3DenseConfig",
+    "Qwen3DenseBackbone",
+    "Qwen3DenseCausalLM",
+    "Qwen3DenseForClassification",
+    "Qwen3DenseForEmbedding",
+]

@@ -1,0 +1,209 @@
+"""Qwen3-dense model family: stage-aware backbone + task heads.
+
+Reference: d9d/module/model/qwen3_dense/model.py (stage-aware backbone with
+layers keyed by *global* layer id) and the head variants. The backbone takes
+token ids on the first pipeline stage and hidden states on later stages;
+only the last stage applies the final norm / head. Layer params are named
+``layers_{global_id}`` so checkpoints are stage-layout independent —
+repartitioning the pipeline never remaps weights.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.models.qwen3.config import Qwen3DenseConfig
+from d9d_tpu.nn.decoder import DecoderLayer
+from d9d_tpu.nn.embedding import TokenEmbedding
+from d9d_tpu.nn.heads import ClassificationHead, EmbeddingHead, LanguageModellingHead
+from d9d_tpu.nn.norm import RMSNorm
+from d9d_tpu.nn.sdpa.protocol import SdpaBackend
+from d9d_tpu.ops import compute_rope_frequencies, make_rope_cos_sin
+from d9d_tpu.pipelining import (
+    PipelineStageInfo,
+    distribute_layers_for_pipeline_stage,
+)
+
+
+class Qwen3DenseBackbone(nn.Module):
+    config: Qwen3DenseConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        cfg = self.config
+        if self.stage.is_first:
+            x = TokenEmbedding(
+                vocab_ranges=cfg.vocab_ranges,
+                hidden_size=cfg.hidden_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="embed_tokens",
+            )(x)
+        else:
+            x = x.astype(self.dtype)
+
+        inv_freq, att_scale = compute_rope_frequencies(
+            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        cos, sin = make_rope_cos_sin(positions, inv_freq, att_scale)
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+
+        for gid in distribute_layers_for_pipeline_stage(cfg.num_layers, self.stage):
+            x = layer_cls(
+                hidden_size=cfg.hidden_size,
+                num_heads=cfg.num_heads,
+                num_kv_heads=cfg.num_kv_heads,
+                head_dim=cfg.head_dim,
+                intermediate_size=cfg.intermediate_size,
+                sdpa=self.sdpa,
+                qk_norm=cfg.qk_norm,
+                window_size=cfg.window_size,
+                use_sinks=cfg.use_sinks,
+                use_output_gate=cfg.use_output_gate,
+                norm_eps=cfg.norm_eps,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name=f"layers_{gid}",
+            )(x, cos, sin, mask)
+
+        if self.stage.is_last:
+            x = RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="norm")(x)
+        return x
+
+
+class Qwen3DenseCausalLM(nn.Module):
+    """Backbone + fused-CE LM head.
+
+    On the last stage, ``__call__`` with labels returns per-token loss
+    ``[B, T]``; non-last stages return the hidden state to send downstream.
+    ``logits`` serves inference.
+    """
+
+    config: Qwen3DenseConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    ce_chunk_size: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        self.model = Qwen3DenseBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        if self.stage.is_last:
+            self.lm_head = LanguageModellingHead(
+                vocab_ranges=self.config.vocab_ranges,
+                hidden_size=self.config.hidden_size,
+                ce_chunk_size=self.ce_chunk_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        labels: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = self.model(x, positions, mask)
+        if self.stage.is_last and labels is not None:
+            return self.lm_head(h, labels)
+        return h
+
+    def logits(
+        self, x: Array, positions: Array, mask: Optional[Array] = None
+    ) -> Array:
+        h = self.model(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        return self.lm_head.logits(h)
+
+
+class Qwen3DenseForClassification(nn.Module):
+    """Backbone + last-token classification head (reference model.py heads)."""
+
+    config: Qwen3DenseConfig
+    sdpa: SdpaBackend
+    num_classes: int = 2
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        pooling_mask: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = Qwen3DenseBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="model",
+        )(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        if pooling_mask is None:
+            pooled = h[:, -1]
+        else:
+            idx = jnp.maximum(pooling_mask.sum(axis=-1) - 1, 0)
+            pooled = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        return ClassificationHead(
+            hidden_size=self.config.hidden_size,
+            num_classes=self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(pooled)
+
+
+class Qwen3DenseForEmbedding(nn.Module):
+    """Backbone + pooled L2-normalized embedding head."""
+
+    config: Qwen3DenseConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        pooling_mask: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = Qwen3DenseBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="model",
+        )(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        return EmbeddingHead()(h, pooling_mask)

@@ -1,0 +1,17 @@
+from d9d_tpu.nn.attention import GroupedQueryAttention
+from d9d_tpu.nn.decoder import DecoderLayer
+from d9d_tpu.nn.embedding import TokenEmbedding
+from d9d_tpu.nn.heads import ClassificationHead, EmbeddingHead, LanguageModellingHead
+from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.nn.norm import RMSNorm
+
+__all__ = [
+    "GroupedQueryAttention",
+    "DecoderLayer",
+    "TokenEmbedding",
+    "ClassificationHead",
+    "EmbeddingHead",
+    "LanguageModellingHead",
+    "SwiGLU",
+    "RMSNorm",
+]

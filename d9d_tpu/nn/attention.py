@@ -1,0 +1,117 @@
+"""Grouped-query attention block.
+
+Reference: d9d/module/block/attention/grouped_query.py:10 — QKV projections
+→ optional per-head QK RMSNorm → (optionally partial) RoPE → pluggable SDPA
+backend → optional sigmoid output gate → output projection. Feature surface
+covers Qwen3 (qk-norm), GPT-OSS-style sinks, and sliding-window models.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.nn.norm import RMSNorm
+from d9d_tpu.nn.sdpa.protocol import SdpaBackend
+from d9d_tpu.ops import RopeStyle, apply_rope
+
+
+class GroupedQueryAttention(nn.Module):
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    sdpa: SdpaBackend
+    qk_norm: bool = False
+    qk_norm_eps: float = 1e-6
+    rope_style: RopeStyle = RopeStyle.HALF
+    rope_fraction: float = 1.0
+    use_sinks: bool = False
+    use_output_gate: bool = False
+    window_size: int | None = None
+    softmax_scale: float | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        cos: Array,
+        sin: Array,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        b, t, _ = x.shape
+        h, hkv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        if h % hkv != 0:
+            raise ValueError(f"num_heads {h} not divisible by num_kv_heads {hkv}")
+
+        def proj(features, name, axes):
+            return nn.Dense(
+                features,
+                use_bias=False,
+                name=name,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+            )
+
+        q = proj(h * d, "q_proj", (la.EMBED, la.HEADS))(x).reshape(b, t, h, d)
+        k = proj(hkv * d, "k_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
+        v = proj(hkv * d, "v_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
+
+        if self.qk_norm:
+            q = RMSNorm(d, eps=self.qk_norm_eps, name="q_norm", param_dtype=self.param_dtype)(q)
+            k = RMSNorm(d, eps=self.qk_norm_eps, name="k_norm", param_dtype=self.param_dtype)(k)
+
+        # Partial RoPE: rotate the first `rot` dims, pass the rest through.
+        # cos/sin must cover >= rot//2 frequencies; for NeoX-style partial
+        # rotary semantics the *model* computes frequencies over the rotary
+        # dim (not head_dim) and passes them here — this block only slices.
+        rot = int(d * self.rope_fraction)
+        if rot:
+            cos_r, sin_r = cos[..., : rot // 2], sin[..., : rot // 2]
+            if rot < d:
+                q = jnp.concatenate(
+                    [apply_rope(q[..., :rot], cos_r, sin_r, self.rope_style), q[..., rot:]],
+                    axis=-1,
+                )
+                k = jnp.concatenate(
+                    [apply_rope(k[..., :rot], cos_r, sin_r, self.rope_style), k[..., rot:]],
+                    axis=-1,
+                )
+            else:
+                q = apply_rope(q, cos_r, sin_r, self.rope_style)
+                k = apply_rope(k, cos_r, sin_r, self.rope_style)
+
+        sinks = None
+        if self.use_sinks:
+            sinks = self.param(
+                "sinks",
+                nn.with_logical_partitioning(nn.initializers.zeros, (la.HEADS,)),
+                (h,),
+                self.param_dtype,
+            )
+
+        attn = self.sdpa(
+            q,
+            k,
+            v,
+            causal=True,
+            softmax_scale=self.softmax_scale,
+            window_size=self.window_size,
+            sinks=sinks,
+            mask=mask,
+        )
+
+        if self.use_output_gate:
+            gate = proj(h * d, "gate_proj", (la.EMBED, la.HEADS))(x)
+            attn = attn.reshape(b, t, h * d) * nn.sigmoid(gate)
+            attn = attn.reshape(b, t, h, d)
+
+        out = attn.reshape(b, t, h * d)
+        return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)

@@ -1,0 +1,69 @@
+"""Pre-norm transformer decoder layer (reference:
+d9d/module/model/qwen3_dense/decoder_layer.py:79)."""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn.attention import GroupedQueryAttention
+from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.nn.norm import RMSNorm
+from d9d_tpu.nn.sdpa.protocol import SdpaBackend
+from d9d_tpu.ops import RopeStyle
+
+
+class DecoderLayer(nn.Module):
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    sdpa: SdpaBackend
+    qk_norm: bool = True
+    rope_style: RopeStyle = RopeStyle.HALF
+    window_size: int | None = None
+    use_sinks: bool = False
+    use_output_gate: bool = False
+    norm_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: Array, cos: Array, sin: Array, mask: Optional[Array] = None
+    ) -> Array:
+        attn_out = GroupedQueryAttention(
+            hidden_size=self.hidden_size,
+            num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            sdpa=self.sdpa,
+            qk_norm=self.qk_norm,
+            rope_style=self.rope_style,
+            window_size=self.window_size,
+            use_sinks=self.use_sinks,
+            use_output_gate=self.use_output_gate,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="self_attn",
+        )(
+            RMSNorm(self.hidden_size, eps=self.norm_eps, name="input_layernorm")(x),
+            cos,
+            sin,
+            mask,
+        )
+        x = x + attn_out
+        mlp_out = SwiGLU(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="mlp",
+        )(
+            RMSNorm(
+                self.hidden_size, eps=self.norm_eps, name="post_attention_layernorm"
+            )(x)
+        )
+        return x + mlp_out

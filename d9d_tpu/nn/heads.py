@@ -1,0 +1,110 @@
+"""Model heads: language modelling (fused CE), classification, embedding.
+
+Reference: d9d/module/block/head/{language_modelling.py:14,
+classification.py:7, embedding.py:8}.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.ops import LM_IGNORE_INDEX, linear_cross_entropy
+
+
+class LanguageModellingHead(nn.Module):
+    """LM head over named vocab ranges with fused linear+CE loss.
+
+    ``__call__`` returns per-token loss (never materializing full logits,
+    reference language_modelling.py:14 via CCE); ``logits`` returns raw
+    logits for inference/eval paths.
+    """
+
+    vocab_ranges: tuple[tuple[str, int], ...]
+    hidden_size: int
+    ce_chunk_size: int = 2048
+    logit_softcap: float | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        self._tables = [
+            self.param(
+                f"head_{name}",
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), (la.VOCAB, la.EMBED)
+                ),
+                (size, self.hidden_size),
+                self.param_dtype,
+            )
+            for name, size in self.vocab_ranges
+        ]
+
+    def _weight(self) -> Array:
+        t = self._tables
+        return t[0] if len(t) == 1 else jnp.concatenate(t, axis=0)
+
+    def __call__(self, hidden: Array, labels: Array) -> Array:
+        """hidden [B,T,D], labels [B,T] → per-token loss [B,T] (fp32)."""
+        w = self._weight()
+        b, t, d = hidden.shape
+        loss = linear_cross_entropy(
+            hidden.reshape(b * t, d),
+            w,
+            labels.reshape(b * t),
+            chunk_size=self.ce_chunk_size,
+            logit_softcap=self.logit_softcap,
+        )
+        return loss.reshape(b, t)
+
+    def logits(self, hidden: Array) -> Array:
+        w = self._weight()
+        return hidden.astype(jnp.float32) @ w.astype(jnp.float32).T
+
+
+class ClassificationHead(nn.Module):
+    """Linear classifier over a pooled hidden state (reference classification.py:7)."""
+
+    hidden_size: int
+    num_classes: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden: Array) -> Array:
+        return nn.Dense(
+            self.num_classes,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (la.EMBED, la.CLASSES)
+            ),
+            name="classifier",
+        )(hidden).astype(jnp.float32)
+
+
+class EmbeddingHead(nn.Module):
+    """Mean-pool + L2-normalize sentence embeddings (reference embedding.py:8)."""
+
+    @nn.compact
+    def __call__(self, hidden: Array, pooling_mask: Optional[Array] = None) -> Array:
+        """hidden [B,T,D], pooling_mask [B,T] (1 = include) → [B,D] fp32."""
+        h = hidden.astype(jnp.float32)
+        if pooling_mask is None:
+            pooled = h.mean(axis=1)
+        else:
+            m = pooling_mask.astype(jnp.float32)[..., None]
+            pooled = (h * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-12)
+
+
+__all__ = [
+    "LM_IGNORE_INDEX",
+    "LanguageModellingHead",
+    "ClassificationHead",
+    "EmbeddingHead",
+]

@@ -1,0 +1,27 @@
+"""Logical axis vocabulary for parameter partitioning.
+
+The reference attaches parallelism to modules imperatively (DTensor
+``ParallelStyle``s, d9d/module/parallelism/style/*). The TPU-native design
+instead annotates every parameter with *logical* axis names at definition
+time; a parallelism *plan* is then just a table mapping logical names to
+mesh axes (see d9d_tpu/parallel/plan.py). Same separation of concerns —
+model code never mentions mesh axes — but it compiles to XLA SPMD sharding
+instead of eager collectives.
+"""
+
+# Embedding / residual stream width.
+EMBED = "embed"
+# Vocabulary dimension.
+VOCAB = "vocab"
+# FFN intermediate width.
+MLP = "mlp"
+# Attention query heads (x head_dim fused projections are split on heads).
+HEADS = "heads"
+# Attention kv heads.
+KV_HEADS = "kv_heads"
+# Per-head feature dim.
+HEAD_DIM = "head_dim"
+# Expert index dim of MoE grouped weights.
+EXPERT = "expert"
+# Classification classes.
+CLASSES = "classes"

@@ -1,0 +1,39 @@
+"""SwiGLU feed-forward block (reference: d9d/module/block/ffn/swiglu.py:8)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.ops import silu_mul
+
+
+class SwiGLU(nn.Module):
+    """gate/up/down projections around the fused silu-mul op.
+
+    Weights carry logical axes (embed, mlp) / (mlp, embed): a TP plan maps
+    ``mlp`` to the tp mesh axis (column-split gate/up, row-split down) and
+    XLA inserts the single all-reduce after the down projection.
+    """
+
+    hidden_size: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda features, name, axes: nn.Dense(  # noqa: E731
+            features,
+            use_bias=False,
+            name=name,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), axes
+            ),
+        )
+        gate = dense(self.intermediate_size, "gate_proj", (la.EMBED, la.MLP))(x)
+        up = dense(self.intermediate_size, "up_proj", (la.EMBED, la.MLP))(x)
+        return dense(self.hidden_size, "down_proj", (la.MLP, la.EMBED))(
+            silu_mul(gate, up)
+        )

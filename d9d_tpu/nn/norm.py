@@ -1,0 +1,30 @@
+"""RMSNorm block (reference: d9d/module/block/normalization/rms_norm.py:8)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.ops import rms_norm
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layer norm with optional zero-centered weight.
+
+    ``zero_centered=True`` stores the scale as an offset from 1 (DeepSeek
+    style), so fresh init (zeros) is an identity scale either way.
+    """
+
+    hidden_size: int
+    eps: float = 1e-6
+    zero_centered: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        init = nn.initializers.zeros if self.zero_centered else nn.initializers.ones
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(init, (None,)),
+            (self.hidden_size,),
+            self.param_dtype,
+        )
+        return rms_norm(x, weight, eps=self.eps, zero_centered=self.zero_centered)

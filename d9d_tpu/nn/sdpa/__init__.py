@@ -1,0 +1,15 @@
+from d9d_tpu.nn.sdpa.config import (
+    SdpaBackendConfig,
+    SdpaEagerConfig,
+    SdpaPallasFlashConfig,
+)
+from d9d_tpu.nn.sdpa.factory import build_sdpa_backend
+from d9d_tpu.nn.sdpa.protocol import SdpaBackend
+
+__all__ = [
+    "SdpaBackend",
+    "SdpaBackendConfig",
+    "SdpaEagerConfig",
+    "SdpaPallasFlashConfig",
+    "build_sdpa_backend",
+]

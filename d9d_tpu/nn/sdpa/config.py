@@ -1,0 +1,31 @@
+"""SDPA backend configs — pydantic discriminated union.
+
+Reference pattern: d9d/module/block/attention/sdpa/config.py:8-76 and the
+backend-selection DEP (deps/0008-dep-backend-selection.md): every backend
+family gets a typed config union + a factory with auto-detection + one env
+override channel carrying a JSON-encoded config.
+"""
+
+from typing import Annotated, Literal, Union
+
+import pydantic
+
+
+class SdpaEagerConfig(pydantic.BaseModel):
+    """Pure-XLA attention. Full feature surface; the correctness oracle."""
+
+    type: Literal["eager"] = "eager"
+
+
+class SdpaPallasFlashConfig(pydantic.BaseModel):
+    """Pallas flash-attention kernel (TPU only)."""
+
+    type: Literal["pallas_flash"] = "pallas_flash"
+    block_q: int = 512
+    block_kv: int = 512
+
+
+SdpaBackendConfig = Annotated[
+    Union[SdpaEagerConfig, SdpaPallasFlashConfig],
+    pydantic.Field(discriminator="type"),
+]

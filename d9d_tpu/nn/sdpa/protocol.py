@@ -1,0 +1,27 @@
+"""SDPA backend protocol (reference: d9d/module/block/attention/sdpa/protocol.py:6)."""
+
+from typing import Protocol
+
+from d9d_tpu.core.types import Array
+
+
+class SdpaBackend(Protocol):
+    """A scaled-dot-product-attention implementation.
+
+    All backends accept the full feature surface; ones that cannot honor an
+    argument must raise, never silently ignore (matching the reference's
+    backend contract).
+    """
+
+    def __call__(
+        self,
+        q: Array,
+        k: Array,
+        v: Array,
+        *,
+        causal: bool = True,
+        softmax_scale: float | None = None,
+        window_size: int | None = None,
+        sinks: Array | None = None,
+        mask: Array | None = None,
+    ) -> Array: ...

@@ -1,0 +1,33 @@
+from d9d_tpu.ops.attention.eager import eager_sdpa
+from d9d_tpu.ops.linear_ce import LM_IGNORE_INDEX, linear_cross_entropy
+from d9d_tpu.ops.rms_norm import rms_norm
+from d9d_tpu.ops.rope import (
+    RopeScaling,
+    RopeScalingLinear,
+    RopeScalingNone,
+    RopeScalingNtk,
+    RopeScalingYarn,
+    RopeStyle,
+    apply_rope,
+    compute_rope_frequencies,
+    make_rope_cos_sin,
+)
+from d9d_tpu.ops.swiglu import silu_mul, swiglu
+
+__all__ = [
+    "eager_sdpa",
+    "LM_IGNORE_INDEX",
+    "linear_cross_entropy",
+    "rms_norm",
+    "RopeScaling",
+    "RopeScalingLinear",
+    "RopeScalingNone",
+    "RopeScalingNtk",
+    "RopeScalingYarn",
+    "RopeStyle",
+    "apply_rope",
+    "compute_rope_frequencies",
+    "make_rope_cos_sin",
+    "silu_mul",
+    "swiglu",
+]

@@ -1,0 +1,3 @@
+from d9d_tpu.ops.attention.eager import eager_sdpa
+
+__all__ = ["eager_sdpa"]

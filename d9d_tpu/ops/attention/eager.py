@@ -1,0 +1,84 @@
+"""Reference (XLA "eager") scaled-dot-product attention.
+
+Feature-parity target of the reference's eager SDPA backend
+(d9d/module/block/attention/sdpa/impl/eager.py:9): GQA head broadcasting,
+causal masking, sliding window, learnable attention sinks, and explicit
+boolean masks — all in one fp32-softmax implementation. This is the
+correctness oracle the Pallas flash kernel is tested against, and the
+fallback for platforms without Pallas support.
+
+Shape convention is flash-style ``[batch, seq, heads, head_dim]``.
+"""
+
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+NEG_INF = float("-inf")
+
+
+def eager_sdpa(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    softmax_scale: float | None = None,
+    window_size: int | None = None,
+    sinks: Array | None = None,
+    mask: Array | None = None,
+) -> Array:
+    """Attention over ``q [B,T,Hq,D]``, ``k/v [B,S,Hkv,D]`` → ``[B,T,Hq,Dv]``.
+
+    - GQA: ``Hq`` must be a multiple of ``Hkv``; kv heads are broadcast.
+    - ``window_size``: each query attends to keys in ``(pos-window, pos]``.
+    - ``sinks [Hq]``: learnable per-head sink logits joining the softmax
+      denominator (attention-sink stabilization; reference
+      kernel/flash_attn/function.py:34 handles the analytic dsink — here
+      autodiff derives it for free).
+    - ``mask``: boolean, broadcastable to ``[B, Hq, T, S]``; True = attend.
+    """
+    b, t, hq, d = q.shape
+    _, s, hkv, dv = v.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    qf = q.astype(jnp.float32).reshape(b, t, hkv, g, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # [B, Hkv, G, T, S]
+    logits = jnp.einsum("bthgd,bshd->bhgts", qf, kf) * scale
+
+    neg = jnp.asarray(NEG_INF, logits.dtype)
+    q_pos = jnp.arange(t)[:, None] + (s - t)  # align last query with last key
+    k_pos = jnp.arange(s)[None, :]
+    if causal:
+        logits = jnp.where(k_pos <= q_pos, logits, neg)
+    if window_size is not None:
+        logits = jnp.where(k_pos > q_pos - window_size, logits, neg)
+    if mask is not None:
+        m = jnp.broadcast_to(mask, (b, hq, t, s)).reshape(b, hkv, g, t, s)
+        logits = jnp.where(m, logits, neg)
+
+    if sinks is not None:
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, hkv, g, 1, 1), (b, hkv, g, t, 1)
+        )
+        logits = jnp.concatenate([logits, sink], axis=-1)
+
+    # stable softmax; rows that are fully masked produce zeros, not NaN
+    m_max = jnp.max(logits, axis=-1, keepdims=True)
+    m_max = jnp.where(jnp.isfinite(m_max), m_max, 0.0)
+    unnorm = jnp.exp(logits - m_max)
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    probs = unnorm / jnp.maximum(denom, 1e-30)
+
+    if sinks is not None:
+        probs = probs[..., :-1]
+
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, vf)
+    return out.reshape(b, t, hq, dv).astype(q.dtype)

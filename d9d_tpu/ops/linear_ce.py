@@ -1,0 +1,80 @@
+"""Fused linear + cross-entropy that never holds the full logit matrix.
+
+TPU equivalent of the reference's vendored Cut Cross-Entropy
+(d9d/kernel/cce/main.py:119): the LM head projection and the CE loss are
+fused so the ``[tokens, vocab]`` logit tensor is only ever materialized one
+token-chunk at a time. On TPU this is a ``lax.scan`` over token chunks with
+rematerialization (``jax.checkpoint``) — the backward pass recomputes each
+chunk's logits instead of storing them, trading MXU FLOPs (cheap) for HBM
+(the bottleneck), which is exactly the trade the Triton kernel makes on GPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from d9d_tpu.core.types import Array
+
+LM_IGNORE_INDEX = -100
+
+
+def _chunk_loss(
+    hidden: Array, labels: Array, weight_t: Array, logit_softcap: float | None
+) -> Array:
+    """Per-token loss for one chunk. hidden [C,D], labels [C], weight_t [D,V]."""
+    logits = jnp.einsum(
+        "cd,dv->cv",
+        hidden.astype(jnp.float32),
+        weight_t.astype(jnp.float32),
+        precision=lax.Precision.DEFAULT,
+    )
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    safe_labels = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    correct = jnp.take_along_axis(logits, safe_labels[:, None], axis=-1)[:, 0]
+    loss = lse - correct
+    return jnp.where(labels == LM_IGNORE_INDEX, 0.0, loss)
+
+
+def linear_cross_entropy(
+    hidden: Array,
+    weight: Array,
+    labels: Array,
+    *,
+    chunk_size: int = 2048,
+    logit_softcap: float | None = None,
+) -> Array:
+    """Per-token CE of ``hidden [N,D] @ weight[V,D].T`` against ``labels [N]``.
+
+    Tokens labelled ``LM_IGNORE_INDEX`` (-100) contribute zero loss
+    (reference: module/block/head/language_modelling.py:14). Returns fp32
+    ``[N]`` — reduction/weighting is the caller's policy.
+    """
+    n, d = hidden.shape
+    weight_t = weight.T  # [D, V]
+
+    if n <= chunk_size:
+        return _chunk_loss(hidden, labels, weight_t, logit_softcap)
+
+    pad = (-n) % chunk_size
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=LM_IGNORE_INDEX)
+    num_chunks = hidden.shape[0] // chunk_size
+    hidden = hidden.reshape(num_chunks, chunk_size, d)
+    labels = labels.reshape(num_chunks, chunk_size)
+
+    body = jax.checkpoint(
+        functools.partial(_chunk_loss, logit_softcap=logit_softcap)
+    )
+
+    def scan_fn(carry, xs):
+        h, l = xs
+        return carry, body(h, l, weight_t)
+
+    _, losses = lax.scan(scan_fn, None, (hidden, labels))
+    losses = losses.reshape(-1)
+    return losses[:n] if pad else losses

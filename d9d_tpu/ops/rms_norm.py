@@ -1,0 +1,39 @@
+"""RMS normalization op.
+
+TPU equivalent of the reference Triton RMSNorm kernel
+(d9d/kernel/normalization/rms/function.py:29, op.py:26,153), including the
+zero-centered-weight variant (DeepSeek style). On TPU the forward/backward
+are left to XLA, which fuses the reduction + scale into neighbouring ops —
+a hand-written Pallas kernel only pays off when fused into larger blocks,
+which is handled at the block level.
+
+The reduction runs in float32 regardless of input dtype (matching the
+reference kernel's internal fp32 accumulation) and casts back at the end.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from d9d_tpu.core.types import Array
+
+
+def rms_norm(
+    x: Array,
+    weight: Array,
+    *,
+    eps: float = 1e-6,
+    zero_centered: bool = False,
+) -> Array:
+    """Normalize ``x`` over its last dim and scale by ``weight``.
+
+    With ``zero_centered=True`` the effective scale is ``1 + weight`` (the
+    parameter is stored as an offset from 1, reference rms/function.py:29).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    scale = weight.astype(jnp.float32)
+    if zero_centered:
+        scale = scale + 1.0
+    return (normed * scale).astype(dtype)

@@ -1,0 +1,155 @@
+"""Rotary position embeddings: frequency computation, scaling laws, application.
+
+TPU-native equivalent of the reference RoPE stack
+(d9d/module/block/positional/rope.py:22,76,187 and rope_scaling.py:36-120):
+two layout styles (HALF = GPT-NeoX rotate-half, INTERLEAVED = GPT-J pairs),
+four scaling laws (none / linear / NTK-aware / YaRN). Everything here is a
+pure function of static config + a positions array, so it jits and shards
+trivially (positions can be sharded over the cp axes).
+"""
+
+import dataclasses
+import enum
+import math
+
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+
+class RopeStyle(enum.Enum):
+    HALF = "half"
+    INTERLEAVED = "interleaved"
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScalingNone:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScalingLinear:
+    factor: float
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScalingNtk:
+    """NTK-aware scaling: rescales theta so the longest wavelength covers the
+    extended context (reference rope_scaling.py:58)."""
+
+    factor: float
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScalingYarn:
+    """YaRN (arXiv 2309.00071): interpolate low-frequency bands, extrapolate
+    high-frequency bands, with sqrt-log attention temperature
+    (reference rope_scaling.py:120)."""
+
+    factor: float
+    original_max_position: int
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    attention_factor: float | None = None
+
+
+RopeScaling = RopeScalingNone | RopeScalingLinear | RopeScalingNtk | RopeScalingYarn
+
+
+def _yarn_correction_dim(num_rotations: float, dim: int, theta: float, max_pos: int) -> float:
+    return (dim * math.log(max_pos / (num_rotations * 2 * math.pi))) / (
+        2 * math.log(theta)
+    )
+
+
+def compute_rope_frequencies(
+    head_dim: int,
+    theta: float,
+    scaling: RopeScaling = RopeScalingNone(),
+) -> tuple[Array, float]:
+    """Return (inv_freq [head_dim//2] float32, attention_scale).
+
+    ``attention_scale`` multiplies cos/sin (YaRN temperature); 1.0 otherwise.
+    """
+    dim = head_dim
+    exponents = jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
+    inv_freq = 1.0 / (theta**exponents)
+    scale = 1.0
+
+    if isinstance(scaling, RopeScalingNone):
+        pass
+    elif isinstance(scaling, RopeScalingLinear):
+        inv_freq = inv_freq / scaling.factor
+    elif isinstance(scaling, RopeScalingNtk):
+        adjusted_theta = theta * scaling.factor ** (dim / (dim - 2))
+        inv_freq = 1.0 / (adjusted_theta**exponents)
+    elif isinstance(scaling, RopeScalingYarn):
+        low = _yarn_correction_dim(
+            scaling.beta_fast, dim, theta, scaling.original_max_position
+        )
+        high = _yarn_correction_dim(
+            scaling.beta_slow, dim, theta, scaling.original_max_position
+        )
+        low = max(math.floor(low), 0)
+        high = min(math.ceil(high), dim // 2 - 1)
+        # ramp: 0 where extrapolation (high freq), 1 where interpolation
+        ramp = jnp.clip(
+            (jnp.arange(dim // 2, dtype=jnp.float32) - low) / max(high - low, 1e-3),
+            0.0,
+            1.0,
+        )
+        interp = inv_freq / scaling.factor
+        inv_freq = inv_freq * (1 - ramp) + interp * ramp
+        if scaling.attention_factor is not None:
+            scale = scaling.attention_factor
+        else:
+            scale = 0.1 * math.log(scaling.factor) + 1.0
+    else:
+        raise TypeError(f"unknown rope scaling: {scaling!r}")
+    return inv_freq, scale
+
+
+def make_rope_cos_sin(
+    positions: Array,
+    inv_freq: Array,
+    attention_scale: float = 1.0,
+    dtype: jnp.dtype = jnp.float32,
+) -> tuple[Array, Array]:
+    """cos/sin of shape ``positions.shape + (head_dim//2,)``."""
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    cos = jnp.cos(angles) * attention_scale
+    sin = jnp.sin(angles) * attention_scale
+    return cos.astype(dtype), sin.astype(dtype)
+
+
+def apply_rope(
+    x: Array,
+    cos: Array,
+    sin: Array,
+    style: RopeStyle = RopeStyle.HALF,
+) -> Array:
+    """Rotate ``x [..., T, H, D]`` by cos/sin ``[..., T, D//2]``.
+
+    HALF pairs element i with i + D/2 (GPT-NeoX / HF Llama layout);
+    INTERLEAVED pairs 2i with 2i+1 (GPT-J layout). Reference:
+    module/block/positional/rope.py:187.
+    """
+    d_half = x.shape[-1] // 2
+    cos = cos[..., None, :]  # broadcast over heads
+    sin = sin[..., None, :]
+    xf = x.astype(jnp.float32)
+    if style == RopeStyle.HALF:
+        x1 = xf[..., :d_half]
+        x2 = xf[..., d_half:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        )
+    elif style == RopeStyle.INTERLEAVED:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    else:
+        raise ValueError(f"unknown rope style: {style}")
+    return out.astype(x.dtype)

@@ -1,0 +1,24 @@
+"""Fused SwiGLU gate op: silu(gate) * up.
+
+TPU equivalent of the reference Triton silu_mul kernel
+(d9d/kernel/swiglu/function.py:23, op.py:26,97). XLA fuses this elementwise
+chain into the surrounding matmuls on TPU, so the default implementation is
+plain jnp; the op exists as a seam so a Pallas fusion (e.g. into the down
+projection) can be swapped in without touching block code.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+
+def silu_mul(gate: Array, up: Array) -> Array:
+    return jax.nn.silu(gate) * up
+
+
+def swiglu(x: Array, w_gate: Array, w_up: Array, w_down: Array) -> Array:
+    """Full SwiGLU FFN: down( silu(x @ gate) * (x @ up) )."""
+    g = x @ w_gate
+    u = x @ w_up
+    return silu_mul(g, u) @ w_down

@@ -1,0 +1,19 @@
+from d9d_tpu.parallel.plan import (
+    LogicalRules,
+    ParallelPlan,
+    fsdp_plan,
+    hsdp_plan,
+    logical_to_mesh_sharding,
+    replicate_plan,
+    tp_plan,
+)
+
+__all__ = [
+    "LogicalRules",
+    "ParallelPlan",
+    "fsdp_plan",
+    "hsdp_plan",
+    "logical_to_mesh_sharding",
+    "replicate_plan",
+    "tp_plan",
+]

@@ -1,0 +1,99 @@
+"""Parallelism plans: logical-axis → mesh-axis rule tables.
+
+The TPU-native replacement for the reference's parallelize API
+(d9d/module/parallelism/api/{replicate_parallel,fully_sharded,
+hybrid_sharded,expert_parallel}.py). A *plan* is a table mapping the logical
+axis names parameters were annotated with (d9d_tpu/nn/logical_axes.py) to
+mesh axes; applying a plan turns the abstract param tree into
+``NamedSharding``s, and XLA SPMD inserts the all-gathers/reduce-scatters the
+reference implements imperatively (DTensor styles + bucketed allreduce).
+
+- replicate  → DDP: params replicated over every data axis; gradient psum
+  happens inside the jitted step (reference api/replicate_parallel.py:9).
+- fsdp       → ZeRO-3: every weight sharded on its ``embed`` dim over the
+  fused dp_s×cp_s axes (reference api/fully_sharded.py:14); XLA gathers
+  params at use and reduce-scatters grads.
+- hsdp       → same sharding; dp_r replicates implicitly because the rule
+  table never mentions it (reference api/hybrid_sharded.py:10).
+- tp         → Megatron-style: heads/mlp/vocab dims over the tp axis —
+  a capability the reference reserves mesh dims for but never implements
+  (SURVEY §2.9); on TPU it is just more rules in the table.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.mesh import AXIS_TP, MeshContext
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.nn import logical_axes as la
+
+LogicalRules = tuple[tuple[str, str | tuple[str, ...] | None], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """A named logical→mesh rule table."""
+
+    name: str
+    rules: LogicalRules
+
+    def param_shardings(self, ctx: MeshContext, abstract_params: PyTree) -> PyTree:
+        """Map an abstract (eval_shape) param tree with flax Partitioned
+        metadata to a tree of NamedShardings."""
+        logical = nn.get_partition_spec(abstract_params)
+        return logical_to_mesh_sharding(logical, ctx.mesh, self.rules)
+
+
+def logical_to_mesh_sharding(
+    logical_spec_tree: PyTree, mesh: Mesh, rules: LogicalRules
+) -> PyTree:
+    table = dict(rules)
+
+    def convert(spec) -> NamedSharding:
+        if not isinstance(spec, P):
+            return NamedSharding(mesh, P())
+        dims = []
+        for axis in spec:
+            mapped = table.get(axis) if axis is not None else None
+            dims.append(mapped)
+        return NamedSharding(mesh, P(*dims))
+
+    return jax.tree.map(
+        convert, logical_spec_tree, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def replicate_plan(ctx: MeshContext) -> ParallelPlan:
+    return ParallelPlan(name="replicate", rules=())
+
+
+def fsdp_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
+    """Shard every parameter's embed dim over the fused dp_s×cp_s axes."""
+    rules: list[tuple[str, str | tuple[str, ...] | None]] = [
+        (la.EMBED, ctx.fsdp_axes),
+    ]
+    if with_tp:
+        rules += _tp_rules()
+    return ParallelPlan(name="fsdp", rules=tuple(rules))
+
+
+def hsdp_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
+    # dp_r is simply absent from the table → replicated across it.
+    return dataclasses.replace(fsdp_plan(ctx, with_tp=with_tp), name="hsdp")
+
+
+def _tp_rules() -> list[tuple[str, str | tuple[str, ...] | None]]:
+    return [
+        (la.HEADS, AXIS_TP),
+        (la.KV_HEADS, AXIS_TP),
+        (la.MLP, AXIS_TP),
+        (la.VOCAB, AXIS_TP),
+        (la.EXPERT, None),
+    ]
+
+
+def tp_plan(ctx: MeshContext) -> ParallelPlan:
+    return ParallelPlan(name="tp", rules=tuple(_tp_rules()))

@@ -1,0 +1,6 @@
+from d9d_tpu.pipelining.stage_info import (
+    PipelineStageInfo,
+    distribute_layers_for_pipeline_stage,
+)
+
+__all__ = ["PipelineStageInfo", "distribute_layers_for_pipeline_stage"]

@@ -1,0 +1,131 @@
+"""End-to-end: tiny dense LM trains with loss going down (BASELINE config 1).
+
+Mirrors the reference's full-model task-centric harness pattern
+(SURVEY §4.3) at minimum scale: 8-device DP mesh, grad accumulation,
+weighted-loss semantics.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop import (
+    AdamWProvider,
+    CausalLMTask,
+    DatasetProvider,
+    ModelProvider,
+    Trainer,
+    TrainerConfig,
+)
+from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.ops.attention.eager import eager_sdpa
+from d9d_tpu.parallel import fsdp_plan, replicate_plan
+
+VOCAB = 64
+
+
+class TinyModelProvider(ModelProvider):
+    def __init__(self, plan="replicate"):
+        self.cfg = Qwen3DenseConfig.tiny(vocab_size=VOCAB)
+        self.plan_name = plan
+
+    def build_module(self, stage):
+        import jax.numpy as jnp
+
+        return Qwen3DenseCausalLM(
+            config=self.cfg,
+            sdpa=eager_sdpa,
+            stage=stage,
+            dtype=jnp.float32,
+        )
+
+    def build_plan(self, ctx):
+        return replicate_plan(ctx) if self.plan_name == "replicate" else fsdp_plan(ctx)
+
+    def sample_inputs(self, batch_size, seq_len):
+        import jax.numpy as jnp
+
+        tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
+        positions = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return (tokens, positions, tokens)
+
+
+class ShiftPatternDataset(DatasetProvider):
+    """Next token = (token + 3) % VOCAB — a perfectly learnable pattern."""
+
+    def __init__(self, num_batches, batch_size, seq_len, seed=0):
+        self.num_batches = num_batches
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+
+    def build(self):
+        rng = np.random.RandomState(self.seed)
+        for _ in range(self.num_batches):
+            start = rng.randint(0, VOCAB, size=(self.batch_size, 1))
+            steps = np.arange(self.seq_len + 1)[None, :]
+            yield {"input_ids": (start + 3 * steps) % VOCAB}
+
+
+@pytest.mark.parametrize("plan", ["replicate", "fsdp"])
+def test_tiny_lm_loss_goes_down(plan):
+    ctx = MeshParameters(
+        dp_replicate=4 if plan == "replicate" else 1,
+        dp_shard=2 if plan == "replicate" else 8,
+    ).build(jax.devices())
+    config = TrainerConfig(
+        global_batch_size=16,
+        microbatch_size=8,
+        seq_len=16,
+        total_steps=30,
+        learning_rate=1e-2,
+        log_every=5,
+        seed=0,
+    )
+    trainer = Trainer(
+        ctx=ctx,
+        config=config,
+        model_provider=TinyModelProvider(plan),
+        dataset_provider=ShiftPatternDataset(40, 16, 16),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(weight_decay=0.01),
+    )
+    history = trainer.train()
+    assert len(history) >= 3
+    first, last = history[0]["loss"], history[-1]["loss"]
+    assert np.isfinite(first) and np.isfinite(last)
+    # the pattern is deterministic: loss must collapse
+    assert last < first * 0.5, f"loss did not improve: {first} -> {last}"
+    assert history[-1]["grad_norm"] >= 0
+
+
+def test_weighted_loss_ignores_masked_tokens():
+    ctx = MeshParameters(dp_replicate=8).build(jax.devices())
+    config = TrainerConfig(
+        global_batch_size=8,
+        microbatch_size=8,
+        seq_len=8,
+        total_steps=1,
+        log_every=1,
+    )
+    provider = TinyModelProvider()
+
+    class MaskedDataset(DatasetProvider):
+        def build(self):
+            ids = np.arange(8 * 9).reshape(8, 9) % VOCAB
+            mask = np.zeros((8, 9), np.int32)
+            mask[:, :4] = 1
+            yield {"input_ids": ids, "loss_mask": mask}
+
+    trainer = Trainer(
+        ctx=ctx,
+        config=config,
+        model_provider=provider,
+        dataset_provider=MaskedDataset(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    history = trainer.train()
+    # 8 rows x 3 valid label positions (mask shifts by 1) = 24
+    assert history[-1]["loss_weight"] == 24.0

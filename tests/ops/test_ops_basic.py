@@ -1,0 +1,244 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.ops import (
+    LM_IGNORE_INDEX,
+    RopeStyle,
+    apply_rope,
+    compute_rope_frequencies,
+    eager_sdpa,
+    linear_cross_entropy,
+    make_rope_cos_sin,
+    rms_norm,
+    silu_mul,
+)
+
+
+def rng(*shape, seed=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype=dtype)
+
+
+class TestRmsNorm:
+    def test_matches_manual(self):
+        x = rng(4, 16)
+        w = rng(16, seed=1) * 0.1 + 1.0
+        out = rms_norm(x, w)
+        expected = (
+            np.asarray(x)
+            / np.sqrt(np.mean(np.asarray(x) ** 2, -1, keepdims=True) + 1e-6)
+            * np.asarray(w)
+        )
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    def test_zero_centered(self):
+        x = rng(4, 16)
+        w = jnp.zeros(16)
+        out = rms_norm(x, w, zero_centered=True)
+        base = rms_norm(x, jnp.ones(16))
+        np.testing.assert_allclose(out, base, rtol=1e-6)
+
+    def test_preserves_dtype(self):
+        x = rng(4, 16).astype(jnp.bfloat16)
+        assert rms_norm(x, jnp.ones(16)).dtype == jnp.bfloat16
+
+
+class TestSiluMul:
+    def test_matches_torch(self):
+        import torch
+
+        g, u = rng(8, 32), rng(8, 32, seed=1)
+        out = silu_mul(g, u)
+        tg = torch.tensor(np.asarray(g))
+        tu = torch.tensor(np.asarray(u))
+        expected = (torch.nn.functional.silu(tg) * tu).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+class TestRope:
+    def test_half_style_matches_hf(self):
+        """HALF layout must match the HuggingFace Llama/Qwen implementation."""
+        import torch
+
+        b, t, h, d = 2, 5, 3, 8
+        q = rng(b, t, h, d)
+        inv_freq, scale = compute_rope_frequencies(d, 10000.0)
+        assert scale == 1.0
+        positions = jnp.arange(t)
+        cos, sin = make_rope_cos_sin(positions, inv_freq, scale)
+        out = apply_rope(q, cos[None], sin[None], RopeStyle.HALF)
+
+        # HF oracle: rotate_half with cos/sin duplicated across both halves
+        tq = torch.tensor(np.asarray(q)).permute(0, 2, 1, 3)  # [B,H,T,D]
+        t_inv = torch.tensor(np.asarray(inv_freq))
+        ang = torch.arange(t)[:, None].float() * t_inv[None, :]
+        tcos = torch.cat([ang.cos(), ang.cos()], dim=-1)[None, None]
+        tsin = torch.cat([ang.sin(), ang.sin()], dim=-1)[None, None]
+
+        def rotate_half(x):
+            x1, x2 = x.chunk(2, dim=-1)
+            return torch.cat((-x2, x1), dim=-1)
+
+        expected = (tq * tcos + rotate_half(tq) * tsin).permute(0, 2, 1, 3).numpy()
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+    def test_interleaved_rotation_is_norm_preserving(self):
+        q = rng(1, 7, 2, 16)
+        inv_freq, s = compute_rope_frequencies(16, 1e6)
+        cos, sin = make_rope_cos_sin(jnp.arange(7), inv_freq, s)
+        out = apply_rope(q, cos[None], sin[None], RopeStyle.INTERLEAVED)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(q, axis=-1), rtol=1e-5
+        )
+
+    @pytest.mark.parametrize("name", ["linear", "ntk", "yarn"])
+    def test_scalings(self, name):
+        from d9d_tpu.ops import RopeScalingLinear, RopeScalingNtk, RopeScalingYarn
+
+        scaling = {
+            "linear": RopeScalingLinear(factor=4.0),
+            "ntk": RopeScalingNtk(factor=4.0),
+            "yarn": RopeScalingYarn(factor=4.0, original_max_position=128),
+        }[name]
+        inv_freq, scale = compute_rope_frequencies(32, 10000.0, scaling)
+        base, _ = compute_rope_frequencies(32, 10000.0)
+        assert inv_freq.shape == (16,)
+        # scaled frequencies must not exceed base (context extension slows rotation)
+        assert (np.asarray(inv_freq) <= np.asarray(base) + 1e-9).all()
+        if name == "yarn":
+            assert scale > 1.0
+
+
+class TestEagerSdpa:
+    def test_causal_matches_torch(self):
+        import torch
+
+        b, t, h, d = 2, 9, 4, 16
+        q, k, v = rng(b, t, h, d), rng(b, t, h, d, seed=1), rng(b, t, h, d, seed=2)
+        out = eager_sdpa(q, k, v, causal=True)
+        tq, tk, tv = (
+            torch.tensor(np.asarray(x)).permute(0, 2, 1, 3) for x in (q, k, v)
+        )
+        expected = (
+            torch.nn.functional.scaled_dot_product_attention(tq, tk, tv, is_causal=True)
+            .permute(0, 2, 1, 3)
+            .numpy()
+        )
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_gqa_matches_torch(self):
+        import torch
+
+        q = rng(1, 6, 8, 8)
+        k, v = rng(1, 6, 2, 8, seed=1), rng(1, 6, 2, 8, seed=2)
+        out = eager_sdpa(q, k, v, causal=True)
+        tq = torch.tensor(np.asarray(q)).permute(0, 2, 1, 3)
+        tk = torch.tensor(np.asarray(k)).permute(0, 2, 1, 3)
+        tv = torch.tensor(np.asarray(v)).permute(0, 2, 1, 3)
+        expected = (
+            torch.nn.functional.scaled_dot_product_attention(
+                tq, tk, tv, is_causal=True, enable_gqa=True
+            )
+            .permute(0, 2, 1, 3)
+            .numpy()
+        )
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_sliding_window(self):
+        q = rng(1, 8, 1, 4)
+        k, v = rng(1, 8, 1, 4, seed=1), rng(1, 8, 1, 4, seed=2)
+        out_full = eager_sdpa(q, k, v, causal=True)
+        out_win = eager_sdpa(q, k, v, causal=True, window_size=3)
+        # early tokens (window not yet binding) identical, later differ
+        np.testing.assert_allclose(out_win[:, :3], out_full[:, :3], rtol=1e-5)
+        assert not np.allclose(out_win[:, 5:], out_full[:, 5:])
+
+    def test_sinks_reduce_attention_mass(self):
+        q = rng(1, 4, 2, 8)
+        k, v = rng(1, 4, 2, 8, seed=1), rng(1, 4, 2, 8, seed=2)
+        out_nosink = eager_sdpa(q, k, v, causal=True)
+        out_sink = eager_sdpa(q, k, v, causal=True, sinks=jnp.full((2,), 10.0))
+        # huge sink logit absorbs almost all probability mass
+        assert np.abs(np.asarray(out_sink)).max() < np.abs(np.asarray(out_nosink)).max()
+
+    def test_explicit_mask(self):
+        q = rng(1, 4, 1, 4)
+        k, v = rng(1, 4, 1, 4, seed=1), rng(1, 4, 1, 4, seed=2)
+        mask = jnp.ones((1, 1, 4, 4), dtype=bool).at[..., 0].set(False)
+        out = eager_sdpa(q, k, v, causal=True, mask=mask)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_cross_attention_alignment(self):
+        """T < S: last query aligns with last key (decode-style)."""
+        q = rng(1, 1, 1, 4)
+        k, v = rng(1, 6, 1, 4, seed=1), rng(1, 6, 1, 4, seed=2)
+        out = eager_sdpa(q, k, v, causal=True)
+        full_q = jnp.concatenate([rng(1, 5, 1, 4, seed=9), q], axis=1)
+        out_full = eager_sdpa(full_q, k, v, causal=True)
+        np.testing.assert_allclose(out[:, 0], out_full[:, -1], rtol=1e-5)
+
+
+class TestLinearCrossEntropy:
+    def _oracle(self, hidden, weight, labels):
+        logits = np.asarray(hidden, np.float64) @ np.asarray(weight, np.float64).T
+        lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + logits.max(-1)
+        correct = np.take_along_axis(logits, np.maximum(labels, 0)[:, None], -1)[:, 0]
+        loss = lse - correct
+        loss[np.asarray(labels) == LM_IGNORE_INDEX] = 0.0
+        return loss
+
+    def test_matches_oracle(self):
+        h, w = rng(10, 8), rng(32, 8, seed=1)
+        labels = jnp.array([0, 5, 31, LM_IGNORE_INDEX, 2, 7, 1, 0, 30, LM_IGNORE_INDEX])
+        out = linear_cross_entropy(h, w, labels)
+        np.testing.assert_allclose(out, self._oracle(h, w, np.asarray(labels)), rtol=1e-5)
+
+    def test_chunked_equals_unchunked(self):
+        h, w = rng(100, 8), rng(64, 8, seed=1)
+        labels = jnp.arange(100) % 64
+        full = linear_cross_entropy(h, w, labels, chunk_size=1024)
+        chunked = linear_cross_entropy(h, w, labels, chunk_size=16)
+        np.testing.assert_allclose(full, chunked, rtol=1e-5)
+
+    def test_grads_flow_and_match(self):
+        h, w = rng(48, 8), rng(16, 8, seed=1)
+        labels = jnp.arange(48) % 16
+
+        def mean_loss(chunk):
+            return lambda h, w: linear_cross_entropy(
+                h, w, labels, chunk_size=chunk
+            ).mean()
+
+        g_full = jax.grad(mean_loss(1024), argnums=(0, 1))(h, w)
+        g_chunk = jax.grad(mean_loss(8), argnums=(0, 1))(h, w)
+        for a, b in zip(g_full, g_chunk):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+    def test_softcap(self):
+        h, w = rng(4, 8), rng(16, 8, seed=1)
+        labels = jnp.array([0, 1, 2, 3])
+        out = linear_cross_entropy(h, w, labels, logit_softcap=5.0)
+        assert out.shape == (4,)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestPartialRope:
+    def test_gqa_partial_rope_runs_and_passes_through(self):
+        """rope_fraction=0.5: second half of head dims must be untouched by rotation."""
+        import flax.linen as nn
+
+        from d9d_tpu.nn.attention import GroupedQueryAttention
+
+        d = 16
+        module = GroupedQueryAttention(
+            hidden_size=32, num_heads=2, num_kv_heads=2, head_dim=d,
+            sdpa=eager_sdpa, rope_fraction=0.5, dtype=jnp.float32,
+        )
+        x = rng(1, 6, 32)
+        inv_freq, s = compute_rope_frequencies(d // 2, 10000.0)
+        cos, sin = make_rope_cos_sin(jnp.arange(6), inv_freq, s)
+        params = module.init(jax.random.PRNGKey(0), x, cos[None], sin[None])
+        out = module.apply(params, x, cos[None], sin[None])
+        assert out.shape == (1, 6, 32)
+        assert np.isfinite(np.asarray(out)).all()
