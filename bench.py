@@ -93,6 +93,7 @@ def main():
         trainer.params, trainer.opt_state, m = trainer.step_fn(
             trainer.params, trainer.opt_state, b, rng
         )
+        trainer.stepper.advance()
         return m
 
     # warmup (compile)

@@ -72,7 +72,6 @@ class Trainer:
             module=self.module,
             task=self.task,
             optimizer=self.optimizer,
-            ctx=ctx,
             num_microbatches=self.batch_maths.num_microbatches,
             max_grad_norm=config.max_grad_norm,
         )

@@ -29,7 +29,6 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from d9d_tpu.core.mesh import MeshContext
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
 
@@ -53,7 +52,6 @@ def build_train_step(
     module: nn.Module,
     task: TrainTask,
     optimizer: optax.GradientTransformation,
-    ctx: MeshContext,
     num_microbatches: int,
     max_grad_norm: float | None = 1.0,
     grad_dtype: jnp.dtype | None = jnp.float32,

@@ -73,6 +73,11 @@ class GroupedQueryAttention(nn.Module):
         # rotary semantics the *model* computes frequencies over the rotary
         # dim (not head_dim) and passes them here — this block only slices.
         rot = int(d * self.rope_fraction)
+        if rot % 2 != 0:
+            raise ValueError(
+                f"rotary dim must be even: head_dim={d} * "
+                f"rope_fraction={self.rope_fraction} gives {rot}"
+            )
         if rot:
             cos_r, sin_r = cos[..., : rot // 2], sin[..., : rot // 2]
             if rot < d:
@@ -108,10 +113,8 @@ class GroupedQueryAttention(nn.Module):
             mask=mask,
         )
 
+        out = attn.reshape(b, t, h * d)
         if self.use_output_gate:
             gate = proj(h * d, "gate_proj", (la.EMBED, la.HEADS))(x)
-            attn = attn.reshape(b, t, h * d) * nn.sigmoid(gate)
-            attn = attn.reshape(b, t, h, d)
-
-        out = attn.reshape(b, t, h * d)
+            out = out * nn.sigmoid(gate)
         return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)

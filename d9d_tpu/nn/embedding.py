@@ -14,7 +14,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from d9d_tpu.core.types import Array
-from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.nn.vocab_ranges import concat_vocab_ranges, make_vocab_range_params
 
 
 class TokenEmbedding(nn.Module):
@@ -31,16 +31,13 @@ class TokenEmbedding(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids: Array) -> Array:
-        tables = [
-            self.param(
-                f"embedding_{name}",
-                nn.with_logical_partitioning(
-                    nn.initializers.normal(stddev=1.0), (la.VOCAB, la.EMBED)
-                ),
-                (size, self.hidden_size),
-                self.param_dtype,
-            )
-            for name, size in self.vocab_ranges
-        ]
-        table = tables[0] if len(tables) == 1 else jnp.concatenate(tables, axis=0)
+        tables = make_vocab_range_params(
+            self.param,
+            "embedding",
+            self.vocab_ranges,
+            self.hidden_size,
+            self.param_dtype,
+            nn.initializers.normal(stddev=1.0),
+        )
+        table = concat_vocab_ranges(tables)
         return jnp.take(table, token_ids, axis=0).astype(self.dtype)

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.nn.vocab_ranges import concat_vocab_ranges, make_vocab_range_params
 from d9d_tpu.ops import LM_IGNORE_INDEX, linear_cross_entropy
 
 
@@ -30,21 +31,17 @@ class LanguageModellingHead(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     def setup(self) -> None:
-        self._tables = [
-            self.param(
-                f"head_{name}",
-                nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), (la.VOCAB, la.EMBED)
-                ),
-                (size, self.hidden_size),
-                self.param_dtype,
-            )
-            for name, size in self.vocab_ranges
-        ]
+        self._tables = make_vocab_range_params(
+            self.param,
+            "head",
+            self.vocab_ranges,
+            self.hidden_size,
+            self.param_dtype,
+            nn.initializers.lecun_normal(),
+        )
 
     def _weight(self) -> Array:
-        t = self._tables
-        return t[0] if len(t) == 1 else jnp.concatenate(t, axis=0)
+        return concat_vocab_ranges(self._tables)
 
     def __call__(self, hidden: Array, labels: Array) -> Array:
         """hidden [B,T,D], labels [B,T] → per-token loss [B,T] (fp32)."""

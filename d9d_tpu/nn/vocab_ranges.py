@@ -1,0 +1,43 @@
+"""Shared named-vocab-range parameter handling.
+
+Both the token embedding and the LM head store the vocabulary as an
+ordered dict of named ranges, each its own parameter (reference:
+module/block/embedding/shard_token_embedding.py:26 and
+module/block/head/language_modelling.py:14). This helper is the single
+owner of that layout so embedding and head checkpoint structures cannot
+diverge.
+"""
+
+from collections.abc import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn import logical_axes as la
+
+VocabRanges = tuple[tuple[str, int], ...]
+
+
+def make_vocab_range_params(
+    param_fn: Callable,
+    prefix: str,
+    vocab_ranges: VocabRanges,
+    hidden_size: int,
+    param_dtype: jnp.dtype,
+    initializer: nn.initializers.Initializer,
+) -> list[Array]:
+    """Create one [size, hidden] param per named range, logical (vocab, embed)."""
+    return [
+        param_fn(
+            f"{prefix}_{name}",
+            nn.with_logical_partitioning(initializer, (la.VOCAB, la.EMBED)),
+            (size, hidden_size),
+            param_dtype,
+        )
+        for name, size in vocab_ranges
+    ]
+
+
+def concat_vocab_ranges(tables: list[Array]) -> Array:
+    return tables[0] if len(tables) == 1 else jnp.concatenate(tables, axis=0)
