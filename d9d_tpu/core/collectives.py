@@ -1,0 +1,101 @@
+"""Host-level collective helpers for multi-process JAX.
+
+TPU-native counterpart of the reference's buffer-allocating collective
+wrappers (d9d/core/dist_ops/tensor.py:8-150, object.py:8-32). Inside jit,
+collectives are ``lax.psum``/``all_gather`` chosen by shardings; these
+helpers cover the *host-side* cases the reference used torch.distributed
+for directly: metric sync, object gather, variadic-shape gather.
+
+Single-process (tests, one host) degrades to identity/local ops with no
+device traffic.
+"""
+
+from enum import Enum
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d9d_tpu.core.types import PyTree
+
+
+class ReduceOp(str, Enum):
+    # 'avg' is deliberately absent: averaging is not associative across
+    # uneven shards (same reasoning as reference accumulator.py:10).
+    sum = "sum"
+    max = "max"
+    min = "min"
+
+
+_NP_REDUCE = {
+    ReduceOp.sum: np.sum,
+    ReduceOp.max: np.max,
+    ReduceOp.min: np.min,
+}
+
+
+def host_allreduce(
+    value: np.ndarray | jnp.ndarray, op: ReduceOp = ReduceOp.sum
+) -> np.ndarray:
+    """All-reduce a host array across JAX processes.
+
+    Every process must call this with the same-shaped array.
+    """
+    value = np.asarray(value)
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(value))
+    return _NP_REDUCE[op](gathered, axis=0)
+
+
+def host_allreduce_tree(tree: PyTree, op: ReduceOp = ReduceOp.sum) -> PyTree:
+    return jax.tree.map(lambda x: host_allreduce(x, op), tree)
+
+
+def host_allgather_object(obj: Any) -> list[Any]:
+    """Gather an arbitrary (pickleable) object from every process.
+
+    Parity: reference all_gather_object (d9d/core/dist_ops/object.py:32).
+    """
+    if jax.process_count() == 1:
+        return [obj]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # variadic-shape gather: exchange lengths, pad to max, gather, trim
+    length = np.asarray([payload.size], np.int64)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(length)
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros((max_len,), np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return [
+        pickle.loads(gathered[i, : int(lengths[i])].tobytes())
+        for i in range(gathered.shape[0])
+    ]
+
+
+def host_broadcast_object(obj: Any, root: int = 0) -> Any:
+    """Broadcast a pickleable object from ``root`` process to all."""
+    if jax.process_count() == 1:
+        return obj
+    return host_allgather_object(obj)[root]
+
+
+def host_gather_variadic(
+    arrays: Sequence[np.ndarray],
+) -> list[np.ndarray]:
+    """Placeholder-compatible variadic gather: defers to allgather_object.
+
+    Parity: reference gather_variadic_shape (dist_ops/tensor.py:113) which
+    pre-exchanges shapes then isend/irecvs. On TPU hosts the payload runs
+    over the DCN gRPC channel; shape exchange is folded into pickling.
+    """
+    return [a for objs in host_allgather_object(list(arrays)) for a in objs]

@@ -1,0 +1,218 @@
+"""Data-parallel dataset sharding.
+
+Parity: reference d9d/dataset/sharded.py:38 (ShardedDataset with
+sequential/chunked indexing and pad-to-equal-length) and
+shard_dataset_data_parallel. TPU-native note: under single-controller JAX,
+each *process* feeds its addressable slice of the global batch
+(``jax.make_array_from_process_local_data``), so the natural shard axis is
+the process, not the per-device dp rank; ``shard_dataset_data_parallel``
+derives (total, current) from ``jax.process_{count,index}``.
+"""
+
+import math
+import pickle
+from enum import Enum
+from typing import Any, Protocol, Sized, TypeVar
+
+import jax
+
+_T_co = TypeVar("_T_co", covariant=True)
+
+
+class Dataset(Protocol[_T_co]):
+    def __len__(self) -> int:
+        ...
+
+    def __getitem__(self, index: int) -> _T_co:
+        ...
+
+
+class ShardIndexingMode(str, Enum):
+    """sequential = round-robin across shards; chunked = contiguous blocks."""
+
+    sequential = "sequential"
+    chunked = "chunked"
+
+
+class ShardedDataset:
+    """A view onto one shard of an underlying dataset.
+
+    With ``pad_to_equal_size_across_shards`` every shard reports the ceiling
+    length and out-of-range reads clamp to the last element — required so
+    data-parallel groups never diverge in step count (reference rationale,
+    sharded.py:44).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset[_T_co],
+        total_shards: int,
+        current_shard: int,
+        indexing_mode: ShardIndexingMode = ShardIndexingMode.sequential,
+        pad_to_equal_size_across_shards: bool = True,
+    ):
+        if not isinstance(dataset, Sized):
+            raise ValueError("Dataset should implement __len__ method")
+        if not 0 <= current_shard < total_shards:
+            raise ValueError(
+                f"current_shard {current_shard} out of range for "
+                f"{total_shards} shards"
+            )
+        self._dataset = dataset
+        self._total_shards = total_shards
+        self._current_shard = current_shard
+        self._indexing_mode = indexing_mode
+        self._pad = pad_to_equal_size_across_shards
+
+    def _base_index_unsafe(self, index: int) -> int:
+        match self._indexing_mode:
+            case ShardIndexingMode.sequential:
+                return index * self._total_shards + self._current_shard
+            case ShardIndexingMode.chunked:
+                ceil_len = math.ceil(len(self._dataset) / self._total_shards)
+                return ceil_len * self._current_shard + index
+        raise ValueError(f"Unknown shard indexing mode: {self._indexing_mode}")
+
+    def __getitem__(self, index: int) -> _T_co:
+        if index < 0 or index >= len(self):
+            raise IndexError(index)
+        base_index = self._base_index_unsafe(index)
+        if base_index >= len(self._dataset):
+            base_index = len(self._dataset) - 1
+        return self._dataset[base_index]
+
+    def __len__(self) -> int:
+        n = len(self._dataset)
+        ceil_len = math.ceil(n / self._total_shards)
+        if self._pad:
+            return ceil_len
+        remainder = n % self._total_shards
+        match self._indexing_mode:
+            case ShardIndexingMode.sequential:
+                full = n // self._total_shards
+                return full + 1 if self._current_shard < remainder else full
+            case ShardIndexingMode.chunked:
+                # actual items in [ceil_len*shard, min(n, ceil_len*(shard+1)))
+                start = ceil_len * self._current_shard
+                return max(0, min(n - start, ceil_len))
+        raise ValueError(f"Unknown ShardIndexingMode: {self._indexing_mode}")
+
+    def state_dict(self) -> dict[str, Any]:
+        dct: dict[str, Any] = {
+            "total_shards": self._total_shards,
+            "current_shard": self._current_shard,
+        }
+        if hasattr(self._dataset, "state_dict"):
+            dct["dataset"] = self._dataset.state_dict()
+        return dct
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        if state_dict["total_shards"] != self._total_shards:
+            raise ValueError("Shard count mismatch")
+        self._current_shard = state_dict["current_shard"]
+        if hasattr(self._dataset, "load_state_dict"):
+            self._dataset.load_state_dict(state_dict["dataset"])
+
+
+def shard_dataset_data_parallel(
+    dataset: Dataset[_T_co],
+    indexing_mode: ShardIndexingMode = ShardIndexingMode.sequential,
+    pad_to_equal_size_across_shards: bool = True,
+) -> ShardedDataset:
+    """Shard over JAX processes (each feeds its local devices' batch slice)."""
+    return ShardedDataset(
+        dataset=dataset,
+        total_shards=jax.process_count(),
+        current_shard=jax.process_index(),
+        indexing_mode=indexing_mode,
+        pad_to_equal_size_across_shards=pad_to_equal_size_across_shards,
+    )
+
+
+class DatasetImplementingSortKeyProtocol(Protocol[_T_co]):
+    """Dataset that can expose a sort key (e.g. length) without loading items."""
+
+    def __len__(self) -> int:
+        ...
+
+    def sort_key(self, index: int) -> Any:
+        ...
+
+    def __getitem__(self, item: int) -> _T_co:
+        ...
+
+
+class BufferSortedDataset:
+    """Buffered length-sorting with pack-level + intra-pack shuffling.
+
+    Parity: reference d9d/dataset/buffer_sorted.py:38. Groups similar-length
+    items (minimizing padding) while keeping stochasticity: take a buffer of
+    ``buffer_size`` indices, sort by (sort_key, random tiebreak), cut into
+    ``pack_size`` packs, shuffle packs, shuffle within packs.
+    """
+
+    def __init__(
+        self,
+        base_dataset: DatasetImplementingSortKeyProtocol[_T_co],
+        buffer_size: int,
+        pack_size: int,
+        init_seed: int | None = None,
+    ):
+        import random
+
+        self._base_dataset = base_dataset
+        self._buffer_size = buffer_size
+        self._pack_size = pack_size
+        self._rng = random.Random(
+            init_seed ^ 0x105E7 if init_seed is not None else None
+        )
+        self._buffer_indices: list[int] = []
+        self._buffer_idx: int = -1
+
+    def _update_buffer_idx(self, buffer_idx: int) -> None:
+        select_start = buffer_idx * self._buffer_size
+        select_end = min(
+            (buffer_idx + 1) * self._buffer_size, len(self._base_dataset)
+        )
+        base_idx = list(range(select_start, select_end))
+        sort_keys = [
+            (self._base_dataset.sort_key(idx), self._rng.random())
+            for idx in base_idx
+        ]
+        local_idx = sorted(range(len(base_idx)), key=lambda i: sort_keys[i])
+        packs = [
+            local_idx[i : i + self._pack_size]
+            for i in range(0, len(local_idx), self._pack_size)
+        ]
+        self._rng.shuffle(packs)
+        for pack in packs:
+            self._rng.shuffle(pack)
+        flat = [y for pack in packs for y in pack]
+        self._buffer_indices = [base_idx[i] for i in flat]
+        self._buffer_idx = buffer_idx
+
+    def __getitem__(self, index: int) -> _T_co:
+        needs = index // self._buffer_size
+        if self._buffer_idx != needs:
+            self._update_buffer_idx(needs)
+        return self._base_dataset[self._buffer_indices[index % self._buffer_size]]
+
+    def __len__(self) -> int:
+        return len(self._base_dataset)
+
+    def state_dict(self) -> dict[str, Any]:
+        ret: dict[str, Any] = {
+            "seed": pickle.dumps(self._rng.getstate()),
+            "buffer_idx": self._buffer_idx,
+            "buffer_indices": self._buffer_indices,
+        }
+        if hasattr(self._base_dataset, "state_dict"):
+            ret["base_dataset"] = self._base_dataset.state_dict()
+        return ret
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._rng.setstate(pickle.loads(state_dict["seed"]))
+        self._buffer_idx = state_dict["buffer_idx"]
+        self._buffer_indices = state_dict["buffer_indices"]
+        if hasattr(self._base_dataset, "load_state_dict"):
+            self._base_dataset.load_state_dict(state_dict["base_dataset"])

@@ -1,0 +1,41 @@
+from d9d_tpu.lr_scheduler.builder import (
+    PiecewiseScheduleBuilder,
+    Schedule,
+    piecewise_schedule,
+)
+from d9d_tpu.lr_scheduler.config import (
+    AnyCurveConfig,
+    PhaseConfig,
+    PiecewiseSchedulerConfig,
+    curve_from_config,
+    piecewise_scheduler_from_config,
+)
+from d9d_tpu.lr_scheduler.curves import (
+    CurveBase,
+    CurveCosine,
+    CurveExponential,
+    CurveLinear,
+    CurvePoly,
+)
+from d9d_tpu.lr_scheduler.engine import PiecewiseScheduleEngine, SchedulePhase
+from d9d_tpu.lr_scheduler.visualizer import sample_schedule, visualize_schedule
+
+__all__ = [
+    "AnyCurveConfig",
+    "CurveBase",
+    "CurveCosine",
+    "CurveExponential",
+    "CurveLinear",
+    "CurvePoly",
+    "PhaseConfig",
+    "PiecewiseScheduleBuilder",
+    "PiecewiseScheduleEngine",
+    "PiecewiseSchedulerConfig",
+    "Schedule",
+    "SchedulePhase",
+    "curve_from_config",
+    "piecewise_schedule",
+    "piecewise_scheduler_from_config",
+    "sample_schedule",
+    "visualize_schedule",
+]

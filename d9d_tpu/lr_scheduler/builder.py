@@ -1,0 +1,89 @@
+"""Fluent builder for multiphase LR schedules.
+
+Parity: reference d9d/lr_scheduler/piecewise/builder.py
+(PiecewiseScheduleBuilder.for_steps/until_percentage/fill_rest). The
+reference's ``build`` wraps a torch optimizer in LambdaLR; here ``build``
+returns an optax schedule (multiplier) and ``build_lr`` a ready-to-use
+learning-rate schedule, pluggable into any optax optimizer.
+"""
+
+from typing import Callable
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.lr_scheduler.curves import CurveBase
+from d9d_tpu.lr_scheduler.engine import PiecewiseScheduleEngine, SchedulePhase
+
+Schedule = Callable[[int | Array], Array]
+
+
+class PiecewiseScheduleBuilder:
+    def __init__(self, initial_multiplier: float, total_steps: int | None):
+        self._phases: list[SchedulePhase] = []
+        self._total_steps = total_steps
+        self._last_end_step = 0
+        self._last_multiplier = initial_multiplier
+
+    def for_steps(
+        self, steps: int, target_multiplier: float, curve: CurveBase
+    ) -> "PiecewiseScheduleBuilder":
+        """Add a phase lasting ``steps`` steps ending at ``target_multiplier``."""
+        self._phases.append(
+            SchedulePhase(
+                start_step=self._last_end_step,
+                end_step=self._last_end_step + steps,
+                start_value=self._last_multiplier,
+                end_value=target_multiplier,
+                curve=curve,
+            )
+        )
+        self._last_end_step += steps
+        self._last_multiplier = target_multiplier
+        return self
+
+    def until_percentage(
+        self, p: float, target_multiplier: float, curve: CurveBase
+    ) -> "PiecewiseScheduleBuilder":
+        """Add a phase ending at fraction ``p`` of total_steps."""
+        if self._total_steps is None:
+            raise ValueError(
+                "total_steps is required for percentage-based phases"
+            )
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("Percentage should be in range of [0.0, 1.0]")
+        target_step_abs = int(self._total_steps * p)
+        duration = target_step_abs - self._last_end_step
+        if duration < 0:
+            raise ValueError(
+                f"Target percentage {p} (step {target_step_abs}) is behind "
+                f"current cursor (step {self._last_end_step})."
+            )
+        return self.for_steps(duration, target_multiplier, curve)
+
+    def fill_rest(
+        self, target_multiplier: float, curve: CurveBase
+    ) -> "PiecewiseScheduleBuilder":
+        """Add a phase from the cursor to the end of training."""
+        return self.until_percentage(1.0, target_multiplier, curve)
+
+    def build(self) -> Schedule:
+        """Finalize into a ``step -> multiplier`` schedule."""
+        if self._total_steps is not None and self._last_end_step > self._total_steps:
+            raise ValueError(
+                f"Schedule defined for {self._last_end_step} steps, but "
+                f"total_steps is {self._total_steps}."
+            )
+        return PiecewiseScheduleEngine(self._phases)
+
+    def build_lr(self, base_lr: float) -> Schedule:
+        """Finalize into a ``step -> learning_rate`` schedule."""
+        engine = self.build()
+        return lambda step: base_lr * engine(step)
+
+
+def piecewise_schedule(
+    initial_multiplier: float, total_steps: int | None = None
+) -> PiecewiseScheduleBuilder:
+    """Entry point for building a piecewise LR schedule."""
+    return PiecewiseScheduleBuilder(
+        initial_multiplier=initial_multiplier, total_steps=total_steps
+    )

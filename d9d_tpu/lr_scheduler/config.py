@@ -1,0 +1,110 @@
+"""Declarative (pydantic) configuration for piecewise LR schedules.
+
+Parity: reference d9d/lr_scheduler/piecewise/config.py — the same
+discriminated unions: curves {linear, cosine, exponential, poly(power)} and
+phases {steps, percentage, rest}.
+"""
+
+from typing import Annotated, Literal, Union
+
+from pydantic import BaseModel, Field, PositiveInt
+
+from d9d_tpu.lr_scheduler.builder import Schedule, piecewise_schedule
+from d9d_tpu.lr_scheduler.curves import (
+    CurveBase,
+    CurveCosine,
+    CurveExponential,
+    CurveLinear,
+    CurvePoly,
+)
+
+
+class CurveLinearConfig(BaseModel):
+    type: Literal["linear"] = "linear"
+
+
+class CurveCosineConfig(BaseModel):
+    type: Literal["cosine"] = "cosine"
+
+
+class CurveExponentialConfig(BaseModel):
+    type: Literal["exponential"] = "exponential"
+
+
+class CurvePolyConfig(BaseModel):
+    type: Literal["poly"] = "poly"
+    power: float = 2.0
+
+
+AnyCurveConfig = Annotated[
+    Union[
+        CurveLinearConfig,
+        CurveCosineConfig,
+        CurveExponentialConfig,
+        CurvePolyConfig,
+    ],
+    Field(discriminator="type"),
+]
+
+
+def curve_from_config(config: AnyCurveConfig) -> CurveBase:
+    match config:
+        case CurveLinearConfig():
+            return CurveLinear()
+        case CurvePolyConfig():
+            return CurvePoly(config.power)
+        case CurveExponentialConfig():
+            return CurveExponential()
+        case CurveCosineConfig():
+            return CurveCosine()
+    raise TypeError(f"unknown curve config: {config!r}")
+
+
+class StepPhaseConfig(BaseModel):
+    mode: Literal["steps"] = "steps"
+    steps: PositiveInt
+    target_multiplier: float
+    curve: AnyCurveConfig
+
+
+class PercentagePhaseConfig(BaseModel):
+    mode: Literal["percentage"] = "percentage"
+    percentage: float = Field(..., ge=0.0, le=1.0)
+    target_multiplier: float
+    curve: AnyCurveConfig
+
+
+class RestPhaseConfig(BaseModel):
+    mode: Literal["rest"] = "rest"
+    target_multiplier: float
+    curve: AnyCurveConfig
+
+
+PhaseConfig = Annotated[
+    Union[StepPhaseConfig, PercentagePhaseConfig, RestPhaseConfig],
+    Field(discriminator="mode"),
+]
+
+
+class PiecewiseSchedulerConfig(BaseModel):
+    initial_multiplier: float
+    phases: list[PhaseConfig]
+
+
+def piecewise_scheduler_from_config(
+    config: PiecewiseSchedulerConfig, total_steps: int | None
+) -> Schedule:
+    """Build a ``step -> multiplier`` schedule from config."""
+    builder = piecewise_schedule(config.initial_multiplier, total_steps)
+    for phase in config.phases:
+        curve = curve_from_config(phase.curve)
+        match phase:
+            case StepPhaseConfig():
+                builder.for_steps(phase.steps, phase.target_multiplier, curve)
+            case PercentagePhaseConfig():
+                builder.until_percentage(
+                    phase.percentage, phase.target_multiplier, curve
+                )
+            case RestPhaseConfig():
+                builder.fill_rest(phase.target_multiplier, curve)
+    return builder.build()

@@ -1,0 +1,58 @@
+"""Interpolation curves for piecewise LR schedules.
+
+Parity: reference d9d/lr_scheduler/piecewise/curves.py (CurveBase and the
+linear/cosine/poly/exponential family). TPU-native difference: ``compute``
+uses jnp ops on traced scalars so a whole schedule stays inside the jitted
+train step (the reference computes factors in Python per step on the host).
+"""
+
+import abc
+import dataclasses
+
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+
+class CurveBase(abc.ABC):
+    """Interpolates between phase start/end values.
+
+    ``step_p`` is the progress fraction through the phase in [0, 1].
+    """
+
+    @abc.abstractmethod
+    def compute(self, start: float, end: float, step_p: Array) -> Array:
+        ...
+
+
+class CurveLinear(CurveBase):
+    def compute(self, start: float, end: float, step_p: Array) -> Array:
+        return start + (end - start) * step_p
+
+
+class CurveCosine(CurveBase):
+    """Half-period cosine annealing from start to end."""
+
+    def compute(self, start: float, end: float, step_p: Array) -> Array:
+        cos_out = (1.0 + jnp.cos(jnp.pi * step_p)) / 2.0
+        return end + (start - end) * cos_out
+
+
+@dataclasses.dataclass(frozen=True)
+class CurvePoly(CurveBase):
+    """Polynomial interpolation; power=1 is linear, 2 quadratic, etc."""
+
+    power: float = 2.0
+
+    def compute(self, start: float, end: float, step_p: Array) -> Array:
+        return start + (end - start) * step_p**self.power
+
+
+class CurveExponential(CurveBase):
+    """Log-space linear interpolation (values clamped away from zero)."""
+
+    def compute(self, start: float, end: float, step_p: Array) -> Array:
+        eps = 1e-8
+        ls = jnp.log(jnp.maximum(start, eps))
+        le = jnp.log(jnp.maximum(end, eps))
+        return jnp.exp(ls + (le - ls) * step_p)

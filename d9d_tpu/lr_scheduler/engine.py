@@ -1,0 +1,63 @@
+"""Piecewise schedule engine.
+
+Parity: reference d9d/lr_scheduler/piecewise/engine.py (SchedulePhase +
+PiecewiseScheduleEngine.get_factor). The reference walks the phase list in
+Python per step; here the engine is an optax-style schedule: a callable
+``step -> factor`` built from vectorized phase selection, safe to call with
+a traced ``step`` inside jit (and equally fine with a plain int on host).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.lr_scheduler.curves import CurveBase
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulePhase:
+    """One phase: interpolate from start_value to end_value over
+    [start_step, end_step) using ``curve``."""
+
+    start_step: int
+    end_step: int
+    start_value: float
+    end_value: float
+    curve: CurveBase
+
+
+class PiecewiseScheduleEngine:
+    """Callable mapping a (possibly traced) step to a multiplier.
+
+    Out-of-range steps clamp to the nearest boundary value, matching the
+    reference engine.
+    """
+
+    def __init__(self, phases: list[SchedulePhase]):
+        if len(phases) == 0:
+            raise ValueError("Scheduler should contain at least one phase")
+        self._phases = list(phases)
+
+    def get_factor(self, step: int | Array) -> Array:
+        step = jnp.asarray(step, jnp.float32)
+        # Start from the final clamp value; overwrite from last phase to
+        # first so earlier phases win where ranges touch.
+        out = jnp.asarray(self._phases[-1].end_value, jnp.float32)
+        for phase in reversed(self._phases):
+            phase_len = max(phase.end_step - phase.start_step, 1)
+            progress = (step - phase.start_step) / phase_len
+            value = phase.curve.compute(
+                phase.start_value, phase.end_value, jnp.clip(progress, 0.0, 1.0)
+            )
+            inside = (step >= phase.start_step) & (step < phase.end_step)
+            out = jnp.where(inside, value, out)
+        out = jnp.where(
+            step < self._phases[0].start_step,
+            self._phases[0].start_value,
+            out,
+        )
+        return out
+
+    def __call__(self, step: int | Array) -> Array:
+        return self.get_factor(step)

@@ -1,0 +1,32 @@
+"""Schedule sampling/visualization helpers.
+
+Parity: reference d9d/lr_scheduler/visualizer.py (plotly figure of the
+multiplier over training). Sampling is dependency-free; the plotly render is
+optional and gated on import availability.
+"""
+
+import numpy as np
+
+from d9d_tpu.lr_scheduler.builder import Schedule
+
+
+def sample_schedule(schedule: Schedule, total_steps: int) -> np.ndarray:
+    """Evaluate the schedule at every step; returns [total_steps] factors."""
+    return np.asarray(schedule(np.arange(total_steps)), dtype=np.float64)
+
+
+def visualize_schedule(schedule: Schedule, total_steps: int):
+    """Render the schedule as a plotly line figure (requires plotly)."""
+    try:
+        import plotly.graph_objects as go
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "plotly is required for visualize_schedule; use sample_schedule "
+            "for a dependency-free dump"
+        ) from e
+    ys = sample_schedule(schedule, total_steps)
+    fig = go.Figure(go.Scatter(x=list(range(total_steps)), y=ys.tolist()))
+    fig.update_layout(
+        title="LR schedule", xaxis_title="step", yaxis_title="multiplier"
+    )
+    return fig

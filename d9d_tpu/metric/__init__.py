@@ -1,0 +1,26 @@
+from d9d_tpu.metric.abc import Metric
+from d9d_tpu.metric.accumulator import MetricAccumulator
+from d9d_tpu.metric.aggregation import SumMetric, WeightedMeanMetric
+from d9d_tpu.metric.auroc import BinaryAUROCMetric
+from d9d_tpu.metric.classification import (
+    AggregationMethod,
+    ConfusionMatrix,
+    ConfusionMatrixAccumulator,
+    ConfusionMatrixMetric,
+    ConfusionMatrixMetricBuilder,
+)
+from d9d_tpu.metric.container import ComposeMetric
+
+__all__ = [
+    "AggregationMethod",
+    "BinaryAUROCMetric",
+    "ComposeMetric",
+    "ConfusionMatrix",
+    "ConfusionMatrixAccumulator",
+    "ConfusionMatrixMetric",
+    "ConfusionMatrixMetricBuilder",
+    "Metric",
+    "MetricAccumulator",
+    "SumMetric",
+    "WeightedMeanMetric",
+]

@@ -1,0 +1,39 @@
+"""Metric abstraction.
+
+Parity: reference d9d/metric/abc.py:13 (Metric with
+update/sync/compute/reset + Stateful persistence). Differences for TPU:
+state lives in host numpy (metrics are host-side bookkeeping; the hot path
+returns raw statistics from the jitted step), and ``sync`` reduces across
+JAX *processes* — device-level reduction already happened inside jit.
+"""
+
+import abc
+from typing import Any, Generic, TypeVar
+
+TComputeResult = TypeVar("TComputeResult")
+
+
+class Metric(abc.ABC, Generic[TComputeResult]):
+    @abc.abstractmethod
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate a new batch of statistics into local state."""
+
+    @abc.abstractmethod
+    def sync(self) -> None:
+        """All-reduce local state across processes into synchronized state."""
+
+    @abc.abstractmethod
+    def compute(self) -> TComputeResult:
+        """Compute the metric from (synchronized, else local) state."""
+
+    @abc.abstractmethod
+    def reset(self) -> None:
+        """Reset local state to initial values."""
+
+    @abc.abstractmethod
+    def state_dict(self) -> dict[str, Any]:
+        ...
+
+    @abc.abstractmethod
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        ...

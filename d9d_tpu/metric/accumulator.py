@@ -1,0 +1,67 @@
+"""Dual-state metric accumulator.
+
+Parity: reference d9d/metric/component/accumulator.py:42 (MetricAccumulator
+with a 'local' copy updated per step and a 'synchronized' copy populated by
+all-reduce; 'avg' deliberately unsupported). State is host numpy; sync uses
+the process-level collectives in d9d_tpu/core/collectives.py.
+"""
+
+from typing import Any
+
+import numpy as np
+
+from d9d_tpu.core.collectives import ReduceOp, host_allreduce
+
+
+def _accumulate(op: ReduceOp, acc: np.ndarray, value) -> np.ndarray:
+    value = np.asarray(value, dtype=acc.dtype)
+    match op:
+        case ReduceOp.sum:
+            return acc + value
+        case ReduceOp.max:
+            return np.maximum(acc, value)
+        case ReduceOp.min:
+            return np.minimum(acc, value)
+    raise ValueError(f"Unknown reduce op {op}")
+
+
+class MetricAccumulator:
+    def __init__(
+        self,
+        initial_value: np.ndarray | float,
+        reduce_op: ReduceOp = ReduceOp.sum,
+    ):
+        self._initial = np.array(initial_value, copy=True)
+        self._local = self._initial.copy()
+        self._synchronized = self._initial.copy()
+        self._reduce_op = reduce_op
+        self._is_synchronized = False
+
+    def update(self, value) -> None:
+        self._local = _accumulate(self._reduce_op, self._local, value)
+        self._is_synchronized = False
+
+    def sync(self) -> None:
+        self._synchronized = host_allreduce(self._local, self._reduce_op)
+        self._is_synchronized = True
+
+    @property
+    def value(self) -> np.ndarray:
+        """Synchronized value if sync() ran since the last update, else local."""
+        return self._synchronized if self._is_synchronized else self._local
+
+    def reset(self) -> None:
+        self._local = self._initial.copy()
+        self._is_synchronized = False
+
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "local": self._local,
+            "synchronized": self._synchronized,
+            "is_synchronized": self._is_synchronized,
+        }
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._local = np.asarray(state_dict["local"])
+        self._synchronized = np.asarray(state_dict["synchronized"])
+        self._is_synchronized = bool(state_dict["is_synchronized"])

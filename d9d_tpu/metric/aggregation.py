@@ -1,0 +1,73 @@
+"""Aggregation metrics: weighted mean and sum.
+
+Parity: reference d9d/metric/impl/aggregation/{mean,sum}.py:10.
+"""
+
+from typing import Any
+
+import numpy as np
+
+from d9d_tpu.metric.abc import Metric
+from d9d_tpu.metric.accumulator import MetricAccumulator
+
+
+class WeightedMeanMetric(Metric[np.ndarray]):
+    """Tracks Σ(value·weight) and Σweight; computes their ratio."""
+
+    def __init__(self):
+        self._value = MetricAccumulator(np.float32(0))
+        self._weight = MetricAccumulator(np.float32(0))
+
+    def update(self, values, weights) -> None:
+        values = np.asarray(values, np.float32)
+        weights = np.asarray(weights, np.float32)
+        self._value.update((values * weights).sum())
+        self._weight.update(weights.sum())
+
+    def sync(self) -> None:
+        self._value.sync()
+        self._weight.sync()
+
+    def compute(self) -> np.ndarray:
+        return self._value.value / self._weight.value
+
+    def reset(self) -> None:
+        self._value.reset()
+        self._weight.reset()
+
+    @property
+    def accumulated_weight(self) -> np.ndarray:
+        return self._weight.value
+
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "value": self._value.state_dict(),
+            "weight": self._weight.state_dict(),
+        }
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._value.load_state_dict(state_dict["value"])
+        self._weight.load_state_dict(state_dict["weight"])
+
+
+class SumMetric(Metric[np.ndarray]):
+    def __init__(self):
+        self._accumulator = MetricAccumulator(np.float32(0))
+
+    def update(self, value) -> None:
+        self._accumulator.update(np.asarray(value, np.float32).sum())
+
+    def sync(self) -> None:
+        self._accumulator.sync()
+
+    def compute(self) -> np.ndarray:
+        return self._accumulator.value
+
+    def reset(self) -> None:
+        self._accumulator.reset()
+
+    def state_dict(self) -> dict[str, Any]:
+        return {"accumulator": self._accumulator.state_dict()}
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._accumulator.load_state_dict(state_dict["accumulator"])

@@ -1,0 +1,77 @@
+"""Histogram-sketch binary AUROC.
+
+Parity: reference d9d/metric/impl/classification/auroc.py:48
+(BinaryAUROCMetric): probabilities are bucketed into fixed histograms for
+positives and negatives; AUROC ≈ P(X>Y) + 0.5·P(X=Y) via the trapezoidal
+rule over the histograms — O(bins) memory instead of storing predictions.
+"""
+
+from typing import Any
+
+import numpy as np
+
+from d9d_tpu.metric.abc import Metric
+from d9d_tpu.metric.accumulator import MetricAccumulator
+
+
+def _compute_histogram_auroc(
+    pos_hist: np.ndarray, neg_hist: np.ndarray
+) -> np.ndarray:
+    total_pos = pos_hist.sum()
+    total_neg = neg_hist.sum()
+    if total_pos <= 0 or total_neg <= 0:
+        return np.float32(0.5)
+    cum_pos = np.cumsum(pos_hist)
+    acc_pos = total_pos - cum_pos
+    area = ((0.5 * neg_hist * pos_hist) + (neg_hist * acc_pos)).sum()
+    return np.float32(area / (total_pos * total_neg))
+
+
+class BinaryAUROCMetric(Metric[np.ndarray]):
+    def __init__(self, num_bins: int = 10000):
+        self._num_bins = num_bins
+        zeros = np.zeros((num_bins,), np.float32)
+        self._pos_hist = MetricAccumulator(zeros)
+        self._neg_hist = MetricAccumulator(zeros)
+
+    def update(self, probs, labels) -> None:
+        probs = np.asarray(probs).reshape(-1)
+        labels = np.asarray(labels).reshape(-1).astype(np.float32)
+        if probs.size != labels.size:
+            raise ValueError(
+                "Predictions and labels should have the same number of elements"
+            )
+        bins = np.clip(
+            (probs * self._num_bins).astype(np.int64), 0, self._num_bins - 1
+        )
+        pos_batch = np.bincount(
+            bins, weights=labels, minlength=self._num_bins
+        ).astype(np.float32)
+        neg_batch = np.bincount(
+            bins, weights=1.0 - labels, minlength=self._num_bins
+        ).astype(np.float32)
+        self._pos_hist.update(pos_batch)
+        self._neg_hist.update(neg_batch)
+
+    def sync(self) -> None:
+        self._pos_hist.sync()
+        self._neg_hist.sync()
+
+    def compute(self) -> np.ndarray:
+        return _compute_histogram_auroc(
+            self._pos_hist.value, self._neg_hist.value
+        )
+
+    def reset(self) -> None:
+        self._pos_hist.reset()
+        self._neg_hist.reset()
+
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "pos": self._pos_hist.state_dict(),
+            "neg": self._neg_hist.state_dict(),
+        }
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._pos_hist.load_state_dict(state_dict["pos"])
+        self._neg_hist.load_state_dict(state_dict["neg"])

@@ -1,0 +1,367 @@
+"""Confusion-matrix classification metrics.
+
+Parity: reference d9d/metric/impl/classification/confusion_matrix.py:23,105
+plus its component stack (d9d/metric/component/classification/*): prediction
+processors (threshold / one-hot argmax / top-k), per-class TP/FP/TN/FN
+accumulation, statistics (accuracy/precision/recall/F-beta) and
+micro/macro/weighted/none aggregation, composed by a fluent builder.
+"""
+
+import dataclasses
+from enum import Enum
+from typing import Any, Callable, Protocol
+
+import numpy as np
+
+from d9d_tpu.metric.abc import Metric
+from d9d_tpu.metric.accumulator import MetricAccumulator
+
+
+# --- processors -----------------------------------------------------------
+
+
+class ClassificationPredictionsProcessor(Protocol):
+    def __call__(
+        self, preds: np.ndarray, targets: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        ...
+
+
+class ThresholdProcessor:
+    """Binarize probabilistic predictions at a threshold (binary/multilabel)."""
+
+    def __init__(self, threshold: float):
+        self._threshold = threshold
+
+    def __call__(self, preds, targets):
+        preds = np.asarray(preds)
+        targets = np.asarray(targets)
+        if preds.ndim == 1:
+            preds = preds[:, None]
+        if targets.ndim == 1:
+            targets = targets[:, None]
+        return (preds > self._threshold).astype(np.float32), targets.astype(
+            np.float32
+        )
+
+
+class OneHotProcessor:
+    """Argmax predictions and one-hot both sides (multiclass)."""
+
+    def __init__(self, num_classes: int):
+        self._num_classes = num_classes
+
+    def __call__(self, preds, targets):
+        preds = np.asarray(preds)
+        targets = np.asarray(targets)
+        if preds.shape[-1] != self._num_classes:
+            raise ValueError(
+                f"Expected last dim of preds to equal num_classes="
+                f"{self._num_classes}, got {preds.shape[-1]}"
+            )
+        eye = np.eye(self._num_classes, dtype=np.int64)
+        preds_one_hot = eye[np.argmax(preds, axis=-1)]
+        if targets.shape == preds.shape:
+            targets_one_hot = targets.astype(np.int64)
+        elif targets.shape == preds.shape[:-1]:
+            targets_one_hot = eye[targets.astype(np.int64)]
+        elif targets.shape == (*preds.shape[:-1], 1):
+            targets_one_hot = eye[targets[..., 0].astype(np.int64)]
+        else:
+            raise ValueError(
+                f"Targets shape {targets.shape} is incompatible with "
+                f"predictions shape {preds.shape}"
+            )
+        return preds_one_hot, targets_one_hot
+
+
+class TopKProcessor:
+    """Hit/miss of target within top-k predictions (multiclass top-k)."""
+
+    def __init__(self, k: int):
+        self._k = k
+
+    def __call__(self, preds, targets):
+        preds = np.asarray(preds)
+        targets = np.asarray(targets)
+        topk_idx = np.argpartition(-preds, self._k - 1, axis=-1)[
+            ..., : self._k
+        ]
+        is_hit = (topk_idx == targets[..., None]).any(
+            axis=-1, keepdims=True
+        ).astype(np.int64)
+        return is_hit, np.ones_like(is_hit)
+
+
+# --- confusion matrix state ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfusionMatrix:
+    """Per-class counts, each of shape [C]."""
+
+    tp: np.ndarray
+    fp: np.ndarray
+    tn: np.ndarray
+    fn: np.ndarray
+
+
+class ConfusionMatrixAccumulator:
+    def __init__(self, num_outputs: int):
+        self._num_outputs = num_outputs
+        zeros = np.zeros(num_outputs, dtype=np.int64)
+        self._tp = MetricAccumulator(zeros)
+        self._fp = MetricAccumulator(zeros)
+        self._tn = MetricAccumulator(zeros)
+        self._fn = MetricAccumulator(zeros)
+
+    @property
+    def state(self) -> ConfusionMatrix:
+        return ConfusionMatrix(
+            tp=self._tp.value,
+            fp=self._fp.value,
+            tn=self._tn.value,
+            fn=self._fn.value,
+        )
+
+    def update(self, preds: np.ndarray, targets: np.ndarray) -> None:
+        preds = np.asarray(preds).reshape(-1, self._num_outputs)
+        targets = np.asarray(targets).reshape(-1, self._num_outputs)
+        p = preds.astype(bool)
+        t = targets.astype(bool)
+        self._tp.update((p & t).sum(axis=0))
+        self._fp.update((p & ~t).sum(axis=0))
+        self._tn.update((~p & ~t).sum(axis=0))
+        self._fn.update((~p & t).sum(axis=0))
+
+    def sync(self) -> None:
+        for acc in (self._tp, self._fp, self._tn, self._fn):
+            acc.sync()
+
+    def reset(self) -> None:
+        for acc in (self._tp, self._fp, self._tn, self._fn):
+            acc.reset()
+
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "tp": self._tp.state_dict(),
+            "fp": self._fp.state_dict(),
+            "tn": self._tn.state_dict(),
+            "fn": self._fn.state_dict(),
+        }
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._tp.load_state_dict(state_dict["tp"])
+        self._fp.load_state_dict(state_dict["fp"])
+        self._tn.load_state_dict(state_dict["tn"])
+        self._fn.load_state_dict(state_dict["fn"])
+
+
+# --- statistics + aggregation --------------------------------------------
+
+ConfusionMatrixStatistic = Callable[[ConfusionMatrix], np.ndarray]
+
+
+def accuracy_statistic(m: ConfusionMatrix) -> np.ndarray:
+    return (m.tp + m.tn) / (m.tp + m.tn + m.fp + m.fn)
+
+
+def precision_statistic(m: ConfusionMatrix) -> np.ndarray:
+    return m.tp / (m.tp + m.fp)
+
+
+def recall_statistic(m: ConfusionMatrix) -> np.ndarray:
+    return m.tp / (m.tp + m.fn)
+
+
+def fbeta_statistic(beta: float) -> ConfusionMatrixStatistic:
+    beta_sq = beta**2
+
+    def stat(m: ConfusionMatrix) -> np.ndarray:
+        num = (1 + beta_sq) * m.tp
+        den = (1 + beta_sq) * m.tp + beta_sq * m.fn + m.fp
+        return num / den
+
+    return stat
+
+
+class AggregationMethod(str, Enum):
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+
+
+def aggregate(
+    method: AggregationMethod,
+    statistic: ConfusionMatrixStatistic,
+    matrix: ConfusionMatrix,
+) -> np.ndarray:
+    with np.errstate(divide="ignore", invalid="ignore"):
+        match method:
+            case AggregationMethod.MICRO:
+                return statistic(
+                    ConfusionMatrix(
+                        tp=matrix.tp.sum(),
+                        fp=matrix.fp.sum(),
+                        tn=matrix.tn.sum(),
+                        fn=matrix.fn.sum(),
+                    )
+                )
+            case AggregationMethod.MACRO:
+                return statistic(matrix).mean()
+            case AggregationMethod.WEIGHTED:
+                scores = statistic(matrix)
+                supports = matrix.tp + matrix.fn
+                return (scores * supports).sum() / supports.sum()
+            case AggregationMethod.NONE:
+                return statistic(matrix)
+    raise ValueError(f"Unknown aggregation method: {method}")
+
+
+# --- the metric + builder -------------------------------------------------
+
+
+class ConfusionMatrixMetric(Metric[np.ndarray]):
+    def __init__(
+        self,
+        processor: ClassificationPredictionsProcessor,
+        accumulator: ConfusionMatrixAccumulator,
+        method: AggregationMethod,
+        statistic: ConfusionMatrixStatistic,
+    ):
+        self._processor = processor
+        self._accumulator = accumulator
+        self._method = method
+        self._statistic = statistic
+
+    def update(self, preds, targets) -> None:
+        p, t = self._processor(preds, targets)
+        self._accumulator.update(p, t)
+
+    def sync(self) -> None:
+        self._accumulator.sync()
+
+    def compute(self) -> np.ndarray:
+        return aggregate(self._method, self._statistic, self._accumulator.state)
+
+    def reset(self) -> None:
+        self._accumulator.reset()
+
+    def state_dict(self) -> dict[str, Any]:
+        return self._accumulator.state_dict()
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        self._accumulator.load_state_dict(state_dict)
+
+
+class ConfusionMatrixMetricBuilder:
+    """Fluent pipeline: problem type → statistic → aggregation → build().
+
+    Parity: reference ConfusionMatrixMetricBuilder
+    (impl/classification/confusion_matrix.py:105).
+    """
+
+    def __init__(self):
+        self._num_outputs: int | None = None
+        self._processor: ClassificationPredictionsProcessor | None = None
+        self._statistic: ConfusionMatrixStatistic | None = None
+        self._method: AggregationMethod | None = None
+
+    def _ensure_no_problem(self):
+        if self._processor is not None:
+            raise ValueError("A problem type has already been configured.")
+
+    def _ensure_no_statistic(self):
+        if self._statistic is not None:
+            raise ValueError("A target statistic has already been configured.")
+
+    def _ensure_no_aggregation(self):
+        if self._method is not None:
+            raise ValueError("An aggregation methodology has already been selected.")
+
+    def binary(self, threshold: float = 0.5) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_problem()
+        self._processor = ThresholdProcessor(threshold)
+        self._num_outputs = 1
+        self._method = AggregationMethod.MICRO
+        return self
+
+    def multiclass(
+        self, num_classes: int, top_k: int | None = None
+    ) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_problem()
+        if top_k is not None:
+            self._processor = TopKProcessor(top_k)
+            self._num_outputs = 1
+            self._method = AggregationMethod.MICRO
+        else:
+            self._processor = OneHotProcessor(num_classes)
+            self._num_outputs = num_classes
+        return self
+
+    def multilabel(
+        self, num_classes: int, threshold: float = 0.5
+    ) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_problem()
+        self._processor = ThresholdProcessor(threshold)
+        self._num_outputs = num_classes
+        return self
+
+    def with_accuracy(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_statistic()
+        self._statistic = accuracy_statistic
+        return self
+
+    def with_precision(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_statistic()
+        self._statistic = precision_statistic
+        return self
+
+    def with_recall(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_statistic()
+        self._statistic = recall_statistic
+        return self
+
+    def with_f1(self) -> "ConfusionMatrixMetricBuilder":
+        return self.with_fbeta(1.0)
+
+    def with_fbeta(self, beta: float) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_statistic()
+        self._statistic = fbeta_statistic(beta)
+        return self
+
+    def micro(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_aggregation()
+        self._method = AggregationMethod.MICRO
+        return self
+
+    def macro(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_aggregation()
+        self._method = AggregationMethod.MACRO
+        return self
+
+    def weighted(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_aggregation()
+        self._method = AggregationMethod.WEIGHTED
+        return self
+
+    def per_class(self) -> "ConfusionMatrixMetricBuilder":
+        self._ensure_no_aggregation()
+        self._method = AggregationMethod.NONE
+        return self
+
+    def build(self) -> ConfusionMatrixMetric:
+        if self._processor is None or self._num_outputs is None:
+            raise ValueError(
+                "Problem type not configured (binary/multiclass/multilabel)."
+            )
+        if self._statistic is None:
+            raise ValueError("Statistic not configured.")
+        method = self._method or AggregationMethod.MACRO
+        return ConfusionMatrixMetric(
+            processor=self._processor,
+            accumulator=ConfusionMatrixAccumulator(self._num_outputs),
+            method=method,
+            statistic=self._statistic,
+        )

@@ -1,0 +1,45 @@
+"""Metric container.
+
+Parity: reference d9d/metric/impl/container/compose.py:10 (ComposeMetric —
+updates go to named children; sync/compute/reset fan out).
+"""
+
+from collections.abc import Mapping
+from typing import Any
+
+from d9d_tpu.metric.abc import Metric
+
+
+class ComposeMetric(Metric[dict[str, Any]]):
+    def __init__(self, children: Mapping[str, Metric]):
+        self._children = dict(children)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise ValueError(
+            "Cannot update ComposeMetric directly - update its children"
+        )
+
+    def __getitem__(self, item: str) -> Metric:
+        return self._children[item]
+
+    @property
+    def children(self) -> Mapping[str, Metric]:
+        return self._children
+
+    def sync(self) -> None:
+        for metric in self._children.values():
+            metric.sync()
+
+    def compute(self) -> dict[str, Any]:
+        return {name: m.compute() for name, m in self._children.items()}
+
+    def reset(self) -> None:
+        for metric in self._children.values():
+            metric.reset()
+
+    def state_dict(self) -> dict[str, Any]:
+        return {name: m.state_dict() for name, m in self._children.items()}
+
+    def load_state_dict(self, state_dict: dict[str, Any]) -> None:
+        for name, metric in self._children.items():
+            metric.load_state_dict(state_dict[name])

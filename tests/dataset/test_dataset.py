@@ -1,0 +1,137 @@
+"""Dataset utility tests (reference test strategy: unit tests per helper)."""
+
+import numpy as np
+import pytest
+
+from d9d_tpu.dataset import (
+    BufferSortedDataset,
+    PaddingSide1D,
+    ShardIndexingMode,
+    ShardedDataset,
+    TokenPoolingType,
+    pad_stack_1d,
+    token_pooling_mask_from_attention_mask,
+)
+
+
+class ListDataset:
+    def __init__(self, items):
+        self.items = list(items)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+    def sort_key(self, i):
+        return self.items[i]
+
+
+def test_sharded_sequential():
+    ds = ListDataset(range(14))
+    shards = [
+        ShardedDataset(ds, 4, i, ShardIndexingMode.sequential, False)
+        for i in range(4)
+    ]
+    assert [list(s[i] for i in range(len(s))) for s in shards] == [
+        [0, 4, 8, 12],
+        [1, 5, 9, 13],
+        [2, 6, 10],
+        [3, 7, 11],
+    ]
+
+
+def test_sharded_chunked():
+    ds = ListDataset(range(14))
+    shards = [
+        ShardedDataset(ds, 4, i, ShardIndexingMode.chunked, False)
+        for i in range(4)
+    ]
+    assert [list(s[i] for i in range(len(s))) for s in shards] == [
+        [0, 1, 2, 3],
+        [4, 5, 6, 7],
+        [8, 9, 10, 11],
+        [12, 13],
+    ]
+
+
+def test_sharded_padded_equal_lengths():
+    ds = ListDataset(range(14))
+    shards = [
+        ShardedDataset(ds, 4, i, ShardIndexingMode.sequential, True)
+        for i in range(4)
+    ]
+    assert all(len(s) == 4 for s in shards)
+    # out-of-range reads clamp to the last dataset element
+    assert shards[2][3] == 13
+    assert shards[3][3] == 13
+
+
+def test_sharded_validation_and_state():
+    ds = ListDataset(range(10))
+    with pytest.raises(ValueError):
+        ShardedDataset(ds, 4, 7)
+    s = ShardedDataset(ds, 2, 1)
+    state = s.state_dict()
+    s2 = ShardedDataset(ds, 2, 0)
+    s2.load_state_dict(state)
+    assert s2[0] == s[0]
+    with pytest.raises(ValueError):
+        ShardedDataset(ds, 3, 0).load_state_dict(state)
+
+
+def test_buffer_sorted_groups_similar_lengths():
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(1, 100, size=64).tolist()
+    ds = ListDataset(lengths)
+    bs = BufferSortedDataset(ds, buffer_size=32, pack_size=4, init_seed=42)
+    served = [bs[i] for i in range(len(bs))]
+    assert sorted(served) == sorted(lengths)  # permutation, nothing lost
+    # within each pack of 4 the spread must be small vs global spread
+    packs = [served[i : i + 4] for i in range(0, 64, 4)]
+    avg_spread = np.mean([max(p) - min(p) for p in packs])
+    assert avg_spread < (max(lengths) - min(lengths)) / 3
+
+
+def test_buffer_sorted_state_roundtrip():
+    ds = ListDataset(list(range(40, 0, -1)))
+    bs = BufferSortedDataset(ds, buffer_size=16, pack_size=4, init_seed=7)
+    first_half = [bs[i] for i in range(20)]
+    state = bs.state_dict()
+    rest_a = [bs[i] for i in range(20, 40)]
+
+    bs2 = BufferSortedDataset(ds, buffer_size=16, pack_size=4, init_seed=7)
+    bs2.load_state_dict(state)
+    rest_b = [bs2[i] for i in range(20, 40)]
+    assert rest_a == rest_b
+    assert sorted(first_half + rest_a) == sorted(range(1, 41))
+
+
+def test_pad_stack_right_left_multiple():
+    items = [np.array([1, 2, 3]), np.array([4])]
+    out = pad_stack_1d(items, pad_value=0)
+    np.testing.assert_array_equal(out, [[1, 2, 3], [4, 0, 0]])
+    out = pad_stack_1d(items, pad_value=9, padding_side=PaddingSide1D.left)
+    np.testing.assert_array_equal(out, [[1, 2, 3], [9, 9, 4]])
+    out = pad_stack_1d(items, pad_value=0, pad_to_multiple_of=4)
+    assert out.shape == (2, 4)
+    with pytest.raises(ValueError):
+        pad_stack_1d([], 0)
+    with pytest.raises(ValueError):
+        pad_stack_1d(items, 0, pad_to_multiple_of=0)
+
+
+def test_pooling_masks():
+    am = np.array([[1, 1, 1, 0], [1, 1, 0, 0]])
+    np.testing.assert_array_equal(
+        token_pooling_mask_from_attention_mask(am, TokenPoolingType.first),
+        [[1, 0, 0, 0], [1, 0, 0, 0]],
+    )
+    np.testing.assert_array_equal(
+        token_pooling_mask_from_attention_mask(am, TokenPoolingType.last),
+        [[0, 0, 1, 0], [0, 1, 0, 0]],
+    )
+    np.testing.assert_array_equal(
+        token_pooling_mask_from_attention_mask(am, TokenPoolingType.all), am
+    )

@@ -1,0 +1,146 @@
+"""Piecewise LR schedule tests (parity with the reference builder/engine
+semantics: warmup→hold→decay shapes, clamping, jit traceability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.lr_scheduler import (
+    CurveCosine,
+    CurveExponential,
+    CurveLinear,
+    CurvePoly,
+    PiecewiseSchedulerConfig,
+    piecewise_schedule,
+    piecewise_scheduler_from_config,
+    sample_schedule,
+)
+
+
+def test_linear_warmup_and_clamp():
+    sched = (
+        piecewise_schedule(0.0, total_steps=100)
+        .for_steps(10, 1.0, CurveLinear())
+        .fill_rest(0.0, CurveLinear())
+        .build()
+    )
+    assert float(sched(-5)) == 0.0  # clamps below
+    assert float(sched(0)) == 0.0
+    assert float(sched(5)) == pytest.approx(0.5)
+    assert float(sched(10)) == pytest.approx(1.0)
+    assert float(sched(55)) == pytest.approx(0.5)
+    assert float(sched(100)) == pytest.approx(0.0)
+    assert float(sched(1000)) == pytest.approx(0.0)  # clamps above
+
+
+def test_cosine_hits_midpoint():
+    sched = piecewise_schedule(1.0).for_steps(100, 0.0, CurveCosine()).build()
+    assert float(sched(0)) == pytest.approx(1.0)
+    assert float(sched(50)) == pytest.approx(0.5, abs=1e-6)
+    assert float(sched(100)) == pytest.approx(0.0)
+
+
+def test_poly_and_exponential_curves():
+    poly = piecewise_schedule(0.0).for_steps(10, 1.0, CurvePoly(2.0)).build()
+    assert float(poly(5)) == pytest.approx(0.25)
+
+    exp = (
+        piecewise_schedule(1.0).for_steps(10, 0.01, CurveExponential()).build()
+    )
+    assert float(exp(5)) == pytest.approx(0.1, rel=1e-4)
+
+
+def test_multi_phase_continuity():
+    sched = (
+        piecewise_schedule(0.0, total_steps=1000)
+        .for_steps(100, 1.0, CurveLinear())
+        .until_percentage(0.5, 1.0, CurveLinear())
+        .fill_rest(0.1, CurveCosine())
+        .build()
+    )
+    ys = sample_schedule(sched, 1000)
+    assert np.all(np.abs(np.diff(ys)) < 0.05)  # no jumps
+    assert ys[100] == pytest.approx(1.0)
+    assert ys[300] == pytest.approx(1.0)
+    assert ys[999] == pytest.approx(0.1, abs=1e-2)
+
+
+def test_builder_validation():
+    with pytest.raises(ValueError):
+        piecewise_schedule(0.0).until_percentage(0.5, 1.0, CurveLinear())
+    with pytest.raises(ValueError):
+        (
+            piecewise_schedule(0.0, total_steps=10)
+            .for_steps(20, 1.0, CurveLinear())
+            .build()
+        )
+    with pytest.raises(ValueError):
+        (
+            piecewise_schedule(0.0, total_steps=100)
+            .until_percentage(0.5, 1.0, CurveLinear())
+            .until_percentage(0.1, 1.0, CurveLinear())
+        )
+
+
+def test_jit_traceable():
+    sched = (
+        piecewise_schedule(0.0, total_steps=100)
+        .for_steps(10, 1.0, CurveLinear())
+        .fill_rest(0.0, CurveCosine())
+        .build()
+    )
+
+    @jax.jit
+    def f(step):
+        return sched(step)
+
+    for s in (0, 5, 10, 50, 99):
+        assert float(f(jnp.asarray(s))) == pytest.approx(float(sched(s)))
+
+
+def test_from_config_matches_builder():
+    config = PiecewiseSchedulerConfig.model_validate(
+        {
+            "initial_multiplier": 0.0,
+            "phases": [
+                {
+                    "mode": "steps",
+                    "steps": 10,
+                    "target_multiplier": 1.0,
+                    "curve": {"type": "linear"},
+                },
+                {
+                    "mode": "percentage",
+                    "percentage": 0.5,
+                    "target_multiplier": 0.5,
+                    "curve": {"type": "poly", "power": 2.0},
+                },
+                {
+                    "mode": "rest",
+                    "target_multiplier": 0.0,
+                    "curve": {"type": "cosine"},
+                },
+            ],
+        }
+    )
+    sched = piecewise_scheduler_from_config(config, total_steps=100)
+    manual = (
+        piecewise_schedule(0.0, total_steps=100)
+        .for_steps(10, 1.0, CurveLinear())
+        .until_percentage(0.5, 0.5, CurvePoly(2.0))
+        .fill_rest(0.0, CurveCosine())
+        .build()
+    )
+    np.testing.assert_allclose(
+        sample_schedule(sched, 100), sample_schedule(manual, 100), rtol=1e-6
+    )
+
+
+def test_build_lr_scales():
+    sched = (
+        piecewise_schedule(0.0)
+        .for_steps(10, 1.0, CurveLinear())
+        .build_lr(3e-4)
+    )
+    assert float(sched(10)) == pytest.approx(3e-4)

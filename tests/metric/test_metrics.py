@@ -1,0 +1,149 @@
+"""Metric package tests (reference strategy: numeric parity vs
+scikit-learn where available, SURVEY §4 category 8)."""
+
+import numpy as np
+import pytest
+
+from d9d_tpu.metric import (
+    BinaryAUROCMetric,
+    ComposeMetric,
+    ConfusionMatrixMetricBuilder,
+    SumMetric,
+    WeightedMeanMetric,
+)
+
+def _sklearn_metrics():
+    return pytest.importorskip("sklearn.metrics")
+
+
+def test_weighted_mean():
+    m = WeightedMeanMetric()
+    m.update(np.array([1.0, 2.0]), np.array([1.0, 3.0]))
+    m.update(np.array([4.0]), np.array([2.0]))
+    # (1*1 + 2*3 + 4*2) / (1+3+2) = 15/6
+    assert float(m.compute()) == pytest.approx(15 / 6)
+    assert float(m.accumulated_weight) == 6.0
+    m.sync()
+    assert float(m.compute()) == pytest.approx(15 / 6)
+    m.reset()
+    m.update(np.array([5.0]), np.array([1.0]))
+    assert float(m.compute()) == 5.0
+
+
+def test_sum_and_state_roundtrip():
+    m = SumMetric()
+    m.update(np.array([1.0, 2.0, 3.0]))
+    state = m.state_dict()
+    m2 = SumMetric()
+    m2.load_state_dict(state)
+    assert float(m2.compute()) == 6.0
+
+
+def test_compose():
+    m = ComposeMetric({"a": SumMetric(), "b": WeightedMeanMetric()})
+    m["a"].update(np.array([2.0]))
+    m["b"].update(np.array([3.0]), np.array([1.0]))
+    out = m.compute()
+    assert float(out["a"]) == 2.0
+    assert float(out["b"]) == 3.0
+    with pytest.raises(ValueError):
+        m.update(1)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_multiclass_f1_vs_sklearn(average):
+    rng = np.random.default_rng(0)
+    n, c = 500, 4
+    preds = rng.normal(size=(n, c))
+    targets = rng.integers(0, c, size=(n,))
+
+    builder = ConfusionMatrixMetricBuilder().multiclass(c).with_f1()
+    builder = getattr(builder, average)()
+    m = builder.build()
+    m.update(preds[:250], targets[:250])
+    m.update(preds[250:], targets[250:])
+
+    expected = _sklearn_metrics().f1_score(
+        targets, preds.argmax(-1), average=average
+    )
+    assert float(m.compute()) == pytest.approx(expected, abs=1e-6)
+
+
+def test_multiclass_accuracy_micro_vs_sklearn():
+    rng = np.random.default_rng(1)
+    n, c = 300, 5
+    preds = rng.normal(size=(n, c))
+    targets = rng.integers(0, c, size=(n,))
+    m = ConfusionMatrixMetricBuilder().multiclass(c).with_accuracy().micro().build()
+    m.update(preds, targets)
+    # micro-averaged one-hot accuracy counts TN too; equals
+    # (n*c - 2*errors)/(n*c)
+    errors = (preds.argmax(-1) != targets).sum()
+    expected = (n * c - 2 * errors) / (n * c)
+    assert float(m.compute()) == pytest.approx(expected, abs=1e-9)
+
+
+def test_binary_precision_recall_vs_sklearn():
+    rng = np.random.default_rng(2)
+    n = 400
+    probs = rng.random(size=(n,))
+    targets = rng.integers(0, 2, size=(n,))
+    preds_binary = (probs > 0.5).astype(int)
+
+    skm = _sklearn_metrics()
+    for name, fn in [
+        ("with_precision", skm.precision_score),
+        ("with_recall", skm.recall_score),
+        ("with_f1", skm.f1_score),
+    ]:
+        m = getattr(ConfusionMatrixMetricBuilder().binary(0.5), name)().build()
+        m.update(probs, targets)
+        assert float(m.compute()) == pytest.approx(
+            fn(targets, preds_binary), abs=1e-9
+        ), name
+
+
+def test_topk_accuracy():
+    preds = np.array(
+        [[0.1, 0.5, 0.4], [0.8, 0.1, 0.1], [0.2, 0.3, 0.5], [0.3, 0.4, 0.3]]
+    )
+    targets = np.array([2, 0, 0, 1])
+    m = (
+        ConfusionMatrixMetricBuilder()
+        .multiclass(3, top_k=2)
+        .with_recall()
+        .build()
+    )
+    m.update(preds, targets)
+    # top-2 hits: [yes(2 in {1,2}), yes(0 in {0,..}), no(0 not in {2,1}), yes]
+    assert float(m.compute()) == pytest.approx(3 / 4)
+
+
+def test_builder_validation():
+    with pytest.raises(ValueError):
+        ConfusionMatrixMetricBuilder().binary().multiclass(3)
+    with pytest.raises(ValueError):
+        ConfusionMatrixMetricBuilder().with_f1().build()
+    with pytest.raises(ValueError):
+        ConfusionMatrixMetricBuilder().multiclass(3).with_f1().with_accuracy()
+
+
+def test_auroc_vs_sklearn():
+    rng = np.random.default_rng(3)
+    n = 5000
+    labels = rng.integers(0, 2, size=(n,))
+    # informative but noisy scores
+    probs = np.clip(
+        labels * 0.35 + rng.random(size=(n,)) * 0.65, 0.0, 1.0
+    )
+    m = BinaryAUROCMetric(num_bins=10000)
+    m.update(probs[:2500], labels[:2500])
+    m.update(probs[2500:], labels[2500:])
+    expected = _sklearn_metrics().roc_auc_score(labels, probs)
+    assert float(m.compute()) == pytest.approx(expected, abs=5e-3)
+
+
+def test_auroc_degenerate():
+    m = BinaryAUROCMetric(num_bins=100)
+    m.update(np.array([0.3, 0.7]), np.array([1, 1]))
+    assert float(m.compute()) == 0.5
