@@ -83,10 +83,32 @@ def host_allgather_object(obj: Any) -> list[Any]:
 
 
 def host_broadcast_object(obj: Any, root: int = 0) -> Any:
-    """Broadcast a pickleable object from ``root`` process to all."""
+    """Broadcast a pickleable object from ``root`` process to all.
+
+    O(|obj|) on the wire: only the root's payload ships; other processes may
+    pass ``None``.
+    """
     if jax.process_count() == 1:
         return obj
-    return host_allgather_object(obj)[root]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == root:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = np.asarray([payload.size], np.int64)
+    else:
+        payload = np.zeros((0,), np.uint8)
+        length = np.zeros((1,), np.int64)
+    length = np.asarray(
+        multihost_utils.broadcast_one_to_all(length, is_source=jax.process_index() == root)
+    )
+    buf = np.zeros((int(length[0]),), np.uint8)
+    buf[: payload.size] = payload[: buf.size]
+    buf = np.asarray(
+        multihost_utils.broadcast_one_to_all(buf, is_source=jax.process_index() == root)
+    )
+    return pickle.loads(buf.tobytes())
 
 
 def host_gather_variadic(

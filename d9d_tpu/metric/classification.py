@@ -59,14 +59,18 @@ class OneHotProcessor:
                 f"Expected last dim of preds to equal num_classes="
                 f"{self._num_classes}, got {preds.shape[-1]}"
             )
-        eye = np.eye(self._num_classes, dtype=np.int64)
-        preds_one_hot = eye[np.argmax(preds, axis=-1)]
+        classes = np.arange(self._num_classes)
+
+        def one_hot(idx):
+            return (idx[..., None] == classes).astype(np.int64)
+
+        preds_one_hot = one_hot(np.argmax(preds, axis=-1))
         if targets.shape == preds.shape:
             targets_one_hot = targets.astype(np.int64)
         elif targets.shape == preds.shape[:-1]:
-            targets_one_hot = eye[targets.astype(np.int64)]
+            targets_one_hot = one_hot(targets.astype(np.int64))
         elif targets.shape == (*preds.shape[:-1], 1):
-            targets_one_hot = eye[targets[..., 0].astype(np.int64)]
+            targets_one_hot = one_hot(targets[..., 0].astype(np.int64))
         else:
             raise ValueError(
                 f"Targets shape {targets.shape} is incompatible with "
@@ -197,25 +201,30 @@ def aggregate(
     statistic: ConfusionMatrixStatistic,
     matrix: ConfusionMatrix,
 ) -> np.ndarray:
+    # 0/0 per-class scores (zero support/predictions) count as 0.0, matching
+    # sklearn's zero_division=0 default — otherwise one absent class would
+    # NaN-poison every macro/weighted aggregate.
     with np.errstate(divide="ignore", invalid="ignore"):
         match method:
             case AggregationMethod.MICRO:
-                return statistic(
-                    ConfusionMatrix(
-                        tp=matrix.tp.sum(),
-                        fp=matrix.fp.sum(),
-                        tn=matrix.tn.sum(),
-                        fn=matrix.fn.sum(),
+                return np.nan_to_num(
+                    statistic(
+                        ConfusionMatrix(
+                            tp=matrix.tp.sum(),
+                            fp=matrix.fp.sum(),
+                            tn=matrix.tn.sum(),
+                            fn=matrix.fn.sum(),
+                        )
                     )
                 )
             case AggregationMethod.MACRO:
-                return statistic(matrix).mean()
+                return np.nan_to_num(statistic(matrix)).mean()
             case AggregationMethod.WEIGHTED:
-                scores = statistic(matrix)
+                scores = np.nan_to_num(statistic(matrix))
                 supports = matrix.tp + matrix.fn
                 return (scores * supports).sum() / supports.sum()
             case AggregationMethod.NONE:
-                return statistic(matrix)
+                return np.nan_to_num(statistic(matrix))
     raise ValueError(f"Unknown aggregation method: {method}")
 
 

@@ -1,0 +1,2 @@
+from d9d_tpu.model_state.io import *  # noqa: F401,F403
+from d9d_tpu.model_state.mapper import *  # noqa: F401,F403

@@ -1,0 +1,18 @@
+"""Checkpoint index schema.
+
+Parity: reference d9d/model_state/io/dto.py — the standard HF-compatible
+``model.safetensors.index.json`` with a weight→file map.
+"""
+
+from pydantic import BaseModel
+
+MODEL_STATE_INDEX_FILE_NAME = "model.safetensors.index.json"
+
+
+class ModelStateIndexMeta(BaseModel):
+    total_size: int
+
+
+class ModelStateIndex(BaseModel):
+    metadata: ModelStateIndexMeta
+    weight_map: dict[str, str]

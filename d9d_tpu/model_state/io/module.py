@@ -1,0 +1,135 @@
+"""Param-tree ⇄ checkpoint integration.
+
+Parity: reference d9d/model_state/io/{module_reader.py:41,
+module_writer.py:54,79}. The reference augments user mappers with DTensor
+Distribute / GatherFullTensor leaves; under jax the equivalents are plain
+array movement, applied here at the tree boundary:
+
+- save: every param is brought to host (``np.asarray`` — for a sharded
+  ``jax.Array`` XLA gathers the full value; for non-fully-addressable
+  multi-host arrays an explicit process gather runs first), then streamed
+  through the mapper into safetensors shards.
+- load: every streamed output is ``device_put`` with the target leaf's
+  sharding, so parameters land distributed exactly as the parallel plan
+  demands — no full-model host materialization on any single step.
+"""
+
+from collections.abc import Iterable
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from flax import traverse_util
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.model_state.io.reader import read_model_state
+from d9d_tpu.model_state.io.writer import write_model_state_local
+from d9d_tpu.model_state.mapper.abc import ModelStateMapper
+from d9d_tpu.model_state.mapper.compose import ModelStateMapperParallel
+from d9d_tpu.model_state.mapper.leaf import ModelStateMapperIdentity
+
+SEP = "."
+
+
+def flatten_params(params: PyTree) -> dict[str, Any]:
+    """Flax param tree → flat {'a.b.c': leaf} dict."""
+    flat = traverse_util.flatten_dict(params, sep=SEP)
+    return dict(flat)
+
+
+def unflatten_params(flat: dict[str, Any]) -> PyTree:
+    return traverse_util.unflatten_dict(flat, sep=SEP)
+
+
+def identity_mapper_from_names(names: Iterable[str]) -> ModelStateMapper:
+    """Mapper that passes every named state through unchanged.
+
+    Parity: reference adapters/module.py:8 (identity_mapper_from_module).
+    """
+    return ModelStateMapperParallel(
+        [ModelStateMapperIdentity(n) for n in names]
+    )
+
+
+def identity_mapper_from_params(params: PyTree) -> ModelStateMapper:
+    return identity_mapper_from_names(flatten_params(params).keys())
+
+
+def _to_host(x) -> np.ndarray:
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
+def param_state_generator(
+    params: PyTree,
+) -> Iterable[tuple[str, np.ndarray]]:
+    """Stream (dotted-name, host array) pairs; one param on host at a time."""
+    for name, leaf in flatten_params(params).items():
+        yield name, _to_host(leaf)
+
+
+def save_params(
+    dest_dir: Path,
+    params: PyTree,
+    mapper: ModelStateMapper | None = None,
+    shard_size_gb: float = 4.0,
+) -> None:
+    """Save a param tree through ``mapper`` into sharded safetensors."""
+    if mapper is None:
+        mapper = identity_mapper_from_params(params)
+    write_model_state_local(
+        dest_dir=dest_dir,
+        mapper=mapper,
+        state_generator=param_state_generator(params),
+        shard_size_gb=shard_size_gb,
+    )
+
+
+def load_params(
+    src_dir: Path,
+    template: PyTree,
+    mapper: ModelStateMapper | None = None,
+    shardings: PyTree | None = None,
+) -> PyTree:
+    """Load a checkpoint into the structure of ``template``.
+
+    ``template`` leaves may be concrete arrays or ``jax.ShapeDtypeStruct``;
+    ``shardings`` (same structure) provides per-leaf ``NamedSharding``s —
+    streamed outputs are placed directly with them.
+    """
+    flat_template = flatten_params(template)
+    if mapper is None:
+        mapper = identity_mapper_from_names(flat_template.keys())
+    flat_shardings = (
+        flatten_params(shardings) if shardings is not None else {}
+    )
+
+    loaded: dict[str, Any] = {}
+    for name, value in read_model_state(src_dir, mapper):
+        if name not in flat_template:
+            raise KeyError(
+                f"checkpoint produced unknown param {name!r}; template has "
+                f"{len(flat_template)} params"
+            )
+        want = flat_template[name]
+        if tuple(value.shape) != tuple(want.shape):
+            raise ValueError(
+                f"shape mismatch for {name}: checkpoint {tuple(value.shape)} "
+                f"vs template {tuple(want.shape)}"
+            )
+        value = value.astype(want.dtype)
+        sharding = flat_shardings.get(name)
+        if sharding is not None:
+            loaded[name] = jax.device_put(value, sharding)
+        else:
+            loaded[name] = jax.numpy.asarray(value)
+
+    missing = set(flat_template) - set(loaded)
+    if missing:
+        raise ValueError(f"checkpoint missing params: {sorted(missing)[:10]}"
+                         f"{'...' if len(missing) > 10 else ''}")
+    return unflatten_params(loaded)

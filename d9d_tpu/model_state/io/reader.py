@@ -1,0 +1,105 @@
+"""Streaming checkpoint reader.
+
+Parity: reference d9d/model_state/io/reader.py:92 (read_model_state): build
+a file→keys loading plan from the mapper's dependency groups, load each
+safetensors file once, fire mapper groups as their inputs complete, evict
+consumed inputs immediately. Memory high-water is one group + one open
+file, never the whole checkpoint.
+"""
+
+from collections import defaultdict
+from collections.abc import Generator, Iterable
+from pathlib import Path
+
+import numpy as np
+from safetensors import safe_open
+
+from d9d_tpu.model_state.io.dto import (
+    MODEL_STATE_INDEX_FILE_NAME,
+    ModelStateIndex,
+)
+from d9d_tpu.model_state.mapper.abc import ModelStateMapper
+
+
+class _StateLoadingFlow:
+    def __init__(self, src_dir: Path, mapper: ModelStateMapper):
+        self._src_dir = Path(src_dir)
+        self._mapper = mapper
+        self._index = self._load_index()
+        self._groups_to_process = set(mapper.state_dependency_groups())
+        self._stored_states: dict[str, np.ndarray] = {}
+        self._check_index()
+
+    def _load_index(self) -> ModelStateIndex:
+        index_file = self._src_dir / MODEL_STATE_INDEX_FILE_NAME
+        if not index_file.exists():
+            # single-file checkpoints (bare model.safetensors) get a
+            # synthesized index
+            single = self._src_dir / "model.safetensors"
+            if single.exists():
+                with safe_open(str(single), framework="np") as st:
+                    keys = list(st.keys())
+                return ModelStateIndex(
+                    metadata={"total_size": 0},
+                    weight_map={k: "model.safetensors" for k in keys},
+                )
+            raise FileNotFoundError(index_file)
+        return ModelStateIndex.model_validate_json(
+            index_file.read_text(encoding="utf-8")
+        )
+
+    def _check_index(self) -> None:
+        required: set[str] = set()
+        for group in self._groups_to_process:
+            required.update(group.inputs)
+        missing = required.difference(self._index.weight_map.keys())
+        if missing:
+            raise ValueError(
+                f"Cannot run state loading: states {sorted(missing)} are missing!"
+            )
+
+    def _process_available_groups(
+        self,
+    ) -> Generator[tuple[str, np.ndarray], None, None]:
+        for group in self._groups_to_process.copy():
+            if not group.inputs.issubset(self._stored_states.keys()):
+                continue
+            self._groups_to_process.remove(group)
+            outputs = self._mapper.apply(
+                {
+                    k: v
+                    for k, v in self._stored_states.items()
+                    if k in group.inputs
+                }
+            )
+            yield from outputs.items()
+            for input_name in group.inputs:
+                del self._stored_states[input_name]
+
+    def _build_file_loading_plan(self) -> dict[str, set[str]]:
+        plan: dict[str, set[str]] = defaultdict(set)
+        for group in self._mapper.state_dependency_groups():
+            for key in group.inputs:
+                plan[self._index.weight_map[key]].add(key)
+        return plan
+
+    def load(self) -> Iterable[tuple[str, np.ndarray]]:
+        for file_name, keys in self._build_file_loading_plan().items():
+            with safe_open(
+                str(self._src_dir / file_name), framework="np"
+            ) as st:
+                for key in keys:
+                    self._stored_states[key] = st.get_tensor(key)
+            yield from self._process_available_groups()
+        if self._groups_to_process:
+            missing = {g.inputs for g in self._groups_to_process}
+            raise ValueError(
+                f"Reading finished with unsatisfied groups: {missing}"
+            )
+
+
+def read_model_state(
+    src_dir: Path, mapper: ModelStateMapper
+) -> Iterable[tuple[str, np.ndarray]]:
+    """Stream (name, array) pairs from a checkpoint, transformed by ``mapper``."""
+    yield from _StateLoadingFlow(src_dir=src_dir, mapper=mapper).load()

@@ -1,0 +1,237 @@
+"""Streaming checkpoint writer.
+
+Parity: reference d9d/model_state/io/writer.py:175,210,252: consume a
+(name, array) generator, fire mapper groups as inputs complete, spill
+≤shard_size_gb safetensors shards under temp names, then a master pass
+renames shards to ``model-XXXXX-of-YYYYY.safetensors`` and writes one
+global index. Three modes: local (single process), distributed (every
+process holds the full state; only master writes), and pipeline-parallel
+(each process writes only its stages' states; indices merged via
+host object gather — the reference's all_gather_object at writer.py:285-309).
+"""
+
+import warnings
+from collections.abc import Iterable
+from pathlib import Path
+
+import numpy as np
+from safetensors.numpy import save_file
+
+from d9d_tpu.core.collectives import host_allgather_object
+from d9d_tpu.model_state.io.dto import (
+    MODEL_STATE_INDEX_FILE_NAME,
+    ModelStateIndex,
+    ModelStateIndexMeta,
+)
+from d9d_tpu.model_state.mapper.abc import ModelStateMapper
+
+
+class _StateWritingFlowLocal:
+    def __init__(
+        self,
+        dest_dir: Path,
+        mapper: ModelStateMapper,
+        shard_size_gb: float,
+        sharding_rank: int,
+        is_current_process_rank_master: bool,
+    ):
+        self._dest_dir = Path(dest_dir)
+        self._mapper = mapper
+        self._shard_size_bytes = int(shard_size_gb * (1024**3))
+        self._groups_to_process = set(mapper.state_dependency_groups())
+        self._available_source_states: dict[str, np.ndarray] = {}
+        self._total_size = 0
+        self._pending_write_tensors: dict[str, np.ndarray] = {}
+        self._current_shard_size = 0
+        self._sharding_rank = sharding_rank
+        self._weight_name_to_local_shard_idx: dict[str, int] = {}
+        self._local_shard_idx_to_tmp_path: dict[int, Path] = {}
+        self._is_master = is_current_process_rank_master
+
+    def _flush_shard(self) -> None:
+        if not self._pending_write_tensors:
+            return
+        local_shard_num = len(self._local_shard_idx_to_tmp_path) + 1
+        shard_tmp_path = (
+            self._dest_dir
+            / f".tmp-rank{self._sharding_rank}-shard-{local_shard_num}.safetensors"
+        )
+        self._local_shard_idx_to_tmp_path[local_shard_num] = shard_tmp_path
+        save_file(
+            {
+                k: np.ascontiguousarray(v)
+                for k, v in self._pending_write_tensors.items()
+            },
+            str(shard_tmp_path),
+        )
+        for state_name in self._pending_write_tensors:
+            self._weight_name_to_local_shard_idx[state_name] = local_shard_num
+        self._total_size += self._current_shard_size
+        self._pending_write_tensors.clear()
+        self._current_shard_size = 0
+
+    def _process_available_groups(self) -> None:
+        for group in self._groups_to_process.copy():
+            if not group.inputs.issubset(self._available_source_states.keys()):
+                continue
+            self._groups_to_process.remove(group)
+            states_to_save = self._mapper.apply(
+                {
+                    k: self._available_source_states[k]
+                    for k in group.inputs
+                }
+            )
+            for input_name in group.inputs:
+                del self._available_source_states[input_name]
+            if not self._is_master:
+                continue
+            for name, tensor in states_to_save.items():
+                tensor = np.asarray(tensor)
+                update_size = tensor.nbytes
+                if update_size > self._shard_size_bytes:
+                    raise ValueError(
+                        f"Cannot save state {name} larger than shard size"
+                    )
+                if (
+                    self._current_shard_size + update_size
+                    > self._shard_size_bytes
+                ):
+                    self._flush_shard()
+                self._pending_write_tensors[name] = tensor
+                self._current_shard_size += update_size
+
+    def _finalize_locally(self) -> ModelStateIndex:
+        self._flush_shard()
+        if self._groups_to_process:
+            missing = {g.inputs for g in self._groups_to_process}
+            raise ValueError(
+                f"Writing failed: not all source tensors were provided. "
+                f"Missing inputs for groups: {missing}"
+            )
+        if self._available_source_states:
+            warnings.warn(
+                f"State Writing: unconsumed source tensors ignored: "
+                f"{sorted(self._available_source_states.keys())}",
+                stacklevel=2,
+            )
+        weight_map_local = {
+            name: self._local_shard_idx_to_tmp_path[shard_idx].name
+            for name, shard_idx in self._weight_name_to_local_shard_idx.items()
+        }
+        return ModelStateIndex(
+            metadata=ModelStateIndexMeta(total_size=self._total_size),
+            weight_map=weight_map_local,
+        )
+
+    def write(
+        self, state_generator: Iterable[tuple[str, np.ndarray]]
+    ) -> ModelStateIndex | None:
+        self._dest_dir.mkdir(parents=True, exist_ok=True)
+        for name, tensor in state_generator:
+            self._available_source_states[name] = np.asarray(tensor)
+            self._process_available_groups()
+        if self._is_master:
+            return self._finalize_locally()
+        # non-masters still validate that every group fired
+        self._finalize_locally()
+        return None
+
+
+def _finalize_master(dest_dir: Path, indices: list[ModelStateIndex]) -> None:
+    """Rename temp shards into the global numbering and write one index."""
+    dest_dir = Path(dest_dir)
+    total_size = sum(index.metadata.total_size for index in indices)
+    total_weight_map_local = {
+        name: file
+        for index in indices
+        for name, file in index.weight_map.items()
+    }
+    shard_count = len(
+        {file for index in indices for file in index.weight_map.values()}
+    )
+    total_weight_map: dict[str, str] = {}
+    local_to_global: dict[str, str] = {}
+    used = 0
+    for weight_name, old_file in total_weight_map_local.items():
+        if old_file not in local_to_global:
+            used += 1
+            new_file = f"model-{used:05d}-of-{shard_count:05d}.safetensors"
+            (dest_dir / old_file).rename(dest_dir / new_file)
+            local_to_global[old_file] = new_file
+        total_weight_map[weight_name] = local_to_global[old_file]
+    (dest_dir / MODEL_STATE_INDEX_FILE_NAME).write_text(
+        ModelStateIndex(
+            metadata=ModelStateIndexMeta(total_size=total_size),
+            weight_map=total_weight_map,
+        ).model_dump_json(indent=4),
+        encoding="utf-8",
+    )
+
+
+def write_model_state_local(
+    dest_dir: Path,
+    mapper: ModelStateMapper,
+    state_generator: Iterable[tuple[str, np.ndarray]],
+    shard_size_gb: float = 4.0,
+) -> None:
+    """Single-process save."""
+    index = _StateWritingFlowLocal(
+        dest_dir=dest_dir,
+        mapper=mapper,
+        shard_size_gb=shard_size_gb,
+        sharding_rank=0,
+        is_current_process_rank_master=True,
+    ).write(state_generator)
+    assert index is not None
+    _finalize_master(dest_dir, [index])
+
+
+def write_model_state_distributed(
+    dest_dir: Path,
+    mapper: ModelStateMapper,
+    state_generator: Iterable[tuple[str, np.ndarray]],
+    shard_size_gb: float = 4.0,
+) -> None:
+    """Every process streams the same (replicated) state; process 0 writes."""
+    import jax
+
+    is_master = jax.process_index() == 0
+    index = _StateWritingFlowLocal(
+        dest_dir=dest_dir,
+        mapper=mapper,
+        shard_size_gb=shard_size_gb,
+        sharding_rank=0,
+        is_current_process_rank_master=is_master,
+    ).write(state_generator)
+    if is_master:
+        assert index is not None
+        _finalize_master(dest_dir, [index])
+
+
+def write_model_state_pipeline_parallel(
+    dest_dir: Path,
+    mapper: ModelStateMapper,
+    state_generator: Iterable[tuple[str, np.ndarray]],
+    writer_rank: int,
+    is_local_writer: bool,
+    shard_size_gb: float = 4.0,
+) -> None:
+    """Each pipeline stage group writes its own states; indices are merged.
+
+    ``is_local_writer`` selects one process per stage group (the reference's
+    coordinate-sum-0 rule, writer.py:285-309); ``writer_rank`` must be
+    unique among writers (e.g. the pp rank) so temp shard names don't
+    collide.
+    """
+    import jax
+
+    index = _StateWritingFlowLocal(
+        dest_dir=dest_dir,
+        mapper=mapper,
+        shard_size_gb=shard_size_gb,
+        sharding_rank=writer_rank,
+        is_current_process_rank_master=is_local_writer,
+    ).write(state_generator)
+    indices = [i for i in host_allgather_object(index) if i is not None]
+    if jax.process_index() == 0:
+        _finalize_master(dest_dir, indices)

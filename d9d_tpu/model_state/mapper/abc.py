@@ -1,0 +1,43 @@
+"""Model-state transformation graph: the mapper abstraction.
+
+Parity: reference d9d/model_state/mapper/abc.py:7,23 (StateGroup +
+ModelStateMapper). The declarative/imperative split is kept exactly:
+
+1. Declarative — ``state_dependency_groups()`` announces *what* will be
+   consumed/produced, letting the IO layer build streaming plans, validate
+   chains and shard work before touching tensor data.
+2. Imperative — ``apply()`` transforms one complete input group.
+
+Tensors are host ``numpy`` arrays: checkpoint transformation happens on
+host, then the module layer device_puts with target shardings (the jax
+replacement for DTensor distribution).
+"""
+
+import abc
+import dataclasses
+
+import numpy as np
+
+StateDict = dict[str, np.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class StateGroup:
+    """Atomic dependency unit: ``inputs`` are all keys required, ``outputs``
+    all keys produced by one independent transformation."""
+
+    inputs: frozenset[str]
+    outputs: frozenset[str]
+
+
+class ModelStateMapper(abc.ABC):
+    @abc.abstractmethod
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        """Disjoint dependency groups this mapper handles."""
+        ...
+
+    @abc.abstractmethod
+    def apply(self, group: StateDict) -> StateDict:
+        """Transform one group; ``group`` contains exactly the keys of a
+        single StateGroup's inputs, the result exactly its outputs."""
+        ...

@@ -1,0 +1,265 @@
+"""Compose mappers: parallel union, sequential pipeline, prefix scoping,
+group sharding.
+
+Parity: reference d9d/model_state/mapper/compose/{parallel,sequential,
+prefix_scope,shard,helper}.py. Sequential keeps the reference's two key
+behaviors: gap-filling (identity pass-through injection between stages) and
+net dependency-group computation with transitive merging, so a chain
+A:{x}->{y}, B:{y}->{z} reports a single group {x}->{z}.
+"""
+
+from collections.abc import Sequence
+
+from d9d_tpu.model_state.mapper.abc import (
+    ModelStateMapper,
+    StateDict,
+    StateGroup,
+)
+from d9d_tpu.model_state.mapper.leaf import ModelStateMapperIdentity
+
+
+def filter_empty_mappers(
+    mappers: Sequence[ModelStateMapper],
+) -> list[ModelStateMapper]:
+    """Drop mappers with no non-empty dependency group."""
+    result = []
+    for mapper in mappers:
+        for group in mapper.state_dependency_groups():
+            if len(group.inputs) > 0 or len(group.outputs) > 0:
+                result.append(mapper)
+                break
+    return result
+
+
+class ModelStateMapperParallel(ModelStateMapper):
+    """Disjoint union of mappers; input/output key collisions are errors."""
+
+    def __init__(self, mappers: Sequence[ModelStateMapper]):
+        mappers_lst = filter_empty_mappers(mappers)
+
+        all_groups: set[StateGroup] = set()
+        inputs_to_mapper: dict[frozenset[str], ModelStateMapper] = {}
+        seen_inputs: set[str] = set()
+        seen_outputs: set[str] = set()
+        for mapper in mappers_lst:
+            for sub_group in mapper.state_dependency_groups():
+                if not seen_inputs.isdisjoint(sub_group.inputs):
+                    raise ValueError(
+                        f"Found a colliding input group: {sub_group.inputs}"
+                    )
+                seen_inputs.update(sub_group.inputs)
+                if not seen_outputs.isdisjoint(sub_group.outputs):
+                    raise ValueError(
+                        f"Found colliding output keys: {sub_group.outputs}"
+                    )
+                seen_outputs.update(sub_group.outputs)
+                all_groups.add(sub_group)
+                inputs_to_mapper[sub_group.inputs] = mapper
+
+        self._all_groups = frozenset(all_groups)
+        self._inputs_to_mapper = inputs_to_mapper
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return self._all_groups
+
+    def apply(self, group: StateDict) -> StateDict:
+        group_keys = frozenset(group.keys())
+        if group_keys not in self._inputs_to_mapper:
+            raise ValueError(
+                "Tried to run a parallel mapper with undefined group. "
+                "Perhaps you sent groups that are not isolated?"
+            )
+        return self._inputs_to_mapper[group_keys].apply(group)
+
+
+class ModelStateMapperSequential(ModelStateMapper):
+    """Pipeline of mappers with automatic gap filling and group merging."""
+
+    def __init__(self, mappers: list[ModelStateMapper]):
+        mappers = filter_empty_mappers(mappers)
+        if not mappers:
+            raise ValueError("Mappers list cannot be empty.")
+        mappers = self._fill_gaps(mappers)
+        self._groups = self._compute_pipeline_groups(mappers)
+        self._mappers = mappers
+
+    @staticmethod
+    def _fill_gaps(
+        mappers: list[ModelStateMapper],
+    ) -> list[ModelStateMapper]:
+        mappers = mappers.copy()
+        # inputs needed downstream but not produced upstream pass through
+        for stage_i in reversed(range(1, len(mappers))):
+            current_requires = frozenset().union(
+                *(
+                    g.inputs
+                    for g in mappers[stage_i].state_dependency_groups()
+                )
+            )
+            prev_produces = frozenset().union(
+                *(
+                    g.outputs
+                    for g in mappers[stage_i - 1].state_dependency_groups()
+                )
+            )
+            pass_through = current_requires - prev_produces
+            mappers[stage_i - 1] = ModelStateMapperParallel(
+                [mappers[stage_i - 1]]
+                + [ModelStateMapperIdentity(x) for x in pass_through]
+            )
+        # outputs produced upstream but not consumed downstream also pass
+        for stage_i in range(0, len(mappers) - 1):
+            current_produces = frozenset().union(
+                *(
+                    g.outputs
+                    for g in mappers[stage_i].state_dependency_groups()
+                )
+            )
+            next_requires = frozenset().union(
+                *(
+                    g.inputs
+                    for g in mappers[stage_i + 1].state_dependency_groups()
+                )
+            )
+            pass_through = current_produces - next_requires
+            mappers[stage_i + 1] = ModelStateMapperParallel(
+                [mappers[stage_i + 1]]
+                + [ModelStateMapperIdentity(x) for x in pass_through]
+            )
+        return mappers
+
+    @staticmethod
+    def _compute_pipeline_groups(
+        mappers: list[ModelStateMapper],
+    ) -> frozenset[StateGroup]:
+        outputs_depend_on_inputs = {}
+        for last_group in mappers[-1].state_dependency_groups():
+            required_inputs = last_group.inputs
+            for mapper_i in reversed(range(0, len(mappers) - 1)):
+                hit_groups = [
+                    g
+                    for g in mappers[mapper_i].state_dependency_groups()
+                    if not g.outputs.isdisjoint(required_inputs)
+                ]
+                required_inputs = frozenset().union(
+                    *(g.inputs for g in hit_groups)
+                )
+            outputs_depend_on_inputs[last_group.outputs] = required_inputs
+        return ModelStateMapperSequential._merge_groups(
+            list(outputs_depend_on_inputs.items())
+        )
+
+    @staticmethod
+    def _merge_groups(groups) -> frozenset[StateGroup]:
+        # Transitively union groups sharing any input or output key
+        # (union-find; a group is (outputs, inputs) as produced by
+        # _compute_pipeline_groups).
+        items = [(set(outs), set(ins)) for outs, ins in groups]
+        parent = list(range(len(items)))
+
+        def find(i: int) -> int:
+            while parent[i] != i:
+                parent[i] = parent[parent[i]]
+                i = parent[i]
+            return i
+
+        key_owner: dict[tuple[str, str], int] = {}
+        for i, (outs, ins) in enumerate(items):
+            for kind, keys in (("in", ins), ("out", outs)):
+                for key in keys:
+                    owner = key_owner.setdefault((kind, key), i)
+                    if owner != i:
+                        parent[find(i)] = find(owner)
+
+        merged: dict[int, tuple[set[str], set[str]]] = {}
+        for i, (outs, ins) in enumerate(items):
+            root = find(i)
+            acc = merged.setdefault(root, (set(), set()))
+            acc[0].update(outs)
+            acc[1].update(ins)
+        return frozenset(
+            StateGroup(inputs=frozenset(ins), outputs=frozenset(outs))
+            for outs, ins in merged.values()
+        )
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return self._groups
+
+    def apply(self, group: StateDict) -> StateDict:
+        current_state = group
+        next_state: StateDict = {}
+        for mapper in self._mappers:
+            for deps in mapper.state_dependency_groups():
+                if not deps.inputs <= current_state.keys():
+                    continue
+                next_state.update(
+                    mapper.apply(
+                        {
+                            k: v
+                            for k, v in current_state.items()
+                            if k in deps.inputs
+                        }
+                    )
+                )
+            current_state = next_state
+            next_state = {}
+        return current_state
+
+
+class ModelStateMapperPrefixScope(ModelStateMapper):
+    """Scope a child mapper under source/target key prefixes."""
+
+    def __init__(
+        self,
+        mapper: ModelStateMapper,
+        source_prefix: str = "",
+        target_prefix: str = "",
+    ):
+        self._mapper = mapper
+        self._source_prefix = source_prefix
+        self._target_prefix = target_prefix
+        self._groups = frozenset(
+            StateGroup(
+                inputs=frozenset(f"{source_prefix}{k}" for k in g.inputs),
+                outputs=frozenset(f"{target_prefix}{k}" for k in g.outputs),
+            )
+            for g in mapper.state_dependency_groups()
+        )
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return self._groups
+
+    def apply(self, group: StateDict) -> StateDict:
+        scoped = {
+            k.removeprefix(self._source_prefix): v for k, v in group.items()
+        }
+        result = self._mapper.apply(scoped)
+        return {f"{self._target_prefix}{k}": v for k, v in result.items()}
+
+
+class ModelStateMapperShard(ModelStateMapper):
+    """Restrict a mapper to every ``total_shards``-th dependency group —
+    splits checkpoint loading work across processes."""
+
+    def __init__(
+        self,
+        sub_mapper: ModelStateMapper,
+        total_shards: int,
+        current_shard: int,
+    ):
+        groups_sorted = sorted(
+            sub_mapper.state_dependency_groups(),
+            key=lambda g: sorted(g.inputs),
+        )
+        self._groups = frozenset(
+            g
+            for i, g in enumerate(groups_sorted)
+            if i % total_shards == current_shard
+        )
+        self._sub_mapper = sub_mapper
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return self._groups
+
+    def apply(self, group: StateDict) -> StateDict:
+        return self._sub_mapper.apply(group)

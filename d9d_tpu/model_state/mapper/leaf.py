@@ -1,0 +1,232 @@
+"""Leaf mappers: single-tensor and multi-tensor primitives.
+
+Parity: reference d9d/model_state/mapper/leaf/{single_tensor,rename,stack,
+select_child}.py. The DTensor pair (Distribute / GatherFullTensor,
+leaf/dtensor.py) has no leaf equivalent here: under jax, distribution is a
+``device_put`` with a NamedSharding and gathering is ``np.asarray`` on the
+global array — both live in the module IO layer
+(d9d_tpu/model_state/io/module.py), not in the mapper graph.
+"""
+
+import numpy as np
+
+from d9d_tpu.model_state.mapper.abc import (
+    ModelStateMapper,
+    StateDict,
+    StateGroup,
+)
+
+
+def _single(name_in: str, name_out: str) -> frozenset[StateGroup]:
+    return frozenset(
+        [StateGroup(inputs=frozenset([name_in]), outputs=frozenset([name_out]))]
+    )
+
+
+class ModelStateMapperIdentity(ModelStateMapper):
+    """Pass one tensor through unchanged."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name, self._name)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return group
+
+
+class ModelStateMapperRename(ModelStateMapper):
+    def __init__(self, name_from: str, name_to: str):
+        self._name_from = name_from
+        self._name_to = name_to
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name_from, self._name_to)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name_to: group[self._name_from]}
+
+
+class ModelStateMapperTranspose(ModelStateMapper):
+    def __init__(self, name: str, dims: tuple[int, int]):
+        self._name = name
+        self._dims = dims
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name, self._name)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name: np.swapaxes(group[self._name], *self._dims)}
+
+
+class ModelStateMapperSqueeze(ModelStateMapper):
+    def __init__(self, name: str, dim: int | None = None):
+        self._name = name
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name, self._name)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name: np.squeeze(group[self._name], axis=self._dim)}
+
+
+class ModelStateMapperUnsqueeze(ModelStateMapper):
+    def __init__(self, name: str, dim: int):
+        self._name = name
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name, self._name)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name: np.expand_dims(group[self._name], axis=self._dim)}
+
+
+class ModelStateMapperCast(ModelStateMapper):
+    """Cast one tensor to a target dtype (jax extension; the torch reference
+    leaves dtype conversion to load_state_dict)."""
+
+    def __init__(self, name: str, dtype):
+        self._name = name
+        self._dtype = dtype
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return _single(self._name, self._name)
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name: np.asarray(group[self._name]).astype(self._dtype)}
+
+
+class ModelStateMapperStackTensors(ModelStateMapper):
+    """Stack inputs into one output along a new dim."""
+
+    def __init__(self, source_names: list[str], target_name: str, dim: int):
+        self._source_names = list(source_names)
+        self._target_name = target_name
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset(self._source_names),
+                    outputs=frozenset([self._target_name]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {
+            self._target_name: np.stack(
+                [group[n] for n in self._source_names], axis=self._dim
+            )
+        }
+
+
+class ModelStateMapperUnstackTensors(ModelStateMapper):
+    def __init__(self, source_name: str, target_names: list[str], dim: int):
+        self._source_name = source_name
+        self._target_names = list(target_names)
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source_name]),
+                    outputs=frozenset(self._target_names),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        tensor = np.asarray(group[self._source_name])
+        if tensor.shape[self._dim] != len(self._target_names):
+            raise ValueError(
+                f"cannot unstack dim of size {tensor.shape[self._dim]} into "
+                f"{len(self._target_names)} tensors"
+            )
+        parts = np.moveaxis(tensor, self._dim, 0)
+        return {
+            name: np.ascontiguousarray(parts[i])
+            for i, name in enumerate(self._target_names)
+        }
+
+
+class ModelStateMapperChunkTensors(ModelStateMapper):
+    def __init__(self, source_name: str, target_names: list[str], dim: int):
+        self._source_name = source_name
+        self._target_names = list(target_names)
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source_name]),
+                    outputs=frozenset(self._target_names),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        chunks = np.array_split(
+            np.asarray(group[self._source_name]),
+            len(self._target_names),
+            axis=self._dim,
+        )
+        return {
+            name: np.ascontiguousarray(chunk)
+            for name, chunk in zip(self._target_names, chunks, strict=True)
+        }
+
+
+class ModelStateMapperConcatenateTensors(ModelStateMapper):
+    def __init__(self, source_names: list[str], target_name: str, dim: int):
+        self._source_names = list(source_names)
+        self._target_name = target_name
+        self._dim = dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset(self._source_names),
+                    outputs=frozenset([self._target_name]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {
+            self._target_name: np.concatenate(
+                [group[n] for n in self._source_names], axis=self._dim
+            )
+        }
+
+
+class ModelStateMapperSelectChildModules(ModelStateMapper):
+    """Hoist keys out of a parent scope: ``parent.x -> x`` batch rename."""
+
+    def __init__(self, base_names: list[str], parent_name: str):
+        self._base_names = list(base_names)
+        self._parent_prefix = f"{parent_name}."
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._parent_prefix + name]),
+                    outputs=frozenset([name]),
+                )
+                for name in self._base_names
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        name, value = next(iter(group.items()))
+        if name.startswith(self._parent_prefix):
+            return {name[len(self._parent_prefix) :]: value}
+        return {}

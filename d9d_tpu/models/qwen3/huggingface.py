@@ -1,0 +1,281 @@
+"""Bidirectional HuggingFace ⇄ d9d_tpu state mappers for Qwen3-dense.
+
+Parity: reference d9d/module/model/qwen3_dense/huggingface.py (234 LoC of
+bidirectional mappers). Layout differences handled here:
+
+- torch ``nn.Linear.weight`` is [out, in]; flax ``Dense.kernel`` is
+  [in, out] → transpose.
+- embedding/lm_head are [vocab, hidden] on both sides → split/concat over
+  named vocab ranges only.
+- flax param tree keys are dotted under the ``params.`` root:
+  ``params.model.layers_{i}.self_attn.q_proj.kernel``.
+"""
+
+import numpy as np
+
+from d9d_tpu.model_state.mapper import (
+    ModelStateMapper,
+    ModelStateMapperParallel,
+    ModelStateMapperRename,
+    StateDict,
+    StateGroup,
+)
+from d9d_tpu.models.qwen3.config import Qwen3DenseConfig
+
+_P = "params."
+
+
+class _TransposedRename(ModelStateMapper):
+    """[out,in] ⇄ [in,out] weight movement with a rename in one group."""
+
+    def __init__(self, name_from: str, name_to: str):
+        self._name_from = name_from
+        self._name_to = name_to
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._name_from]),
+                    outputs=frozenset([self._name_to]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._name_to: np.swapaxes(group[self._name_from], 0, 1)}
+
+
+class _SplitRanges(ModelStateMapper):
+    """Split one [vocab, ...] tensor into named ranges of given sizes."""
+
+    def __init__(self, source: str, targets: list[tuple[str, int]]):
+        self._source = source
+        self._targets = list(targets)
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source]),
+                    outputs=frozenset(n for n, _ in self._targets),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        tensor = np.asarray(group[self._source])
+        total = sum(s for _, s in self._targets)
+        if tensor.shape[0] != total:
+            raise ValueError(
+                f"{self._source}: vocab dim {tensor.shape[0]} != "
+                f"sum of ranges {total}"
+            )
+        out: StateDict = {}
+        offset = 0
+        for name, size in self._targets:
+            out[name] = np.ascontiguousarray(tensor[offset : offset + size])
+            offset += size
+        return out
+
+
+class _ConcatRanges(ModelStateMapper):
+    """Inverse of _SplitRanges."""
+
+    def __init__(self, sources: list[str], target: str):
+        self._sources = list(sources)
+        self._target = target
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset(self._sources),
+                    outputs=frozenset([self._target]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {
+            self._target: np.concatenate(
+                [group[s] for s in self._sources], axis=0
+            )
+        }
+
+
+def _layer_pairs(config: Qwen3DenseConfig, i: int) -> list[tuple[str, str, bool]]:
+    """(hf_name, d9d_name, transposed) for one decoder layer."""
+    hf = f"model.layers.{i}"
+    us = f"{_P}model.layers_{i}"
+    pairs: list[tuple[str, str, bool]] = []
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        pairs.append(
+            (f"{hf}.self_attn.{proj}.weight", f"{us}.self_attn.{proj}.kernel", True)
+        )
+    if config.use_output_gate:
+        pairs.append(
+            (f"{hf}.self_attn.gate_proj.weight", f"{us}.self_attn.gate_proj.kernel", True)
+        )
+    if config.qk_norm:
+        pairs.append((f"{hf}.self_attn.q_norm.weight", f"{us}.self_attn.q_norm.weight", False))
+        pairs.append((f"{hf}.self_attn.k_norm.weight", f"{us}.self_attn.k_norm.weight", False))
+    if config.use_sinks:
+        pairs.append((f"{hf}.self_attn.sinks", f"{us}.self_attn.sinks", False))
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        pairs.append((f"{hf}.mlp.{proj}.weight", f"{us}.mlp.{proj}.kernel", True))
+    pairs.append((f"{hf}.input_layernorm.weight", f"{us}.input_layernorm.weight", False))
+    pairs.append(
+        (f"{hf}.post_attention_layernorm.weight", f"{us}.post_attention_layernorm.weight", False)
+    )
+    return pairs
+
+
+def qwen3_dense_from_hf_mapper(
+    config: Qwen3DenseConfig,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """HF checkpoint names → d9d_tpu CausalLM param names.
+
+    ``layers``/``include_*`` restrict the mapper to one pipeline stage's
+    params (reference huggingface.py builds stage-aware mappers the same
+    way).
+    """
+    mappers: list[ModelStateMapper] = []
+    if include_embed:
+        mappers.append(
+            _SplitRanges(
+                "model.embed_tokens.weight",
+                [
+                    (f"{_P}model.embed_tokens.embedding_{n}", s)
+                    for n, s in config.vocab_ranges
+                ],
+            )
+        )
+    for i in layers if layers is not None else range(config.num_layers):
+        for hf_name, our_name, transposed in _layer_pairs(config, i):
+            mappers.append(
+                _TransposedRename(hf_name, our_name)
+                if transposed
+                else ModelStateMapperRename(hf_name, our_name)
+            )
+    if include_head:
+        mappers.append(
+            ModelStateMapperRename("model.norm.weight", f"{_P}model.norm.weight")
+        )
+        head_source = (
+            "model.embed_tokens.weight"
+            if tie_word_embeddings
+            else "lm_head.weight"
+        )
+        if tie_word_embeddings and include_embed:
+            # one group reads the embedding and feeds both param families
+            mappers = [
+                m
+                for m in mappers
+                if not isinstance(m, _SplitRanges)
+            ] + [
+                _SplitRangesFanout(
+                    "model.embed_tokens.weight",
+                    [
+                        (f"{_P}model.embed_tokens.embedding_{n}", s)
+                        for n, s in config.vocab_ranges
+                    ],
+                    [
+                        (f"{_P}lm_head.head_{n}", s)
+                        for n, s in config.vocab_ranges
+                    ],
+                )
+            ]
+        else:
+            mappers.append(
+                _SplitRanges(
+                    head_source,
+                    [
+                        (f"{_P}lm_head.head_{n}", s)
+                        for n, s in config.vocab_ranges
+                    ],
+                )
+            )
+    return ModelStateMapperParallel(mappers)
+
+
+class _SplitRangesFanout(ModelStateMapper):
+    """Split one tensor into two parallel families of named ranges (tied
+    embeddings: the same HF table feeds embed_tokens and lm_head)."""
+
+    def __init__(
+        self,
+        source: str,
+        targets_a: list[tuple[str, int]],
+        targets_b: list[tuple[str, int]],
+    ):
+        self._split_a = _SplitRanges(source, targets_a)
+        self._split_b = _SplitRanges(source, targets_b)
+        self._source = source
+        self._outputs = frozenset(
+            [n for n, _ in targets_a] + [n for n, _ in targets_b]
+        )
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source]), outputs=self._outputs
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        out = self._split_a.apply(group)
+        out.update(self._split_b.apply(group))
+        return out
+
+
+def qwen3_dense_to_hf_mapper(
+    config: Qwen3DenseConfig,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """d9d_tpu CausalLM param names → HF checkpoint names."""
+    mappers: list[ModelStateMapper] = []
+    if include_embed:
+        mappers.append(
+            _ConcatRanges(
+                [
+                    f"{_P}model.embed_tokens.embedding_{n}"
+                    for n, _ in config.vocab_ranges
+                ],
+                "model.embed_tokens.weight",
+            )
+        )
+    for i in layers if layers is not None else range(config.num_layers):
+        for hf_name, our_name, transposed in _layer_pairs(config, i):
+            mappers.append(
+                _TransposedRename(our_name, hf_name)
+                if transposed
+                else ModelStateMapperRename(our_name, hf_name)
+            )
+    if include_head:
+        mappers.append(
+            ModelStateMapperRename(f"{_P}model.norm.weight", "model.norm.weight")
+        )
+        if not tie_word_embeddings:
+            mappers.append(
+                _ConcatRanges(
+                    [
+                        f"{_P}lm_head.head_{n}"
+                        for n, _ in config.vocab_ranges
+                    ],
+                    "lm_head.weight",
+                )
+            )
+        # tied: lm_head params are simply not exported
+    return ModelStateMapperParallel(mappers)

@@ -147,3 +147,24 @@ def test_auroc_degenerate():
     m = BinaryAUROCMetric(num_bins=100)
     m.update(np.array([0.3, 0.7]), np.array([1, 1]))
     assert float(m.compute()) == 0.5
+
+
+def test_zero_support_class_matches_sklearn():
+    # class 3 never appears: macro/weighted must not NaN (sklearn
+    # zero_division=0 semantics)
+    skm = _sklearn_metrics()
+    targets = np.array([0, 1, 2, 0, 1])
+    preds = np.eye(4)[targets]  # perfect predictions, class 3 absent
+    for average in ("macro", "weighted", "micro"):
+        m = getattr(
+            ConfusionMatrixMetricBuilder().multiclass(4).with_f1(), average
+        )().build()
+        m.update(preds, targets)
+        expected = skm.f1_score(
+            targets, preds.argmax(-1), average=average, labels=list(range(4)),
+            zero_division=0,
+        )
+        got = float(m.compute())
+        assert not np.isnan(got)
+        if average != "micro":  # micro one-hot counts TNs (see note above)
+            assert got == pytest.approx(expected, abs=1e-9), average

@@ -1,0 +1,133 @@
+"""HF parity: load a transformers Qwen3 checkpoint through the mapper and
+compare logits (reference strategy: block/model-level HF parity tests,
+SURVEY §4.2/§4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.model_state import save_params, load_params, write_model_state_local, identity_mapper_from_names
+from d9d_tpu.model_state.io.reader import read_model_state
+from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.models.qwen3.huggingface import (
+    qwen3_dense_from_hf_mapper,
+    qwen3_dense_to_hf_mapper,
+)
+from d9d_tpu.ops.attention.eager import eager_sdpa
+
+transformers = pytest.importorskip("transformers")
+
+
+VOCAB = 128
+
+
+def _hf_model():
+    torch = pytest.importorskip("torch")
+    cfg = transformers.Qwen3Config(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=64,
+        rope_theta=1_000_000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _our_config():
+    return Qwen3DenseConfig(
+        vocab_ranges=(("default", VOCAB),),
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=96,
+        rope_theta=1_000_000.0,
+        remat=False,
+    )
+
+
+def _save_hf_state(model, tmp_path):
+    state = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    write_model_state_local(
+        tmp_path, identity_mapper_from_names(state.keys()), iter(state.items())
+    )
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    tmp_path = tmp_path_factory.mktemp("hf_ckpt")
+    hf = _hf_model()
+    _save_hf_state(hf, tmp_path)
+
+    cfg = _our_config()
+    model = Qwen3DenseCausalLM(
+        config=cfg, sdpa=eager_sdpa, dtype=jnp.float32
+    )
+    b, t = 2, 16
+    tokens = jnp.zeros((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+    )
+    import flax.linen as nn
+
+    template = nn.unbox(template)
+    params = load_params(
+        tmp_path, template, mapper=qwen3_dense_from_hf_mapper(cfg)
+    )
+    return hf, model, params, cfg, tmp_path
+
+
+def test_logits_match_hf(hf_and_ours):
+    torch = pytest.importorskip("torch")
+    hf, model, params, cfg, _ = hf_and_ours
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(0, VOCAB, size=(2, 16))
+
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens_np)).logits.numpy()
+
+    positions = np.broadcast_to(np.arange(16), (2, 16)).astype(np.int32)
+    ours = model.apply(
+        params,
+        jnp.asarray(tokens_np, jnp.int32),
+        jnp.asarray(positions),
+        method=model.logits,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
+    """Export through the to_hf mapper and compare tensors with the source."""
+    torch = pytest.importorskip("torch")
+    hf, model, params, cfg, _ = hf_and_ours
+    save_params(tmp_path, params, mapper=qwen3_dense_to_hf_mapper(cfg))
+
+    hf_state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    exported = dict(
+        read_model_state(
+            tmp_path, identity_mapper_from_names(hf_state.keys())
+        )
+    )
+    assert set(exported) == set(hf_state)
+    for k in hf_state:
+        np.testing.assert_allclose(
+            exported[k], hf_state[k], rtol=1e-6, atol=1e-6, err_msg=k
+        )

@@ -1,0 +1,135 @@
+"""Streaming IO round-trip tests (reference: model_state/test_dist_io.py
+category, SURVEY §4.6)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.model_state import (
+    MODEL_STATE_INDEX_FILE_NAME,
+    ModelStateMapperRename,
+    ModelStateMapperParallel,
+    identity_mapper_from_names,
+    load_params,
+    read_model_state,
+    save_params,
+    write_model_state_local,
+)
+
+
+def test_write_read_roundtrip(tmp_path):
+    state = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.ones((2, 2), dtype=np.float16),
+        "c": np.array(3, dtype=np.int32),
+    }
+    mapper = identity_mapper_from_names(state.keys())
+    write_model_state_local(tmp_path, mapper, iter(state.items()))
+
+    index = json.loads((tmp_path / MODEL_STATE_INDEX_FILE_NAME).read_text())
+    assert set(index["weight_map"].keys()) == {"a", "b", "c"}
+
+    out = dict(read_model_state(tmp_path, mapper))
+    for k, v in state.items():
+        np.testing.assert_array_equal(out[k], v)
+        assert out[k].dtype == v.dtype
+
+
+def test_shard_spilling(tmp_path):
+    # 4 x 1MB tensors with a ~2MB shard limit -> at least 2 shard files
+    state = {
+        f"t{i}": np.zeros((256, 1024), dtype=np.float32) for i in range(4)
+    }
+    mapper = identity_mapper_from_names(state.keys())
+    write_model_state_local(
+        tmp_path, mapper, iter(state.items()), shard_size_gb=2 / 1024
+    )
+    files = {p.name for p in tmp_path.glob("*.safetensors")}
+    assert len(files) >= 2
+    index = json.loads((tmp_path / MODEL_STATE_INDEX_FILE_NAME).read_text())
+    assert set(index["weight_map"].values()) <= files
+    out = dict(read_model_state(tmp_path, mapper))
+    assert set(out) == set(state)
+
+
+def test_writer_rejects_oversized_tensor(tmp_path):
+    state = {"huge": np.zeros((1024, 1024), dtype=np.float32)}
+    mapper = identity_mapper_from_names(state.keys())
+    with pytest.raises(ValueError, match="larger than shard"):
+        write_model_state_local(
+            tmp_path, mapper, iter(state.items()), shard_size_gb=1 / 1024
+        )
+
+
+def test_writer_detects_missing_inputs(tmp_path):
+    mapper = identity_mapper_from_names(["present", "absent"])
+    with pytest.raises(ValueError, match="Missing inputs"):
+        write_model_state_local(
+            tmp_path, mapper, iter({"present": np.ones(1)}.items())
+        )
+
+
+def test_reader_applies_mapper(tmp_path):
+    state = {"old_name": np.arange(4, dtype=np.float32)}
+    write_model_state_local(
+        tmp_path, identity_mapper_from_names(state.keys()), iter(state.items())
+    )
+    renamed = dict(
+        read_model_state(
+            tmp_path,
+            ModelStateMapperParallel(
+                [ModelStateMapperRename("old_name", "new_name")]
+            ),
+        )
+    )
+    assert set(renamed) == {"new_name"}
+
+
+def test_param_tree_roundtrip(tmp_path):
+    params = {
+        "params": {
+            "dense": {"kernel": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+            "norm": {"weight": jnp.ones(3)},
+        }
+    }
+    save_params(tmp_path, params)
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    loaded = load_params(tmp_path, template)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        loaded,
+    )
+
+
+def test_load_params_with_shardings(tmp_path):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d9d_tpu.core import MeshParameters
+
+    ctx = MeshParameters(dp_shard=4, tp=2).build(jax.devices()[:8])
+    params = {"params": {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4)}}
+    save_params(tmp_path, params)
+    shardings = {
+        "params": {"w": NamedSharding(ctx.mesh, P("dp_s", "tp"))}
+    }
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+    loaded = load_params(tmp_path, template, shardings=shardings)
+    w = loaded["params"]["w"]
+    assert w.sharding.spec == P("dp_s", "tp")
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(params["params"]["w"]))
+
+
+def test_load_params_shape_mismatch(tmp_path):
+    params = {"params": {"w": jnp.ones((2, 2))}}
+    save_params(tmp_path, params)
+    template = {"params": {"w": jax.ShapeDtypeStruct((3, 3), jnp.float32)}}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_params(tmp_path, template)

@@ -1,0 +1,161 @@
+"""Mapper algebra tests (reference: test/d9d_test/model_state/test_mappers.py
+category, SURVEY §4.6)."""
+
+import numpy as np
+import pytest
+
+from d9d_tpu.model_state.mapper import (
+    ModelStateMapperChunkTensors,
+    ModelStateMapperConcatenateTensors,
+    ModelStateMapperIdentity,
+    ModelStateMapperParallel,
+    ModelStateMapperPrefixScope,
+    ModelStateMapperRename,
+    ModelStateMapperSelectChildModules,
+    ModelStateMapperSequential,
+    ModelStateMapperShard,
+    ModelStateMapperStackTensors,
+    ModelStateMapperTranspose,
+    ModelStateMapperUnstackTensors,
+    StateGroup,
+)
+
+
+def _run_all(mapper, state):
+    """Drive a mapper like the IO layer does: fire each group when ready."""
+    out = {}
+    for group in mapper.state_dependency_groups():
+        assert group.inputs <= state.keys(), f"missing {group.inputs}"
+        result = mapper.apply({k: state[k] for k in group.inputs})
+        assert set(result.keys()) == set(group.outputs)
+        out.update(result)
+    return out
+
+
+def test_leaf_rename_transpose():
+    state = {"a": np.arange(6).reshape(2, 3)}
+    out = _run_all(ModelStateMapperRename("a", "b"), state)
+    np.testing.assert_array_equal(out["b"], state["a"])
+    out = _run_all(ModelStateMapperTranspose("a", (0, 1)), state)
+    assert out["a"].shape == (3, 2)
+
+
+def test_stack_unstack_roundtrip():
+    state = {f"w{i}": np.full((2, 2), i) for i in range(3)}
+    stacked = _run_all(
+        ModelStateMapperStackTensors(["w0", "w1", "w2"], "stacked", 0), state
+    )
+    assert stacked["stacked"].shape == (3, 2, 2)
+    unstacked = _run_all(
+        ModelStateMapperUnstackTensors("stacked", ["w0", "w1", "w2"], 0),
+        stacked,
+    )
+    for i in range(3):
+        np.testing.assert_array_equal(unstacked[f"w{i}"], state[f"w{i}"])
+
+
+def test_chunk_concat_roundtrip():
+    state = {"big": np.arange(12).reshape(6, 2)}
+    chunked = _run_all(
+        ModelStateMapperChunkTensors("big", ["c0", "c1", "c2"], 0), state
+    )
+    assert all(chunked[f"c{i}"].shape == (2, 2) for i in range(3))
+    merged = _run_all(
+        ModelStateMapperConcatenateTensors(["c0", "c1", "c2"], "big", 0),
+        chunked,
+    )
+    np.testing.assert_array_equal(merged["big"], state["big"])
+
+
+def test_select_child_modules():
+    m = ModelStateMapperSelectChildModules(["w", "b"], "encoder")
+    state = {"encoder.w": np.ones(2), "encoder.b": np.zeros(2)}
+    out = _run_all(m, state)
+    assert set(out) == {"w", "b"}
+
+
+def test_parallel_collision_detection():
+    with pytest.raises(ValueError, match="colliding"):
+        ModelStateMapperParallel(
+            [ModelStateMapperIdentity("x"), ModelStateMapperRename("x", "y")]
+        )
+    with pytest.raises(ValueError, match="colliding"):
+        ModelStateMapperParallel(
+            [
+                ModelStateMapperRename("a", "out"),
+                ModelStateMapperRename("b", "out"),
+            ]
+        )
+
+
+def test_sequential_chains_groups():
+    # A: {x}->{y}, B: {y}->{z} reports net {x}->{z}
+    seq = ModelStateMapperSequential(
+        [ModelStateMapperRename("x", "y"), ModelStateMapperRename("y", "z")]
+    )
+    groups = seq.state_dependency_groups()
+    assert groups == frozenset(
+        [StateGroup(inputs=frozenset(["x"]), outputs=frozenset(["z"]))]
+    )
+    out = _run_all(seq, {"x": np.ones(3)})
+    assert set(out) == {"z"}
+
+
+def test_sequential_gap_filling():
+    # stage 1 only touches 'a'; 'b' must pass through to stage 2
+    seq = ModelStateMapperSequential(
+        [
+            ModelStateMapperRename("a", "a2"),
+            ModelStateMapperConcatenateTensors(["a2", "b"], "cat", 0),
+        ]
+    )
+    out = _run_all(seq, {"a": np.ones((1, 2)), "b": np.zeros((1, 2))})
+    assert out["cat"].shape == (2, 2)
+
+
+def test_sequential_transitive_merge():
+    # chunk feeds two downstream groups -> one merged net group
+    seq = ModelStateMapperSequential(
+        [
+            ModelStateMapperChunkTensors("src", ["p", "q"], 0),
+            ModelStateMapperParallel(
+                [
+                    ModelStateMapperRename("p", "p_out"),
+                    ModelStateMapperRename("q", "q_out"),
+                ]
+            ),
+        ]
+    )
+    groups = seq.state_dependency_groups()
+    assert groups == frozenset(
+        [
+            StateGroup(
+                inputs=frozenset(["src"]),
+                outputs=frozenset(["p_out", "q_out"]),
+            )
+        ]
+    )
+    out = _run_all(seq, {"src": np.arange(4).reshape(2, 2)})
+    np.testing.assert_array_equal(out["p_out"], [[0, 1]])
+    np.testing.assert_array_equal(out["q_out"], [[2, 3]])
+
+
+def test_prefix_scope():
+    scoped = ModelStateMapperPrefixScope(
+        ModelStateMapperRename("w", "weight"),
+        source_prefix="hf.",
+        target_prefix="ours.",
+    )
+    out = _run_all(scoped, {"hf.w": np.ones(1)})
+    assert set(out) == {"ours.weight"}
+
+
+def test_shard_partitions_groups():
+    inner = ModelStateMapperParallel(
+        [ModelStateMapperIdentity(f"t{i}") for i in range(5)]
+    )
+    shards = [ModelStateMapperShard(inner, 2, i) for i in range(2)]
+    g0 = shards[0].state_dependency_groups()
+    g1 = shards[1].state_dependency_groups()
+    assert len(g0) + len(g1) == 5
+    assert g0.isdisjoint(g1)
