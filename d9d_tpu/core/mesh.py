@@ -160,7 +160,18 @@ class MeshParameters:
                     f"got {len(devices)}"
                 )
             dev_array = np.asarray(devices).reshape(self.axis_sizes)
-            mesh = Mesh(dev_array, MESH_AXIS_NAMES)
+            mesh = Mesh(
+                dev_array,
+                MESH_AXIS_NAMES,
+                axis_types=(jax.sharding.AxisType.Auto,) * len(MESH_AXIS_NAMES),
+            )
+        # Make the mesh ambient: shard_map/get_abstract_mesh inside modules
+        # (e.g. the MoE EP path) resolve it without explicit plumbing.
+        # NOTE: the most recently built mesh wins process-wide — a model
+        # bound to an earlier mesh must not be applied after a second
+        # build() with different axis sizes (the EP path validates axis
+        # sizes and fails loudly on mismatch).
+        jax.set_mesh(mesh)
         return MeshContext(params=self, mesh=mesh)
 
 

@@ -28,7 +28,14 @@ def init_sharded_params(
 ) -> tuple[PyTree, PyTree]:
     """Returns (params, shardings); params are unboxed jax.Arrays already
     placed according to ``plan``."""
-    init_fn = functools.partial(module.init, rng, *sample_inputs)
+    raw_init = functools.partial(module.init, rng, *sample_inputs)
+
+    def init_fn():
+        variables = raw_init()
+        # drop transient sown stats (e.g. MoE tokens_per_expert): they are
+        # re-collected per step via mutable apply, not trained state
+        return {k: v for k, v in variables.items() if k != "moe_stats"}
+
     abstract = jax.eval_shape(init_fn)
     logical_spec = nn.get_partition_spec(abstract)
     shardings = logical_to_mesh_sharding(logical_spec, ctx.mesh, plan.rules)

@@ -62,6 +62,11 @@ class OneHotProcessor:
         classes = np.arange(self._num_classes)
 
         def one_hot(idx):
+            if idx.size and (idx.min() < 0 or idx.max() >= self._num_classes):
+                raise ValueError(
+                    f"targets out of range [0, {self._num_classes}): "
+                    f"min={idx.min()}, max={idx.max()}"
+                )
             return (idx[..., None] == classes).astype(np.int64)
 
         preds_one_hot = one_hot(np.argmax(preds, axis=-1))
@@ -222,7 +227,9 @@ def aggregate(
             case AggregationMethod.WEIGHTED:
                 scores = np.nan_to_num(statistic(matrix))
                 supports = matrix.tp + matrix.fn
-                return (scores * supports).sum() / supports.sum()
+                return np.nan_to_num(
+                    (scores * supports).sum() / supports.sum()
+                )
             case AggregationMethod.NONE:
                 return np.nan_to_num(statistic(matrix))
     raise ValueError(f"Unknown aggregation method: {method}")

@@ -206,6 +206,9 @@ def write_model_state_distributed(
     if is_master:
         assert index is not None
         _finalize_master(dest_dir, [index])
+    # barrier: no process may observe the directory before the master
+    # finished renaming shards + writing the index
+    host_allgather_object(None)
 
 
 def write_model_state_pipeline_parallel(

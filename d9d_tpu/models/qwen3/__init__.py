@@ -1,4 +1,12 @@
 from d9d_tpu.models.qwen3.config import Qwen3DenseConfig
+from d9d_tpu.models.qwen3.moe import (
+    Qwen3MoeBackbone,
+    Qwen3MoeCausalLM,
+    Qwen3MoeConfig,
+    Qwen3MoeDecoderLayer,
+    Qwen3MoeForClassification,
+    Qwen3MoeForEmbedding,
+)
 from d9d_tpu.models.qwen3.dense import (
     Qwen3DenseBackbone,
     Qwen3DenseCausalLM,
@@ -12,4 +20,10 @@ __all__ = [
     "Qwen3DenseCausalLM",
     "Qwen3DenseForClassification",
     "Qwen3DenseForEmbedding",
+    "Qwen3MoeBackbone",
+    "Qwen3MoeCausalLM",
+    "Qwen3MoeConfig",
+    "Qwen3MoeDecoderLayer",
+    "Qwen3MoeForClassification",
+    "Qwen3MoeForEmbedding",
 ]

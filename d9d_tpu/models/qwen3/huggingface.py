@@ -1,4 +1,4 @@
-"""Bidirectional HuggingFace ⇄ d9d_tpu state mappers for Qwen3-dense.
+"""Bidirectional HuggingFace ⇄ d9d_tpu state mappers for Qwen3 dense + MoE.
 
 Parity: reference d9d/module/model/qwen3_dense/huggingface.py (234 LoC of
 bidirectional mappers). Layout differences handled here:
@@ -103,6 +103,45 @@ class _ConcatRanges(ModelStateMapper):
             )
         }
 
+def _embed_head_from_hf_mappers(
+    config,
+    *,
+    tie_word_embeddings: bool,
+    include_embed: bool,
+    include_head: bool,
+) -> list[ModelStateMapper]:
+    """Shared embed/norm/head mappers for the HF->d9d direction, handling
+    the tied-embedding fanout up front (one group feeds both families)."""
+    embed_targets = [
+        (f"{_P}model.embed_tokens.embedding_{n}", s)
+        for n, s in config.vocab_ranges
+    ]
+    head_targets = [
+        (f"{_P}lm_head.head_{n}", s) for n, s in config.vocab_ranges
+    ]
+    mappers: list[ModelStateMapper] = []
+    if include_head:
+        mappers.append(
+            ModelStateMapperRename("model.norm.weight", f"{_P}model.norm.weight")
+        )
+    if tie_word_embeddings and include_embed and include_head:
+        mappers.append(
+            _SplitRangesFanout(
+                "model.embed_tokens.weight", embed_targets, head_targets
+            )
+        )
+        return mappers
+    if include_embed:
+        mappers.append(_SplitRanges("model.embed_tokens.weight", embed_targets))
+    if include_head:
+        source = (
+            "model.embed_tokens.weight"
+            if tie_word_embeddings
+            else "lm_head.weight"
+        )
+        mappers.append(_SplitRanges(source, head_targets))
+    return mappers
+
 
 def _layer_pairs(config: Qwen3DenseConfig, i: int) -> list[tuple[str, str, bool]]:
     """(hf_name, d9d_name, transposed) for one decoder layer."""
@@ -145,61 +184,18 @@ def qwen3_dense_from_hf_mapper(
     params (reference huggingface.py builds stage-aware mappers the same
     way).
     """
-    mappers: list[ModelStateMapper] = []
-    if include_embed:
-        mappers.append(
-            _SplitRanges(
-                "model.embed_tokens.weight",
-                [
-                    (f"{_P}model.embed_tokens.embedding_{n}", s)
-                    for n, s in config.vocab_ranges
-                ],
-            )
-        )
+    mappers = _embed_head_from_hf_mappers(
+        config,
+        tie_word_embeddings=tie_word_embeddings,
+        include_embed=include_embed,
+        include_head=include_head,
+    )
     for i in layers if layers is not None else range(config.num_layers):
         for hf_name, our_name, transposed in _layer_pairs(config, i):
             mappers.append(
                 _TransposedRename(hf_name, our_name)
                 if transposed
                 else ModelStateMapperRename(hf_name, our_name)
-            )
-    if include_head:
-        mappers.append(
-            ModelStateMapperRename("model.norm.weight", f"{_P}model.norm.weight")
-        )
-        head_source = (
-            "model.embed_tokens.weight"
-            if tie_word_embeddings
-            else "lm_head.weight"
-        )
-        if tie_word_embeddings and include_embed:
-            # one group reads the embedding and feeds both param families
-            mappers = [
-                m
-                for m in mappers
-                if not isinstance(m, _SplitRanges)
-            ] + [
-                _SplitRangesFanout(
-                    "model.embed_tokens.weight",
-                    [
-                        (f"{_P}model.embed_tokens.embedding_{n}", s)
-                        for n, s in config.vocab_ranges
-                    ],
-                    [
-                        (f"{_P}lm_head.head_{n}", s)
-                        for n, s in config.vocab_ranges
-                    ],
-                )
-            ]
-        else:
-            mappers.append(
-                _SplitRanges(
-                    head_source,
-                    [
-                        (f"{_P}lm_head.head_{n}", s)
-                        for n, s in config.vocab_ranges
-                    ],
-                )
             )
     return ModelStateMapperParallel(mappers)
 
@@ -278,4 +274,193 @@ def qwen3_dense_to_hf_mapper(
                 )
             )
         # tied: lm_head params are simply not exported
+    return ModelStateMapperParallel(mappers)
+
+
+# --- Qwen3-MoE ------------------------------------------------------------
+# Reference: d9d/module/model/qwen3_moe/huggingface.py:118,290 (incl. the
+# v4 ModuleList experts format: one [out,in] weight per expert, stacked
+# here into our grouped [E, in, out] layout).
+
+
+class _StackExpertsTransposed(ModelStateMapper):
+    """E per-expert [out,in] weights → one grouped [E, in, out] tensor."""
+
+    def __init__(self, sources: list[str], target: str):
+        self._sources = list(sources)
+        self._target = target
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset(self._sources),
+                    outputs=frozenset([self._target]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        stacked = np.stack(
+            [np.swapaxes(group[s], 0, 1) for s in self._sources], axis=0
+        )
+        return {self._target: np.ascontiguousarray(stacked)}
+
+
+class _UnstackExpertsTransposed(ModelStateMapper):
+    """Inverse of _StackExpertsTransposed."""
+
+    def __init__(self, source: str, targets: list[str]):
+        self._source = source
+        self._targets = list(targets)
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source]),
+                    outputs=frozenset(self._targets),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        tensor = np.asarray(group[self._source])
+        return {
+            name: np.ascontiguousarray(np.swapaxes(tensor[e], 0, 1))
+            for e, name in enumerate(self._targets)
+        }
+
+
+def _moe_attention_pairs(config, i: int) -> list[tuple[str, str, bool]]:
+    hf = f"model.layers.{i}"
+    us = f"{_P}model.layers_{i}"
+    pairs: list[tuple[str, str, bool]] = []
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        pairs.append(
+            (f"{hf}.self_attn.{proj}.weight", f"{us}.self_attn.{proj}.kernel", True)
+        )
+    if config.qk_norm:
+        pairs.append((f"{hf}.self_attn.q_norm.weight", f"{us}.self_attn.q_norm.weight", False))
+        pairs.append((f"{hf}.self_attn.k_norm.weight", f"{us}.self_attn.k_norm.weight", False))
+    pairs.append((f"{hf}.input_layernorm.weight", f"{us}.input_layernorm.weight", False))
+    pairs.append(
+        (f"{hf}.post_attention_layernorm.weight", f"{us}.post_attention_layernorm.weight", False)
+    )
+    return pairs
+
+
+def qwen3_moe_from_hf_mapper(
+    config,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """HF Qwen3MoE checkpoint names → d9d_tpu Qwen3MoeCausalLM params."""
+    mappers = _embed_head_from_hf_mappers(
+        config,
+        tie_word_embeddings=tie_word_embeddings,
+        include_embed=include_embed,
+        include_head=include_head,
+    )
+    for i in layers if layers is not None else range(config.num_layers):
+        hf = f"model.layers.{i}"
+        us = f"{_P}model.layers_{i}"
+        for hf_name, our_name, transposed in _moe_attention_pairs(config, i):
+            mappers.append(
+                _TransposedRename(hf_name, our_name)
+                if transposed
+                else ModelStateMapperRename(hf_name, our_name)
+            )
+        if i in config.mlp_only_layers:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mappers.append(
+                    _TransposedRename(
+                        f"{hf}.mlp.{proj}.weight", f"{us}.mlp.{proj}.kernel"
+                    )
+                )
+        else:
+            mappers.append(
+                _TransposedRename(
+                    f"{hf}.mlp.gate.weight", f"{us}.mlp.router.gate.kernel"
+                )
+            )
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mappers.append(
+                    _StackExpertsTransposed(
+                        [
+                            f"{hf}.mlp.experts.{e}.{proj}.weight"
+                            for e in range(config.num_experts)
+                        ],
+                        f"{us}.mlp.grouped_experts.{proj}",
+                    )
+                )
+    return ModelStateMapperParallel(mappers)
+
+
+def qwen3_moe_to_hf_mapper(
+    config,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """d9d_tpu Qwen3MoeCausalLM params → HF Qwen3MoE checkpoint names."""
+    mappers: list[ModelStateMapper] = []
+    if include_embed:
+        mappers.append(
+            _ConcatRanges(
+                [
+                    f"{_P}model.embed_tokens.embedding_{n}"
+                    for n, _ in config.vocab_ranges
+                ],
+                "model.embed_tokens.weight",
+            )
+        )
+    for i in layers if layers is not None else range(config.num_layers):
+        hf = f"model.layers.{i}"
+        us = f"{_P}model.layers_{i}"
+        for hf_name, our_name, transposed in _moe_attention_pairs(config, i):
+            mappers.append(
+                _TransposedRename(our_name, hf_name)
+                if transposed
+                else ModelStateMapperRename(our_name, hf_name)
+            )
+        if i in config.mlp_only_layers:
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mappers.append(
+                    _TransposedRename(
+                        f"{us}.mlp.{proj}.kernel", f"{hf}.mlp.{proj}.weight"
+                    )
+                )
+        else:
+            mappers.append(
+                _TransposedRename(
+                    f"{us}.mlp.router.gate.kernel", f"{hf}.mlp.gate.weight"
+                )
+            )
+            for proj in ("gate_proj", "up_proj", "down_proj"):
+                mappers.append(
+                    _UnstackExpertsTransposed(
+                        f"{us}.mlp.grouped_experts.{proj}",
+                        [
+                            f"{hf}.mlp.experts.{e}.{proj}.weight"
+                            for e in range(config.num_experts)
+                        ],
+                    )
+                )
+    if include_head:
+        mappers.append(
+            ModelStateMapperRename(f"{_P}model.norm.weight", "model.norm.weight")
+        )
+        if not tie_word_embeddings:
+            mappers.append(
+                _ConcatRanges(
+                    [f"{_P}lm_head.head_{n}" for n, _ in config.vocab_ranges],
+                    "lm_head.weight",
+                )
+            )
     return ModelStateMapperParallel(mappers)

@@ -1,0 +1,322 @@
+"""Qwen3-MoE model family: stage-aware backbone + task heads.
+
+Reference: d9d/module/model/qwen3_moe/model.py:29,221,322,425 and
+params.py:4-93. Same structure as the dense family, with the FFN replaced
+by an MoE layer (all layers sparse by default; ``mlp_only_layers`` keeps
+specific layers dense, matching HF Qwen3MoE semantics).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn.attention import GroupedQueryAttention
+from d9d_tpu.nn.embedding import TokenEmbedding
+from d9d_tpu.nn.heads import (
+    ClassificationHead,
+    EmbeddingHead,
+    LanguageModellingHead,
+)
+from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.nn.moe import MoELayer, SharedExpertParameters
+from d9d_tpu.nn.norm import RMSNorm
+from d9d_tpu.nn.sdpa.protocol import SdpaBackend
+from d9d_tpu.ops import (
+    RopeScaling,
+    RopeScalingNone,
+    compute_rope_frequencies,
+    make_rope_cos_sin,
+)
+from d9d_tpu.pipelining import (
+    PipelineStageInfo,
+    distribute_layers_for_pipeline_stage,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen3MoeConfig:
+    vocab_ranges: tuple[tuple[str, int], ...]
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    moe_intermediate_size: int
+    num_experts: int
+    num_experts_per_tok: int
+    # dense FFN width for layers listed in mlp_only_layers
+    intermediate_size: int = 0
+    mlp_only_layers: tuple[int, ...] = ()
+    shared_expert: Optional[SharedExpertParameters] = None
+    norm_topk_prob: bool = True
+    rope_theta: float = 1_000_000.0
+    rope_scaling: RopeScaling = RopeScalingNone()
+    qk_norm: bool = True
+    norm_eps: float = 1e-6
+    remat: bool = True
+    # mesh axes carrying expert parallelism; None = local experts
+    ep_axes: Optional[tuple[str, ...]] = None
+
+    @property
+    def vocab_size(self) -> int:
+        return sum(s for _, s in self.vocab_ranges)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, ep_axes=None) -> "Qwen3MoeConfig":
+        return Qwen3MoeConfig(
+            vocab_ranges=(("default", vocab_size),),
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            moe_intermediate_size=64,
+            num_experts=8,
+            num_experts_per_tok=2,
+            remat=False,
+            ep_axes=ep_axes,
+        )
+
+    @staticmethod
+    def qwen3_30b_a3b(vocab_size: int = 151_936, ep_axes=None) -> "Qwen3MoeConfig":
+        """Qwen3-30B-A3B geometry (flagship MoE, BASELINE config 3)."""
+        return Qwen3MoeConfig(
+            vocab_ranges=(("default", vocab_size),),
+            hidden_size=2048,
+            num_layers=48,
+            num_heads=32,
+            num_kv_heads=4,
+            head_dim=128,
+            moe_intermediate_size=768,
+            num_experts=128,
+            num_experts_per_tok=8,
+            ep_axes=ep_axes,
+        )
+
+
+class Qwen3MoeDecoderLayer(nn.Module):
+    config: Qwen3MoeConfig
+    sdpa: SdpaBackend
+    layer_idx: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: Array, cos: Array, sin: Array, mask: Optional[Array] = None
+    ) -> Array:
+        cfg = self.config
+        attn_out = GroupedQueryAttention(
+            hidden_size=cfg.hidden_size,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            sdpa=self.sdpa,
+            qk_norm=cfg.qk_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="self_attn",
+        )(
+            RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="input_layernorm")(x),
+            cos,
+            sin,
+            mask,
+        )
+        x = x + attn_out
+        h = RMSNorm(
+            cfg.hidden_size, eps=cfg.norm_eps, name="post_attention_layernorm"
+        )(x)
+        if self.layer_idx in cfg.mlp_only_layers:
+            mlp_out = SwiGLU(
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="mlp",
+            )(h)
+        else:
+            mlp_out = MoELayer(
+                hidden_dim=cfg.hidden_size,
+                intermediate_dim_grouped=cfg.moe_intermediate_size,
+                num_grouped_experts=cfg.num_experts,
+                top_k=cfg.num_experts_per_tok,
+                router_renormalize_probabilities=cfg.norm_topk_prob,
+                shared_expert=cfg.shared_expert,
+                ep_axes=cfg.ep_axes,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="mlp",
+            )(h)
+        return x + mlp_out
+
+
+class Qwen3MoeBackbone(nn.Module):
+    config: Qwen3MoeConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        cfg = self.config
+        if self.stage.is_first:
+            x = TokenEmbedding(
+                vocab_ranges=cfg.vocab_ranges,
+                hidden_size=cfg.hidden_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="embed_tokens",
+            )(x)
+        else:
+            x = x.astype(self.dtype)
+
+        inv_freq, att_scale = compute_rope_frequencies(
+            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
+        cos, sin = make_rope_cos_sin(positions, inv_freq, att_scale)
+
+        layer_cls = Qwen3MoeDecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(Qwen3MoeDecoderLayer, prevent_cse=False)
+
+        for gid in distribute_layers_for_pipeline_stage(cfg.num_layers, self.stage):
+            x = layer_cls(
+                config=cfg,
+                sdpa=self.sdpa,
+                layer_idx=gid,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name=f"layers_{gid}",
+            )(x, cos, sin, mask)
+
+        if self.stage.is_last:
+            x = RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="norm")(x)
+        return x
+
+
+class Qwen3MoeCausalLM(nn.Module):
+    """Backbone + fused-CE LM head (reference model.py:221)."""
+
+    config: Qwen3MoeConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    ce_chunk_size: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        self.model = Qwen3MoeBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        if self.stage.is_last:
+            self.lm_head = LanguageModellingHead(
+                vocab_ranges=self.config.vocab_ranges,
+                hidden_size=self.config.hidden_size,
+                ce_chunk_size=self.ce_chunk_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        labels: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = self.model(x, positions, mask)
+        if self.stage.is_last and labels is not None:
+            return self.lm_head(h, labels)
+        return h
+
+    def logits(
+        self, x: Array, positions: Array, mask: Optional[Array] = None
+    ) -> Array:
+        h = self.model(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        return self.lm_head.logits(h)
+
+
+class Qwen3MoeForClassification(nn.Module):
+    """Backbone + last-token classification head (reference model.py:322)."""
+
+    config: Qwen3MoeConfig
+    sdpa: SdpaBackend
+    num_classes: int = 2
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        pooling_mask: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = Qwen3MoeBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="model",
+        )(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        if pooling_mask is None:
+            pooled = h[:, -1]
+        else:
+            idx = jnp.maximum(pooling_mask.sum(axis=-1) - 1, 0)
+            pooled = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+        return ClassificationHead(
+            hidden_size=self.config.hidden_size,
+            num_classes=self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(pooled)
+
+
+class Qwen3MoeForEmbedding(nn.Module):
+    """Backbone + pooled L2-normalized embedding head (reference model.py:425)."""
+
+    config: Qwen3MoeConfig
+    sdpa: SdpaBackend
+    stage: PipelineStageInfo = PipelineStageInfo()
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        positions: Array,
+        pooling_mask: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        h = Qwen3MoeBackbone(
+            config=self.config,
+            sdpa=self.sdpa,
+            stage=self.stage,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="model",
+        )(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        return EmbeddingHead()(h, pooling_mask)

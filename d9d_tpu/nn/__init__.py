@@ -3,6 +3,13 @@ from d9d_tpu.nn.decoder import DecoderLayer
 from d9d_tpu.nn.embedding import TokenEmbedding
 from d9d_tpu.nn.heads import ClassificationHead, EmbeddingHead, LanguageModellingHead
 from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.nn.moe import (
+    GroupedSwiGLU,
+    MoELayer,
+    SharedExpertParameters,
+    SharedSwiGLU,
+    TopKRouter,
+)
 from d9d_tpu.nn.norm import RMSNorm
 
 __all__ = [
@@ -13,5 +20,10 @@ __all__ = [
     "EmbeddingHead",
     "LanguageModellingHead",
     "SwiGLU",
+    "GroupedSwiGLU",
+    "MoELayer",
+    "SharedExpertParameters",
+    "SharedSwiGLU",
+    "TopKRouter",
     "RMSNorm",
 ]

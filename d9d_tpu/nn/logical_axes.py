@@ -23,5 +23,9 @@ KV_HEADS = "kv_heads"
 HEAD_DIM = "head_dim"
 # Expert index dim of MoE grouped weights.
 EXPERT = "expert"
+# In/out feature dims of grouped expert weights (distinct from dense
+# EMBED/MLP so EP plans can leave them unsharded while FSDP shards dense).
+EXPERT_EMBED = "expert_embed"
+EXPERT_MLP = "expert_mlp"
 # Classification classes.
 CLASSES = "classes"

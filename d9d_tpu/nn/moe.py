@@ -1,0 +1,401 @@
+"""Mixture-of-Experts stack: router, grouped experts, shared expert, layer.
+
+Parity: reference d9d/module/block/moe/* (router.py:23, grouped_linear.py:12,
+grouped_experts.py:10, shared_expert.py:21, layer.py:16) and its
+communication handlers (communications/{naive,deepep}.py).
+
+TPU-native design:
+- Grouped GEMM is ``lax.ragged_dot`` on expert-sorted rows (static N·K
+  shape) instead of the nv-grouped-gemm wheel.
+- The local (no-EP) path is the reference's NoCommunicationHandler: a
+  stable argsort permute, expert compute, scatter-add combine.
+- The EP path replaces DeepEP's NVSHMEM all-to-all with an
+  all-gather → compute-local-experts → reduce-scatter flow inside a
+  partial-manual ``shard_map`` over the expert mesh axes. On ICI this is
+  bandwidth-comparable to an all-to-all for k≈8 while being dropless and
+  fully differentiable (the VJP of all_gather is psum_scatter and vice
+  versa, so the backward re-crosses the network exactly like DeepEP's
+  dispatch/combine backward pair, deepep.py:91-150).
+- Load stats are sown into the ``moe_stats`` collection instead of a
+  mutable buffer (layer.py:16 tokens_per_expert).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn import logical_axes as la
+from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.ops.moe import (
+    grouped_matmul,
+    permute_tokens,
+    sort_tokens_by_expert,
+    unpermute_combine,
+)
+from d9d_tpu.ops.swiglu import silu_mul
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedExpertParameters:
+    """Config for the optional shared expert (reference shared_expert.py:8)."""
+
+    intermediate_size: int
+    enable_gate: bool = False
+
+
+class TopKRouter(nn.Module):
+    """Softmax gate → optional expert bias → top-k → optional renorm.
+
+    Reference router.py:23. The expert bias (loss-free load balancing) is a
+    non-trainable variable in the ``moe_buffers`` collection, updated
+    outside the gradient path.
+    """
+
+    dim: int
+    num_experts: int
+    top_k: int
+    renormalize_probabilities: bool = True
+    enable_expert_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden: Array) -> tuple[Array, Array]:
+        """hidden [N, D] → (indices [N, K] int32, probs [N, K] fp32)."""
+        scores = nn.Dense(
+            self.num_experts,
+            use_bias=False,
+            name="gate",
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (la.EMBED, None)
+            ),
+        )(hidden)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+
+        if self.enable_expert_bias:
+            bias = self.variable(
+                "moe_buffers",
+                "expert_bias",
+                lambda: jnp.zeros((self.num_experts,), jnp.float32),
+            ).value
+            _, selected_idx = lax.top_k(probs + bias, self.top_k)
+            selected_probs = jnp.take_along_axis(probs, selected_idx, axis=-1)
+        else:
+            selected_probs, selected_idx = lax.top_k(probs, self.top_k)
+
+        if self.renormalize_probabilities:
+            selected_probs = selected_probs / (
+                selected_probs.sum(axis=-1, keepdims=True) + 1e-20
+            )
+        return selected_idx.astype(jnp.int32), selected_probs
+
+
+class GroupedSwiGLU(nn.Module):
+    """E parallel SwiGLU experts over grouped GEMM (reference
+    grouped_experts.py:10 + grouped_linear.py:12). Weights are [E, in, out]
+    with the ``expert`` logical axis on dim 0 so an EP plan shards experts
+    across the expert mesh axes."""
+
+    hidden_dim: int
+    intermediate_dim: int
+    num_experts: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        def weight(name, din, dout, ax_in, ax_out):
+            init = nn.initializers.variance_scaling(
+                1.0 / 3.0, "fan_in", "uniform", in_axis=1, out_axis=2
+            )
+            return self.param(
+                name,
+                nn.with_logical_partitioning(init, (la.EXPERT, ax_in, ax_out)),
+                (self.num_experts, din, dout),
+                self.param_dtype,
+            )
+
+        self.gate_weight = weight(
+            "gate_proj",
+            self.hidden_dim,
+            self.intermediate_dim,
+            la.EXPERT_EMBED,
+            la.EXPERT_MLP,
+        )
+        self.up_weight = weight(
+            "up_proj",
+            self.hidden_dim,
+            self.intermediate_dim,
+            la.EXPERT_EMBED,
+            la.EXPERT_MLP,
+        )
+        self.down_weight = weight(
+            "down_proj",
+            self.intermediate_dim,
+            self.hidden_dim,
+            la.EXPERT_MLP,
+            la.EXPERT_EMBED,
+        )
+
+    def __call__(
+        self, permuted_x: Array, permuted_probs: Array, group_sizes: Array
+    ) -> Array:
+        """Expert-sorted rows [M, D] + probs [M] → weighted outputs [M, D]."""
+        return grouped_swiglu_apply(
+            permuted_x,
+            permuted_probs,
+            group_sizes,
+            self.gate_weight,
+            self.up_weight,
+            self.down_weight,
+            self.dtype,
+        )
+
+
+def grouped_swiglu_apply(
+    permuted_x: Array,
+    permuted_probs: Array,
+    group_sizes: Array,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    dtype: jnp.dtype,
+) -> Array:
+    """Functional core shared by the local path and the EP shard_map body."""
+    x = permuted_x.astype(dtype)
+    gate_w = gate_w.astype(dtype)
+    up_w = up_w.astype(dtype)
+    down_w = down_w.astype(dtype)
+    hidden = silu_mul(
+        grouped_matmul(x, gate_w, group_sizes),
+        grouped_matmul(x, up_w, group_sizes),
+    )
+    out = grouped_matmul(hidden, down_w, group_sizes)
+    return out * permuted_probs[:, None].astype(dtype)
+
+
+class SharedSwiGLU(nn.Module):
+    """Always-on expert with optional sigmoid gate (reference
+    shared_expert.py:21)."""
+
+    hidden_size: int
+    params_config: SharedExpertParameters
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        out = SwiGLU(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.params_config.intermediate_size,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="expert",
+        )(x)
+        if self.params_config.enable_gate:
+            gate = nn.Dense(
+                1,
+                use_bias=False,
+                name="gate",
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            out = out * nn.sigmoid(gate)
+        return out
+
+
+class MoELayer(nn.Module):
+    """Router + dispatch + grouped experts + combine (+ shared expert).
+
+    ``ep_axes`` selects the communication handler, mirroring the
+    reference's enable_distributed_communicator (layer.py:67):
+    - None → local permute only (NoCommunicationHandler).
+    - mesh axis tuple → shard_map EP flow over those axes. Tokens must be
+      sharded over ``ep_axes`` on the batch dim and expert weights on the
+      expert dim (the EP plan arranges both).
+    """
+
+    hidden_dim: int
+    intermediate_dim_grouped: int
+    num_grouped_experts: int
+    top_k: int
+    router_renormalize_probabilities: bool = True
+    router_enable_expert_bias: bool = False
+    shared_expert: Optional[SharedExpertParameters] = None
+    ep_axes: Optional[tuple[str, ...]] = None
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        self.router = TopKRouter(
+            dim=self.hidden_dim,
+            num_experts=self.num_grouped_experts,
+            top_k=self.top_k,
+            renormalize_probabilities=self.router_renormalize_probabilities,
+            enable_expert_bias=self.router_enable_expert_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.grouped_experts = GroupedSwiGLU(
+            hidden_dim=self.hidden_dim,
+            intermediate_dim=self.intermediate_dim_grouped,
+            num_experts=self.num_grouped_experts,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        if self.shared_expert is not None:
+            self.shared_expert_module = SharedSwiGLU(
+                hidden_size=self.hidden_dim,
+                params_config=self.shared_expert,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+
+    def __call__(self, hidden: Array) -> Array:
+        """[B, T, D] → [B, T, D]."""
+        orig_shape = hidden.shape
+        x = hidden.reshape(-1, orig_shape[-1])
+
+        shared = None
+        if self.shared_expert is not None:
+            shared = self.shared_expert_module(x)
+
+        topk_ids, topk_probs = self.router(x)
+
+        # load-balancing stats (reference tokens_per_expert buffer):
+        # collected when callers apply with mutable=["moe_stats"]
+        self.sow(
+            "moe_stats",
+            "tokens_per_expert",
+            jnp.bincount(
+                topk_ids.reshape(-1), length=self.num_grouped_experts
+            ),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros(
+                (self.num_grouped_experts,), jnp.int32
+            ),
+        )
+
+        if self.ep_axes is None:
+            out = self._forward_local(x, topk_ids, topk_probs)
+        else:
+            out = self._forward_ep(x, topk_ids, topk_probs)
+
+        if shared is not None:
+            out = out + shared
+        return out.reshape(orig_shape)
+
+    # --- local permute path (reference communications/naive.py) ----------
+
+    def _forward_local(
+        self, x: Array, topk_ids: Array, topk_probs: Array
+    ) -> Array:
+        sort = sort_tokens_by_expert(topk_ids, self.num_grouped_experts)
+        permuted_x, permuted_probs = permute_tokens(x, topk_probs, sort)
+        y = self.grouped_experts(permuted_x, permuted_probs, sort.group_sizes)
+        return unpermute_combine(y, sort, x.shape[0]).astype(x.dtype)
+
+    # --- EP path (reference communications/deepep.py, re-designed) -------
+
+    def _forward_ep(
+        self, x: Array, topk_ids: Array, topk_probs: Array
+    ) -> Array:
+        ep_axes = tuple(self.ep_axes)
+        mesh = jax.sharding.get_abstract_mesh()
+        if not mesh.shape:
+            raise RuntimeError(
+                "MoE EP path needs an ambient mesh; build it via "
+                "MeshParameters.build() (which calls jax.set_mesh)"
+            )
+        missing = [a for a in ep_axes if a not in mesh.shape]
+        if missing:
+            raise ValueError(
+                f"ep_axes {missing} not in the ambient mesh "
+                f"{dict(mesh.shape)} — was a different mesh built after "
+                f"this model was configured?"
+            )
+        ep_size = 1
+        for a in ep_axes:
+            ep_size *= mesh.shape[a]
+        num_experts = self.num_grouped_experts
+        if num_experts % ep_size != 0:
+            raise ValueError(
+                f"num_experts {num_experts} not divisible by ep size {ep_size}"
+            )
+        e_loc = num_experts // ep_size
+        dtype = self.dtype
+
+        def ep_body(x_loc, ids_loc, probs_loc, gate_w, up_w, down_w):
+            # x_loc: [n_loc, D] — this shard's tokens
+            # gate_w/up_w/down_w: [e_loc, ...] — this shard's experts
+            my_shard = lax.axis_index(ep_axes)
+            x_g = lax.all_gather(x_loc, ep_axes, axis=0, tiled=True)
+            ids_g = lax.all_gather(ids_loc, ep_axes, axis=0, tiled=True)
+            probs_g = lax.all_gather(probs_loc, ep_axes, axis=0, tiled=True)
+
+            n_global, k = ids_g.shape
+            flat_ids = ids_g.reshape(-1)
+            local_e = flat_ids - my_shard * e_loc
+            mine = (local_e >= 0) & (local_e < e_loc)
+            # rows not owned here sort into a sentinel zero-expert group
+            sort_key = jnp.where(mine, local_e, e_loc)
+            sort_idx = jnp.argsort(sort_key, stable=True)
+            group_sizes = jnp.bincount(sort_key, length=e_loc + 1).astype(
+                jnp.int32
+            )
+
+            token_idx = sort_idx // k
+            permuted_x = jnp.take(x_g, token_idx, axis=0)
+            permuted_probs = jnp.take(
+                probs_g.reshape(-1), sort_idx, axis=0
+            )
+
+            zeros = lambda w: jnp.zeros(  # noqa: E731
+                (1, *w.shape[1:]), w.dtype
+            )
+            y = grouped_swiglu_apply(
+                permuted_x,
+                permuted_probs,
+                group_sizes,
+                jnp.concatenate([gate_w, zeros(gate_w)], axis=0),
+                jnp.concatenate([up_w, zeros(up_w)], axis=0),
+                jnp.concatenate([down_w, zeros(down_w)], axis=0),
+                dtype,
+            )
+            combined = jnp.zeros((n_global, x_g.shape[-1]), y.dtype)
+            combined = combined.at[token_idx].add(y)
+            # sum each token's contributions across expert shards and
+            # return it to its owner
+            return lax.psum_scatter(
+                combined, ep_axes, scatter_dimension=0, tiled=True
+            )
+
+        out = jax.shard_map(
+            ep_body,
+            mesh=mesh,
+            in_specs=(
+                P(ep_axes, None),
+                P(ep_axes, None),
+                P(ep_axes, None),
+                P(ep_axes, None, None),
+                P(ep_axes, None, None),
+                P(ep_axes, None, None),
+            ),
+            out_specs=P(ep_axes, None),
+            axis_names=set(ep_axes),
+        )(
+            x,
+            topk_ids,
+            topk_probs,
+            self.grouped_experts.gate_weight,
+            self.grouped_experts.up_weight,
+            self.grouped_experts.down_weight,
+        )
+        return out.astype(x.dtype)

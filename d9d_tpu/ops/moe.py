@@ -1,0 +1,85 @@
+"""MoE token routing/permutation ops + grouped matmul.
+
+Replaces the reference kernel layer for MoE (SURVEY §2.2):
+- nv-grouped-gemm wheel (d9d/kernel/gmm/function.py:10,51) →
+  ``jax.lax.ragged_dot`` — XLA's native grouped GEMM, MXU-tiled on TPU,
+  differentiable (dI and dW both flow; the reference's GradDirection split
+  is owned by the pipelining layer's two-phase VJP instead).
+- Triton permute/unpermute kernels (d9d/kernel/moe/permute_with_probs.py:711,
+  indices_to_multihot.py:263) → a stable argsort over expert ids + gather;
+  XLA fuses the gather into the surrounding computation, and every shape is
+  static (N·K rows) as TPU compilation demands.
+
+All functions operate on a flat token dim; callers reshape [B,T,D]→[N,D].
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from d9d_tpu.core.types import Array
+
+
+class TokenSort(NamedTuple):
+    """Result of sorting (token, choice) pairs by expert.
+
+    sort_idx: [N*K] position in the flattened (token-major) pair array for
+        each sorted row; row r of the permuted layout is pair sort_idx[r].
+    token_idx: [N*K] owning token of each sorted row (= sort_idx // K).
+    group_sizes: [E] rows per expert, in sorted order.
+    """
+
+    sort_idx: Array
+    token_idx: Array
+    group_sizes: Array
+
+
+def sort_tokens_by_expert(topk_ids: Array, num_experts: int) -> TokenSort:
+    """Stable-sort (token, k) pairs by their routed expert id.
+
+    topk_ids: [N, K] int32 expert assignments.
+    """
+    n, k = topk_ids.shape
+    flat_ids = topk_ids.reshape(n * k)
+    sort_idx = jnp.argsort(flat_ids, stable=True)
+    group_sizes = jnp.bincount(flat_ids, length=num_experts)
+    return TokenSort(
+        sort_idx=sort_idx,
+        token_idx=sort_idx // k,
+        group_sizes=group_sizes.astype(jnp.int32),
+    )
+
+
+def permute_tokens(
+    x: Array, probs: Array, sort: TokenSort
+) -> tuple[Array, Array]:
+    """Gather tokens (and their routing probs) into expert-sorted layout.
+
+    x: [N, D]; probs: [N, K] → ([N*K, D], [N*K]).
+    """
+    permuted_x = jnp.take(x, sort.token_idx, axis=0)
+    permuted_probs = jnp.take(probs.reshape(-1), sort.sort_idx, axis=0)
+    return permuted_x, permuted_probs
+
+
+def unpermute_combine(y: Array, sort: TokenSort, num_tokens: int) -> Array:
+    """Scatter-add expert outputs back to their owning tokens.
+
+    y: [N*K, D] (already prob-weighted) → [N, D]. The reverse of
+    ``permute_tokens``; gradients flow as the corresponding gather.
+    """
+    out = jnp.zeros((num_tokens, y.shape[-1]), dtype=y.dtype)
+    return out.at[sort.token_idx].add(y)
+
+
+def grouped_matmul(x: Array, weight: Array, group_sizes: Array) -> Array:
+    """Per-expert matmul on expert-sorted rows.
+
+    x: [rows, in], weight: [E, in, out], group_sizes: [E] with
+    sum(group_sizes) <= rows (trailing rows produce unspecified values —
+    callers mask or pad with a zero expert).
+    """
+    return lax.ragged_dot(
+        x, weight, group_sizes.astype(jnp.int32), preferred_element_type=x.dtype
+    )

@@ -1,6 +1,7 @@
 from d9d_tpu.parallel.plan import (
     LogicalRules,
     ParallelPlan,
+    fsdp_ep_plan,
     fsdp_plan,
     hsdp_plan,
     logical_to_mesh_sharding,
@@ -11,6 +12,7 @@ from d9d_tpu.parallel.plan import (
 __all__ = [
     "LogicalRules",
     "ParallelPlan",
+    "fsdp_ep_plan",
     "fsdp_plan",
     "hsdp_plan",
     "logical_to_mesh_sharding",

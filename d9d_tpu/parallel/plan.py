@@ -91,9 +91,27 @@ def _tp_rules() -> list[tuple[str, str | tuple[str, ...] | None]]:
         (la.KV_HEADS, AXIS_TP),
         (la.MLP, AXIS_TP),
         (la.VOCAB, AXIS_TP),
-        (la.EXPERT, None),
     ]
 
 
 def tp_plan(ctx: MeshContext) -> ParallelPlan:
     return ParallelPlan(name="tp", rules=tuple(_tp_rules()))
+
+
+def fsdp_ep_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
+    """FSDP/HSDP for dense params + expert parallelism for MoE weights.
+
+    Experts are Shard(0) over the expert mesh axes and replicated on
+    ep_replicate — reference api/expert_parallel.py:9
+    (ShardMoESparseExpertsParallel). Grouped-weight feature dims stay
+    unsharded (they ride the ragged grouped GEMM whole).
+    """
+    rules: list[tuple[str, str | tuple[str, ...] | None]] = [
+        (la.EMBED, ctx.fsdp_axes),
+        (la.EXPERT, ctx.ep_shard_axes),
+        (la.EXPERT_EMBED, None),
+        (la.EXPERT_MLP, None),
+    ]
+    if with_tp:
+        rules += _tp_rules()
+    return ParallelPlan(name="fsdp_ep", rules=tuple(rules))

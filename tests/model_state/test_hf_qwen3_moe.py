@@ -1,0 +1,131 @@
+"""HF parity for Qwen3-MoE: load a transformers checkpoint through the
+mapper, compare logits; roundtrip back (reference huggingface.py:118,290)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.model_state import (
+    identity_mapper_from_names,
+    load_params,
+    read_model_state,
+    save_params,
+    write_model_state_local,
+)
+from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+from d9d_tpu.models.qwen3.huggingface import (
+    qwen3_moe_from_hf_mapper,
+    qwen3_moe_to_hf_mapper,
+)
+from d9d_tpu.ops.attention.eager import eager_sdpa
+
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+def _hf_model():
+    torch = pytest.importorskip("torch")
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=96,
+        moe_intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_experts=8,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+        max_position_embeddings=64,
+        rope_theta=1_000_000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        router_aux_loss_coef=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3MoeForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _our_config():
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", VOCAB),),
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        moe_intermediate_size=48,
+        num_experts=8,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,
+        rope_theta=1_000_000.0,
+        remat=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours(tmp_path_factory):
+    import flax.linen as nn
+
+    tmp_path = tmp_path_factory.mktemp("hf_moe_ckpt")
+    hf = _hf_model()
+    state = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    write_model_state_local(
+        tmp_path, identity_mapper_from_names(state.keys()), iter(state.items())
+    )
+
+    cfg = _our_config()
+    model = Qwen3MoeCausalLM(config=cfg, sdpa=eager_sdpa, dtype=jnp.float32)
+    b, t = 2, 16
+    tokens = jnp.zeros((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    template = nn.unbox(
+        jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+        )
+    )
+    template = {"params": template["params"]}
+    params = load_params(
+        tmp_path, template, mapper=qwen3_moe_from_hf_mapper(cfg)
+    )
+    return hf, model, params, cfg
+
+
+def test_logits_match_hf(hf_and_ours):
+    torch = pytest.importorskip("torch")
+    hf, model, params, cfg = hf_and_ours
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(0, VOCAB, size=(2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens_np)).logits.numpy()
+    positions = np.broadcast_to(np.arange(16), (2, 16)).astype(np.int32)
+    ours = model.apply(
+        params,
+        jnp.asarray(tokens_np, jnp.int32),
+        jnp.asarray(positions),
+        method=model.logits,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4
+    )
+
+
+def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
+    hf, model, params, cfg = hf_and_ours
+    save_params(tmp_path, params, mapper=qwen3_moe_to_hf_mapper(cfg))
+    hf_state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    exported = dict(
+        read_model_state(tmp_path, identity_mapper_from_names(hf_state.keys()))
+    )
+    assert set(exported) == set(hf_state)
+    for k in hf_state:
+        np.testing.assert_allclose(
+            exported[k], hf_state[k], rtol=1e-6, atol=1e-6, err_msg=k
+        )
