@@ -344,29 +344,33 @@ class MoELayer(nn.Module):
             flat_ids = ids_g.reshape(-1)
             local_e = flat_ids - my_shard * e_loc
             mine = (local_e >= 0) & (local_e < e_loc)
-            # rows not owned here sort into a sentinel zero-expert group
-            sort_key = jnp.where(mine, local_e, e_loc)
+            # Rows not owned here ride the last local group with prob 0:
+            # they compute through a real expert but contribute (and
+            # backprop) exactly zero. This keeps the weight tensors
+            # unconcatenated — a sentinel zero-expert would copy all three
+            # [e_loc, ...] tensors every forward and their grads every
+            # backward.
+            sort_key = jnp.where(mine, local_e, e_loc - 1)
             sort_idx = jnp.argsort(sort_key, stable=True)
-            group_sizes = jnp.bincount(sort_key, length=e_loc + 1).astype(
+            group_sizes = jnp.bincount(sort_key, length=e_loc).astype(
                 jnp.int32
             )
 
             token_idx = sort_idx // k
             permuted_x = jnp.take(x_g, token_idx, axis=0)
-            permuted_probs = jnp.take(
-                probs_g.reshape(-1), sort_idx, axis=0
+            mine_sorted = jnp.take(mine, sort_idx, axis=0)
+            permuted_probs = (
+                jnp.take(probs_g.reshape(-1), sort_idx, axis=0)
+                * mine_sorted.astype(probs_g.dtype)
             )
 
-            zeros = lambda w: jnp.zeros(  # noqa: E731
-                (1, *w.shape[1:]), w.dtype
-            )
             y = grouped_swiglu_apply(
                 permuted_x,
                 permuted_probs,
                 group_sizes,
-                jnp.concatenate([gate_w, zeros(gate_w)], axis=0),
-                jnp.concatenate([up_w, zeros(up_w)], axis=0),
-                jnp.concatenate([down_w, zeros(down_w)], axis=0),
+                gate_w,
+                up_w,
+                down_w,
                 dtype,
             )
             combined = jnp.zeros((n_global, x_g.shape[-1]), y.dtype)

@@ -1,6 +1,39 @@
+from d9d_tpu.pipelining.factory import (
+    DualPipeVScheduleConfig,
+    GPipeScheduleConfig,
+    Interleaved1F1BScheduleConfig,
+    InferenceScheduleConfig,
+    LoopedBFSScheduleConfig,
+    PipelineScheduleConfig,
+    ZeroBubble1PScheduleConfig,
+    ZeroBubbleVScheduleConfig,
+    build_program_builder,
+)
+from d9d_tpu.pipelining.runtime import (
+    PipelineExecutionResult,
+    PipelineScheduleExecutor,
+    PipelineStageRuntime,
+    StageTask,
+)
 from d9d_tpu.pipelining.stage_info import (
     PipelineStageInfo,
     distribute_layers_for_pipeline_stage,
 )
 
-__all__ = ["PipelineStageInfo", "distribute_layers_for_pipeline_stage"]
+__all__ = [
+    "DualPipeVScheduleConfig",
+    "GPipeScheduleConfig",
+    "Interleaved1F1BScheduleConfig",
+    "InferenceScheduleConfig",
+    "LoopedBFSScheduleConfig",
+    "PipelineExecutionResult",
+    "PipelineScheduleConfig",
+    "PipelineScheduleExecutor",
+    "PipelineStageInfo",
+    "PipelineStageRuntime",
+    "StageTask",
+    "ZeroBubble1PScheduleConfig",
+    "ZeroBubbleVScheduleConfig",
+    "build_program_builder",
+    "distribute_layers_for_pipeline_stage",
+]

@@ -1,0 +1,101 @@
+"""Schedule factory: pydantic config → program builder.
+
+Reference: d9d/pipelining/factory/{config.py:6-78, registry.py, factory.py:92}
+— a discriminated-union schedule config resolved through a registry. The
+TPU build keeps the config surface; "building" a schedule is composing the
+program + comm injection + validation (the executor is wired by the loop).
+"""
+
+from typing import Annotated, Literal, Union
+
+import pydantic
+
+from d9d_tpu.pipelining.program.builders import (
+    DualPipeVProgramBuilder,
+    GPipeProgramBuilder,
+    Interleaved1F1BProgramBuilder,
+    InferenceProgramBuilder,
+    LoopedBFSProgramBuilder,
+    ProgramBuilder,
+    ZeroBubbleVProgramBuilder,
+)
+
+__all__ = [
+    "DualPipeVScheduleConfig",
+    "GPipeScheduleConfig",
+    "Interleaved1F1BScheduleConfig",
+    "InferenceScheduleConfig",
+    "LoopedBFSScheduleConfig",
+    "PipelineScheduleConfig",
+    "ZeroBubble1PScheduleConfig",
+    "ZeroBubbleVScheduleConfig",
+    "build_program_builder",
+]
+
+
+class GPipeScheduleConfig(pydantic.BaseModel):
+    kind: Literal["gpipe"] = "gpipe"
+
+
+class InferenceScheduleConfig(pydantic.BaseModel):
+    kind: Literal["inference"] = "inference"
+    stages_per_rank: int = 1
+
+
+class LoopedBFSScheduleConfig(pydantic.BaseModel):
+    kind: Literal["looped_bfs"] = "looped_bfs"
+    stages_per_rank: int = 1
+
+
+class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
+    kind: Literal["interleaved_1f1b"] = "interleaved_1f1b"
+    stages_per_rank: int = 1
+
+
+class ZeroBubble1PScheduleConfig(pydantic.BaseModel):
+    kind: Literal["zero_bubble_1p"] = "zero_bubble_1p"
+    stages_per_rank: int = 1
+
+
+class ZeroBubbleVScheduleConfig(pydantic.BaseModel):
+    kind: Literal["zero_bubble_v"] = "zero_bubble_v"
+
+
+class DualPipeVScheduleConfig(pydantic.BaseModel):
+    kind: Literal["dual_pipe_v"] = "dual_pipe_v"
+
+
+PipelineScheduleConfig = Annotated[
+    Union[
+        GPipeScheduleConfig,
+        InferenceScheduleConfig,
+        LoopedBFSScheduleConfig,
+        Interleaved1F1BScheduleConfig,
+        ZeroBubble1PScheduleConfig,
+        ZeroBubbleVScheduleConfig,
+        DualPipeVScheduleConfig,
+    ],
+    pydantic.Field(discriminator="kind"),
+]
+
+
+def build_program_builder(
+    config: PipelineScheduleConfig, pp: int
+) -> ProgramBuilder:
+    if isinstance(config, GPipeScheduleConfig):
+        return GPipeProgramBuilder(pp)
+    if isinstance(config, InferenceScheduleConfig):
+        return InferenceProgramBuilder(pp, config.stages_per_rank)
+    if isinstance(config, LoopedBFSScheduleConfig):
+        return LoopedBFSProgramBuilder(pp, config.stages_per_rank)
+    if isinstance(config, Interleaved1F1BScheduleConfig):
+        return Interleaved1F1BProgramBuilder(pp, config.stages_per_rank)
+    if isinstance(config, ZeroBubble1PScheduleConfig):
+        return Interleaved1F1BProgramBuilder(
+            pp, config.stages_per_rank, zero_bubble=True
+        )
+    if isinstance(config, ZeroBubbleVScheduleConfig):
+        return ZeroBubbleVProgramBuilder(pp)
+    if isinstance(config, DualPipeVScheduleConfig):
+        return DualPipeVProgramBuilder(pp)
+    raise TypeError(f"unknown schedule config {config!r}")

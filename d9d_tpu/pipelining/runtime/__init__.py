@@ -1,0 +1,12 @@
+from d9d_tpu.pipelining.runtime.executor import (
+    PipelineExecutionResult,
+    PipelineScheduleExecutor,
+)
+from d9d_tpu.pipelining.runtime.stage import PipelineStageRuntime, StageTask
+
+__all__ = [
+    "PipelineExecutionResult",
+    "PipelineScheduleExecutor",
+    "PipelineStageRuntime",
+    "StageTask",
+]

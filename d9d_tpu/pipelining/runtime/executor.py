@@ -1,0 +1,238 @@
+"""Pipeline schedule executor: interprets a compiled action program.
+
+Reference: d9d/pipelining/runtime/executor.py:16 (PipelineScheduleExecutor)
+— a VM iterating ``program[rank]`` per process, with NCCL P2P at Send/Recv
+actions. Under JAX's single controller one executor interprets the *merged*
+program (the dependency-proven global linearization from
+``validate_program``): every rank's compute is dispatched from one Python
+loop, device-to-device transfers happen at Send actions via
+``jax.device_put`` onto the consuming stage's sharding, and XLA's async
+dispatch provides the overlap the reference gets from per-process
+execution — the host races ahead enqueuing work for all stage device
+groups while earlier computations are still running.
+
+Buffer lifecycle (reference computations.py:29,121): the executor stores
+per (stage, microbatch) only the input carry (the remat residual) and the
+output cotangent between its producing backward and consuming
+weight-backward; entries are freed at last use, which bounds pipeline
+memory exactly like the reference's per-microbatch caches.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.pipelining.program.actions import (
+    Action,
+    BackwardFull,
+    BackwardInput,
+    BackwardRecv,
+    BackwardSend,
+    BackwardWeight,
+    Compose,
+    ForwardCompute,
+    ForwardRecv,
+    ForwardSend,
+    PipelineProgram,
+)
+from d9d_tpu.pipelining.program.validate import validate_program
+from d9d_tpu.pipelining.runtime.stage import PipelineStageRuntime
+
+__all__ = ["PipelineExecutionResult", "PipelineScheduleExecutor"]
+
+
+@dataclasses.dataclass
+class PipelineExecutionResult:
+    """Per-step outcome: unscaled per-stage grad sums + loss statistics."""
+
+    grads: dict[int, PyTree] | None  # stage id → Σ_mb grads (unscaled)
+    loss_sum: Any
+    weight_sum: Any
+    metrics: dict[str, Any]
+    outputs: list[PyTree] | None = None  # forward-only: last-stage aux per mb
+
+
+class PipelineScheduleExecutor:
+    """Executes one train/eval step per call.
+
+    ``stages`` maps *global stage id* → runtime. The executor owns no
+    parameters — it reads ``stage.params`` at each action, so optimizer
+    updates between steps are picked up automatically.
+    """
+
+    def __init__(
+        self,
+        *,
+        stages: dict[int, PipelineStageRuntime],
+        program: PipelineProgram,
+        stage_owner: dict[int, int],
+        num_microbatches: int,
+        train: bool = True,
+    ):
+        self.stages = stages
+        self.num_stages = len(stages)
+        self.num_microbatches = num_microbatches
+        self.stage_owner = stage_owner
+        self.train = train
+        sim = validate_program(
+            program,
+            num_stages=self.num_stages,
+            num_microbatches=num_microbatches,
+            stage_owner=stage_owner,
+            train=train,
+        )
+        self.order: tuple[tuple[int, Action], ...] = sim.order
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _put(tree: PyTree, sharding) -> PyTree:
+        if sharding is None:
+            return tree
+        return jax.device_put(tree, sharding)
+
+    def step(self, microbatches: list[PyTree]) -> PipelineExecutionResult:
+        """Run the program over ``microbatches`` (list of host/device pytrees)."""
+        if len(microbatches) != self.num_microbatches:
+            raise ValueError(
+                f"program compiled for {self.num_microbatches} microbatches, "
+                f"got {len(microbatches)}"
+            )
+        first = self.stages[0]
+        last = self.stages[self.num_stages - 1]
+
+        carries: dict[int, PyTree] = {}  # mb → first-stage carry
+        kwargs_h: dict[int, PyTree] = {}  # mb → host kwargs tree
+        states: dict[int, PyTree] = {}  # mb → last-stage task state
+        for mb, micro in enumerate(microbatches):
+            carry, kw, state = first.task.split_microbatch(micro)
+            carries[mb] = self._put(carry, first.carry_sharding)
+            kwargs_h[mb] = kw
+            states[mb] = self._put(state, last.state_sharding)
+
+        # per-(stage, mb) device buffers
+        inputs: dict[tuple[int, int], PyTree] = {}  # carry in (remat residual)
+        kwargs_d: dict[tuple[int, int], PyTree] = {}  # kwargs on stage submesh
+        cots: dict[tuple[int, int], PyTree] = {}  # cotangent wrt stage output
+        grad_in: dict[tuple[int, int], PyTree] = {}  # input grad awaiting send
+        fwd_out: dict[tuple[int, int], PyTree] = {}  # output awaiting send/use
+
+        grads: dict[int, PyTree] = {}
+        loss_sum = weight_sum = None
+        metrics_sum: dict[str, Any] = {}
+        outputs: list[PyTree | None] = [None] * self.num_microbatches
+
+        def stage_kwargs(s: int, mb: int) -> PyTree:
+            if (s, mb) not in kwargs_d:
+                kwargs_d[(s, mb)] = self._put(
+                    kwargs_h[mb], self.stages[s].kwargs_sharding
+                )
+            return kwargs_d[(s, mb)]
+
+        def add_loss(aux):
+            nonlocal loss_sum, weight_sum
+            loss, weight, metrics = aux
+            loss_sum = loss if loss_sum is None else loss_sum + loss
+            weight_sum = weight if weight_sum is None else weight_sum + weight
+            for k, v in metrics.items():
+                metrics_sum[k] = v if k not in metrics_sum else metrics_sum[k] + v
+
+        def add_grads(s: int, gp: PyTree):
+            stage = self.stages[s]
+            if s not in grads:
+                grads[s] = stage.cast_grads(gp)
+            else:
+                grads[s] = stage.accumulate(grads[s], gp)
+
+        def route_input_grad(s: int, mb: int, gc: PyTree):
+            """Store dI for the downstream (stage-1) consumer."""
+            if s == 0:
+                return
+            if self.stage_owner[s - 1] == self.stage_owner[s]:
+                cots[(s - 1, mb)] = gc  # local edge: no send action exists
+            else:
+                grad_in[(s, mb)] = gc  # cross-rank: BackwardSend will move it
+
+        def execute(action: Action) -> None:
+            if isinstance(action, Compose):
+                for member in action.actions:
+                    execute(member)
+                return
+            s, mb = action.stage, action.microbatch
+            stage = self.stages[s]
+            if isinstance(action, ForwardCompute):
+                if s == 0:
+                    inputs[(0, mb)] = carries.pop(mb)
+                elif (s, mb) not in inputs:
+                    # same-rank edge: pull directly from the producing stage
+                    inputs[(s, mb)] = fwd_out.pop((s - 1, mb))
+                carry = inputs[(s, mb)]
+                kw = stage_kwargs(s, mb)
+                if stage.info.is_last:
+                    if not self.train:
+                        aux = stage.forward_loss(carry, kw, states[mb])
+                        add_loss(aux)
+                        outputs[mb] = aux
+                        inputs.pop((s, mb), None)
+                    # train: forward is folded into the backward's
+                    # value_and_grad (remat), nothing to run here
+                else:
+                    fwd_out[(s, mb)] = stage.forward(carry, kw)
+                    if not self.train:
+                        inputs.pop((s, mb), None)
+            elif isinstance(action, ForwardSend):
+                out = fwd_out.pop((s, mb))
+                nxt = self.stages[s + 1]
+                inputs[(s + 1, mb)] = self._put(out, nxt.carry_sharding)
+            elif isinstance(action, ForwardRecv):
+                pass  # transfer already targeted this stage at the Send
+            elif isinstance(action, BackwardFull):
+                cot = None if stage.info.is_last else cots.pop((s, mb))
+                state = states.get(mb) if stage.info.is_last else None
+                gp, gc, aux = stage.backward_full(
+                    inputs.pop((s, mb)), stage_kwargs(s, mb), cot, state
+                )
+                kwargs_d.pop((s, mb), None)
+                if aux is not None:
+                    add_loss(aux)
+                add_grads(s, gp)
+                route_input_grad(s, mb, gc)
+            elif isinstance(action, BackwardInput):
+                cot = None if stage.info.is_last else cots.get((s, mb))
+                state = states.get(mb) if stage.info.is_last else None
+                gc, aux = stage.backward_input(
+                    inputs[(s, mb)], stage_kwargs(s, mb), cot, state
+                )
+                if aux is not None:
+                    add_loss(aux)
+                if gc is not None:
+                    route_input_grad(s, mb, gc)
+                # inputs/cot stay alive for the deferred weight backward
+            elif isinstance(action, BackwardWeight):
+                kw = stage_kwargs(s, mb)
+                cot = None if stage.info.is_last else cots.pop((s, mb), None)
+                state = states.get(mb) if stage.info.is_last else None
+                gp = stage.backward_weight(inputs.pop((s, mb)), kw, cot, state)
+                kwargs_d.pop((s, mb), None)
+                add_grads(s, gp)
+            elif isinstance(action, BackwardSend):
+                g = grad_in.pop((s, mb))
+                prev = self.stages[s - 1]
+                cots[(s - 1, mb)] = self._put(g, prev.carry_sharding)
+            elif isinstance(action, BackwardRecv):
+                pass
+            else:  # pragma: no cover
+                raise TypeError(f"unknown action {action!r}")
+
+        for _rank, action in self.order:
+            execute(action)
+
+        return PipelineExecutionResult(
+            grads=grads if self.train else None,
+            loss_sum=loss_sum,
+            weight_sum=weight_sum,
+            metrics=metrics_sum,
+            outputs=outputs if not self.train else None,
+        )

@@ -1,0 +1,199 @@
+"""Per-stage compiled compute: forward + full/split backward.
+
+Reference: d9d/pipelining/infra/stage/stage.py:13 (PipelineStage) and
+splitgrad.py (autograd-graph surgery for the zero-bubble dI/dW split).
+
+TPU redesign: there is no autograd graph to mutate. Instead each stage gets
+four jitted pure functions — forward, fused backward, input-only backward,
+weight-only backward — derived from the stage's forward with ``jax.vjp``.
+Residual policy is *rematerialization*: the executor stores only the
+stage's small input carry per in-flight microbatch; every backward variant
+recomputes the stage forward inside its own jit (XLA fuses it with the
+cotangent math). That is the memory-optimal choice for deep pipelines on
+TPU (the reference reaches the same point via activation checkpointing),
+costs one extra forward per backward direction, and makes the dI/dW split
+exact rather than approximated: input-backward computes only the carry
+cotangent chain, weight-backward only the parameter grads, matching the
+compute split that zero-bubble schedules rely on (splitgrad.py:220,290).
+"""
+
+import dataclasses
+from typing import Any, Protocol
+
+import flax.linen as nn
+import jax
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.pipelining.stage_info import PipelineStageInfo
+
+__all__ = ["PipelineStageRuntime", "StageTask"]
+
+
+class StageTask(Protocol):
+    """How the executor drives one stage of a model for a task.
+
+    Split of responsibilities mirroring the reference's TrainTask +
+    LossComputer pair (loop/control/task.py:180,
+    component/pipeline_result_processing.py:18): the task defines what a
+    microbatch looks like and how the last stage turns activations into a
+    weighted loss; the engine owns everything else.
+    """
+
+    def split_microbatch(
+        self, microbatch: PyTree
+    ) -> tuple[PyTree, PyTree, PyTree]:
+        """→ (first_stage_carry, per_stage_kwargs, last_stage_state)."""
+        ...
+
+    def stage_forward(
+        self, module: nn.Module, params: PyTree, carry: PyTree, kwargs: PyTree
+    ) -> PyTree:
+        """Non-last stage: carry in → carry out."""
+        ...
+
+    def last_stage_loss(
+        self,
+        module: nn.Module,
+        params: PyTree,
+        carry: PyTree,
+        kwargs: PyTree,
+        state: PyTree,
+    ) -> tuple[jax.Array, jax.Array, dict[str, jax.Array]]:
+        """Last stage: → (loss_sum, weight, metrics)."""
+        ...
+
+
+def _tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(lambda x, y: x + y.astype(x.dtype), a, b)
+
+
+@dataclasses.dataclass
+class PipelineStageRuntime:
+    """One pipeline stage: module + params + the four compiled functions.
+
+    ``carry_sharding``/``state_sharding`` describe where this stage's
+    activations and task state live (its pp submesh); the executor uses
+    them as transfer targets.
+    """
+
+    info: PipelineStageInfo
+    module: nn.Module
+    params: PyTree
+    task: StageTask
+    carry_sharding: Any | None = None
+    kwargs_sharding: Any | None = None
+    state_sharding: Any | None = None
+    grad_dtype: Any | None = None
+
+    def __post_init__(self) -> None:
+        self._fwd = jax.jit(self._fwd_impl)
+        self._fwd_loss = jax.jit(self._fwd_loss_impl)
+        self._bwd_full = jax.jit(self._bwd_full_impl)
+        self._bwd_input = jax.jit(self._bwd_input_impl)
+        self._bwd_weight = jax.jit(self._bwd_weight_impl)
+        self._acc = jax.jit(_tree_add, donate_argnums=(0,))
+        self._cast = jax.jit(
+            lambda g: jax.tree.map(
+                lambda x: x.astype(self.grad_dtype) if self.grad_dtype else x, g
+            )
+        )
+
+    # ---- forward ---------------------------------------------------------
+
+    def _fwd_impl(self, params, carry, kwargs):
+        return self.task.stage_forward(self.module, params, carry, kwargs)
+
+    def _fwd_loss_impl(self, params, carry, kwargs, state):
+        return self.task.last_stage_loss(self.module, params, carry, kwargs, state)
+
+    def forward(self, carry, kwargs):
+        return self._fwd(self.params, carry, kwargs)
+
+    def forward_loss(self, carry, kwargs, state):
+        """Last stage forward → (loss_sum, weight, metrics)."""
+        return self._fwd_loss(self.params, carry, kwargs, state)
+
+    # ---- backward (remat: recompute fwd inside each jit) ----------------
+
+    def _loss_of(self, params, carry, kwargs, state):
+        loss, weight, metrics = self.task.last_stage_loss(
+            self.module, params, carry, kwargs, state
+        )
+        return loss, (weight, metrics)
+
+    def _bwd_full_impl(self, params, carry, kwargs, cot, state):
+        """→ (grad_params, grad_carry, aux). ``cot``/``state`` exclusive."""
+        if self.info.is_last:
+            grad_fn = jax.value_and_grad(
+                self._loss_of, argnums=(0, 1), has_aux=True
+            )
+            (loss, (weight, metrics)), (gp, gc) = grad_fn(
+                params, carry, kwargs, state
+            )
+            return gp, gc, (loss, weight, metrics)
+        _, vjp = jax.vjp(
+            lambda p, c: self.task.stage_forward(self.module, p, c, kwargs),
+            params,
+            carry,
+        )
+        gp, gc = vjp(cot)
+        return gp, gc, None
+
+    def _bwd_input_impl(self, params, carry, kwargs, cot, state):
+        """Input-only backward → (grad_carry, aux)."""
+        if self.info.is_last:
+            if self.info.is_first:
+                # single-stage pipeline: tokens are not differentiable, but
+                # the loss statistics must still surface from this action
+                loss, (weight, metrics) = self._loss_of(
+                    params, carry, kwargs, state
+                )
+                return None, (loss, weight, metrics)
+            grad_fn = jax.value_and_grad(
+                self._loss_of, argnums=1, has_aux=True
+            )
+            (loss, (weight, metrics)), gc = grad_fn(params, carry, kwargs, state)
+            return gc, (loss, weight, metrics)
+        if self.info.is_first:
+            # tokens are not differentiable; dI is a structural no-op
+            return None, None
+        _, vjp = jax.vjp(
+            lambda c: self.task.stage_forward(self.module, params, c, kwargs),
+            carry,
+        )
+        (gc,) = vjp(cot)
+        return gc, None
+
+    def _bwd_weight_impl(self, params, carry, kwargs, cot, state):
+        """Weight-only backward → grad_params."""
+        if self.info.is_last:
+            gp = jax.grad(
+                lambda p: self._loss_of(p, carry, kwargs, state)[0]
+            )(params)
+            return gp
+        _, vjp = jax.vjp(
+            lambda p: self.task.stage_forward(self.module, p, carry, kwargs),
+            params,
+        )
+        (gp,) = vjp(cot)
+        return gp
+
+    def backward_full(self, carry, kwargs, cot=None, state=None):
+        return self._bwd_full(self.params, carry, kwargs, cot, state)
+
+    def backward_input(self, carry, kwargs, cot=None, state=None):
+        return self._bwd_input(self.params, carry, kwargs, cot, state)
+
+    def backward_weight(self, carry, kwargs, cot=None, state=None):
+        return self._bwd_weight(self.params, carry, kwargs, cot, state)
+
+    # ---- gradient accumulator -------------------------------------------
+
+    def cast_grads(self, grads: PyTree) -> PyTree:
+        """First microbatch: adopt grads as the accumulator (cast to
+        ``grad_dtype``); preserves the vjp output sharding, so no separate
+        zero-init is needed."""
+        return self._cast(grads)
+
+    def accumulate(self, acc: PyTree, grads: PyTree) -> PyTree:
+        return self._acc(acc, grads)
