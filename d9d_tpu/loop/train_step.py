@@ -123,9 +123,13 @@ def build_train_step(
             clip = jnp.minimum(1.0, max_grad_norm / jnp.maximum(grad_norm, 1e-12))
             grads = jax.tree.map(lambda g: g * clip, grads)
 
-        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        if not getattr(optimizer, "accepts_fp32_grads", False):
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        # optimizers owning the parameter write (e.g. StochasticAdamW's
+        # stochastic-rounding write-back) supply their own apply_updates
+        apply = getattr(optimizer, "apply_updates", optax.apply_updates)
+        params = apply(params, updates)
 
         out_metrics = {
             "loss": loss,

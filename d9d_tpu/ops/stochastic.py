@@ -1,0 +1,95 @@
+"""Stochastic rounding fp32 -> bf16.
+
+TPU equivalent of the reference Triton stochastic-rounding kernels
+(d9d/kernel/stochastic/adamw_step.py:97, copy.py:34, ops/round.py): add
+uniform random bits below the bf16 mantissa cut and truncate, so the
+expected value of the rounded number equals the fp32 input. Used by the
+StochasticAdamW optimizer to train directly in bf16 without fp32 master
+weights.
+
+Two implementations with identical semantics:
+
+- :func:`stochastic_round_to_bf16` — pure jnp bit-twiddling on
+  ``bitcast_convert_type``; XLA fuses it into the surrounding optimizer
+  arithmetic, which is usually enough because the op is bandwidth-bound.
+- :func:`stochastic_round_to_bf16_pallas` — Pallas TPU kernel using the
+  on-chip PRNG (``pltpu.prng_random_bits``), avoiding the cost of
+  materializing a jax.random key block.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from d9d_tpu.core.types import Array
+
+_MANTISSA_MASK = 0xFFFF  # bits dropped when truncating fp32 -> bf16
+_BF16_MASK = 0xFFFF0000
+
+
+def _sr_bits(x_bits: Array, rand_bits: Array) -> Array:
+    """Core rounding rule on uint32 views: add 16 random low bits, truncate."""
+    rnd = rand_bits & jnp.uint32(_MANTISSA_MASK)
+    return (x_bits + rnd) & jnp.uint32(_BF16_MASK)
+
+
+def stochastic_round_to_bf16(x: Array, key: jax.Array) -> Array:
+    """Stochastically round ``x`` (any float dtype) to bfloat16.
+
+    E[result] == x exactly (the two candidate bf16 neighbours are chosen
+    with probability proportional to proximity). Non-finite values pass
+    through deterministic casting.
+    """
+    xf = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    rand = jax.random.bits(key, x.shape, jnp.uint32)
+    out = jax.lax.bitcast_convert_type(_sr_bits(bits, rand), jnp.float32)
+    return jnp.where(jnp.isfinite(xf), out, xf).astype(jnp.bfloat16)
+
+
+_LANES = 128
+_BLOCK_ROWS = 256
+
+
+def _sr_kernel(seed_ref, x_ref, out_ref):
+    # distinct stream per grid block: hash the block id into the seed
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    xf = x_ref[...]
+    bits = pltpu.bitcast(xf, jnp.uint32)
+    rand = pltpu.bitcast(pltpu.prng_random_bits(xf.shape), jnp.uint32)
+    out = pltpu.bitcast(_sr_bits(bits, rand), jnp.float32)
+    out_ref[...] = jnp.where(jnp.isfinite(xf), out, xf).astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def stochastic_round_to_bf16_pallas(
+    x: Array, seed: Array, *, interpret: bool = False
+) -> Array:
+    """Pallas TPU stochastic rounding driven by the on-chip PRNG.
+
+    ``seed`` is a scalar int32; reuse across calls yields identical noise,
+    so callers should fold the step counter in. The input is processed as
+    (rows, 128) VMEM blocks over a 1-D grid.
+    """
+    n = x.size
+    cols = _LANES
+    rows = -(-n // cols)
+    pad_rows = -(-rows // _BLOCK_ROWS) * _BLOCK_ROWS
+    flat = jnp.pad(x.astype(jnp.float32).reshape(-1), (0, pad_rows * cols - n))
+    tiled = flat.reshape(pad_rows, cols)
+
+    out = pl.pallas_call(
+        _sr_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(pad_rows // _BLOCK_ROWS,),
+            in_specs=[pl.BlockSpec((_BLOCK_ROWS, cols), lambda i, seed: (i, 0))],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, cols), lambda i, seed: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((pad_rows, cols), jnp.bfloat16),
+        interpret=interpret,
+    )(seed.reshape(1).astype(jnp.int32), tiled)
+    return out.reshape(-1)[:n].reshape(x.shape)

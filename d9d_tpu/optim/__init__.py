@@ -1,0 +1,5 @@
+"""Optimizers (reference: d9d/optim)."""
+
+from d9d_tpu.optim.stochastic_adamw import StochasticAdamW, StochasticAdamWState
+
+__all__ = ["StochasticAdamW", "StochasticAdamWState"]

@@ -1,0 +1,147 @@
+"""StochasticAdamW + stochastic rounding tests.
+
+Mirrors the reference test strategy for kernel/stochastic/* and
+optim/stochastic/adamw.py: (a) rounding is mean-preserving and lands only on
+the two bf16 neighbours; (b) the bf16 optimizer tracks an fp32 optax.adamw
+trajectory; (c) RNG state lives in the optimizer state (reproducible).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from d9d_tpu.ops.stochastic import (
+    stochastic_round_to_bf16,
+    stochastic_round_to_bf16_pallas,
+)
+from d9d_tpu.optim import StochasticAdamW
+
+
+class TestStochasticRounding:
+    def test_lands_on_neighbours(self):
+        x = jnp.array([1.0 + 1 / 256.0] * 1024, jnp.float32)  # between bf16 grid pts
+        out = stochastic_round_to_bf16(x, jax.random.PRNGKey(0))
+        lo = np.float32(jnp.asarray(x[0]).astype(jnp.bfloat16))  # nearest = 1.0
+        vals = set(np.unique(np.asarray(out.astype(jnp.float32))))
+        grid = {1.0, 1.0 + 1 / 128.0}
+        assert vals <= grid, (vals, grid, lo)
+        assert len(vals) == 2  # both neighbours hit
+
+    def test_mean_preserving(self):
+        # value 1/4 of the way between two bf16 neighbours -> P(up) = 0.25
+        lo, hi = 1.0, 1.0 + 1 / 128.0
+        x = jnp.full((200_000,), lo + (hi - lo) * 0.25, jnp.float32)
+        out = stochastic_round_to_bf16(x, jax.random.PRNGKey(1))
+        frac_up = float(jnp.mean((out.astype(jnp.float32) > lo).astype(jnp.float32)))
+        assert abs(frac_up - 0.25) < 0.01
+        mean = float(jnp.mean(out.astype(jnp.float32)))
+        assert abs(mean - float(x[0])) < 1e-5
+
+    def test_exact_values_unchanged(self):
+        x = jnp.array([0.0, 1.0, -2.0, 0.5], jnp.float32)  # exact in bf16
+        out = stochastic_round_to_bf16(x, jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(
+            np.asarray(out.astype(jnp.float32)), np.asarray(x)
+        )
+
+    def test_nonfinite_passthrough(self):
+        x = jnp.array([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+        out = stochastic_round_to_bf16(x, jax.random.PRNGKey(3))
+        o = np.asarray(out.astype(jnp.float32))
+        assert np.isposinf(o[0]) and np.isneginf(o[1]) and np.isnan(o[2])
+
+    def test_pallas_kernel_matches_semantics(self):
+        try:
+            x = jnp.full((8, 128), 1.0 + 1 / 512.0, jnp.float32)
+            out = stochastic_round_to_bf16_pallas(
+                x, jnp.int32(42), interpret=True
+            )
+        except Exception as e:  # pragma: no cover - interpret-mode gaps
+            pytest.skip(f"pallas interpret mode unavailable for prng: {e}")
+        vals = set(np.unique(np.asarray(out.astype(jnp.float32))))
+        assert vals <= {1.0, 1.0 + 1 / 128.0}
+
+
+def _tree_close(a, b, tol):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32), atol=tol, rtol=tol
+        )
+
+
+class TestStochasticAdamW:
+    def _problem(self, dtype):
+        params = {
+            "w": jnp.linspace(-1, 1, 64, dtype=jnp.float32).astype(dtype),
+            "b": jnp.zeros((8,), dtype),
+        }
+        def grads_at(step):
+            g = jax.random.normal(jax.random.PRNGKey(100 + step), (64,))
+            return {"w": g.astype(jnp.float32), "b": jnp.ones((8,), jnp.float32)}
+        return params, grads_at
+
+    def test_tracks_fp32_adamw(self):
+        lr, wd = 1e-2, 0.1
+        params_bf, grads_at = self._problem(jnp.bfloat16)
+        params_32 = jax.tree.map(lambda p: p.astype(jnp.float32), params_bf)
+
+        opt = StochasticAdamW(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+        ref = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+        state = opt.init(params_bf)
+        ref_state = ref.init(params_32)
+
+        for step in range(50):
+            g = grads_at(step)
+            new_p, state = jax.jit(opt.update)(g, state, params_bf)
+            params_bf = opt.apply_updates(params_bf, new_p)
+            upd, ref_state = ref.update(g, ref_state, params_32)
+            params_32 = optax.apply_updates(params_32, upd)
+
+        # bf16 stochastic trajectory stays near the fp32 one; individual
+        # elements random-walk a few bf16 grid points, the mean error is tight
+        _tree_close(params_bf, params_32, tol=8e-2)
+        err = np.asarray(params_bf["w"].astype(jnp.float32)) - np.asarray(
+            params_32["w"]
+        )
+        assert abs(err.mean()) < 5e-3
+        assert jax.tree.leaves(params_bf)[0].dtype == jnp.bfloat16
+
+    def test_reproducible_from_state(self):
+        params, grads_at = self._problem(jnp.bfloat16)
+        opt = StochasticAdamW(1e-2, seed=7)
+        s0 = opt.init(params)
+        p1, s1 = opt.update(grads_at(0), s0, params)
+        p2, s2 = opt.update(grads_at(0), s0, params)
+        _tree_close(p1, p2, tol=0.0)
+        assert int(s1.count) == 1
+
+    def test_moment_dtype_bf16(self):
+        params, grads_at = self._problem(jnp.bfloat16)
+        opt = StochasticAdamW(1e-2, moment_dtype=jnp.bfloat16)
+        state = opt.init(params)
+        assert jax.tree.leaves(state.mu)[0].dtype == jnp.bfloat16
+        new_p, state = opt.update(grads_at(0), state, params)
+        assert jax.tree.leaves(state.mu)[0].dtype == jnp.bfloat16
+        assert jax.tree.leaves(new_p)[0].dtype == jnp.bfloat16
+
+    def test_in_trainer_loop_loss_decreases(self):
+        # tiny quadratic: params should descend
+        params = {"w": jnp.full((128,), 2.0, jnp.bfloat16)}
+        opt = StochasticAdamW(5e-2)
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return jnp.sum(p["w"].astype(jnp.float32) ** 2)
+
+        losses = []
+        for _ in range(100):
+            g = jax.grad(loss_fn)(params)
+            g = {"w": g["w"].astype(jnp.float32)}
+            new_p, state = opt.update(g, state, params)
+            params = opt.apply_updates(params, new_p)
+            losses.append(float(loss_fn(params)))
+        assert losses[-1] < losses[0] * 0.2
