@@ -1,0 +1,38 @@
+"""Training protocols.
+
+Reference: d9d/core/protocol/training.py:5,34 (OptimizerProtocol /
+LRSchedulerProtocol). The TPU engine accepts any plain optax
+``GradientTransformation`` AND optimizers implementing this richer
+protocol, which adds two optional capabilities the train step honors:
+
+- ``accepts_fp32_grads = True`` — the step passes accumulated fp32 grads
+  through without down-casting them to the param dtype first (needed by
+  optimizers that do their own precision management, e.g. StochasticAdamW).
+- ``apply_updates(params, updates)`` — the optimizer owns the parameter
+  write instead of ``optax.apply_updates`` (needed when the write itself
+  carries semantics, e.g. stochastic rounding into bf16).
+"""
+
+from typing import Any, Protocol, runtime_checkable
+
+from d9d_tpu.core.types import PyTree
+
+
+@runtime_checkable
+class OptimizerProtocol(Protocol):
+    """Structural type for engine-compatible optimizers."""
+
+    def init(self, params: PyTree) -> Any: ...
+
+    def update(
+        self, grads: PyTree, state: Any, params: PyTree
+    ) -> tuple[PyTree, Any]: ...
+
+
+@runtime_checkable
+class OptimizerOwnsApply(OptimizerProtocol, Protocol):
+    """Optimizers that additionally own the parameter write."""
+
+    accepts_fp32_grads: bool
+
+    def apply_updates(self, params: PyTree, updates: PyTree) -> PyTree: ...

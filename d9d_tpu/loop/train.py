@@ -43,6 +43,7 @@ class Trainer:
         task: TrainTask,
         optimizer_provider: OptimizerProvider,
         learning_rate: optax.ScalarOrSchedule | None = None,
+        peft_method=None,
     ):
         self.ctx = ctx
         self.config = config
@@ -63,6 +64,19 @@ class Trainer:
             self.module, sample, self.init_rng, ctx, plan
         )
 
+        self.peft_method = peft_method
+        self.base_params = None
+        if peft_method is not None:
+            # engine "params" become the adapter tree; base stays frozen
+            from d9d_tpu.peft import PeftTask
+
+            inject_rng = jax.random.fold_in(self.init_rng, 1)
+            self.base_params, adapters = peft_method.inject(
+                self.params, inject_rng
+            )
+            self.params = adapters
+            self.task = task = PeftTask(task, peft_method, self.base_params)
+
         self.optimizer = optimizer_provider.build(
             learning_rate if learning_rate is not None else config.learning_rate
         )
@@ -78,6 +92,7 @@ class Trainer:
         self.dataset = dataset_provider
         self._batch_sharding = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
         self._eval_fn = None
+        self._merge_fn = None
 
     # ------------------------------------------------------------------
 
@@ -124,6 +139,15 @@ class Trainer:
                 history.append(host_metrics)
                 logger.info("step %d: %s", step, host_metrics)
         return history
+
+    def merged_params(self) -> PyTree:
+        """Full parameter tree for export: identity without PEFT, adapters
+        folded into the frozen base with it."""
+        if self.peft_method is None:
+            return self.params
+        if self._merge_fn is None:
+            self._merge_fn = jax.jit(self.peft_method.merge)
+        return self._merge_fn(self.base_params, self.params)
 
     # convenience for tests / evaluation -------------------------------
 

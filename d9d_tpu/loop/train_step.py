@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
 
@@ -51,7 +52,7 @@ def build_train_step(
     *,
     module: nn.Module,
     task: TrainTask,
-    optimizer: optax.GradientTransformation,
+    optimizer: "optax.GradientTransformation | OptimizerProtocol",
     num_microbatches: int,
     max_grad_norm: float | None = 1.0,
     grad_dtype: jnp.dtype | None = jnp.float32,
@@ -123,11 +124,11 @@ def build_train_step(
             clip = jnp.minimum(1.0, max_grad_norm / jnp.maximum(grad_norm, 1e-12))
             grads = jax.tree.map(lambda g: g * clip, grads)
 
+        # OptimizerOwnsApply capabilities (core/protocol.py): fp32 grads
+        # pass-through + optimizer-owned parameter write
         if not getattr(optimizer, "accepts_fp32_grads", False):
             grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        # optimizers owning the parameter write (e.g. StochasticAdamW's
-        # stochastic-rounding write-back) supply their own apply_updates
         apply = getattr(optimizer, "apply_updates", optax.apply_updates)
         params = apply(params, updates)
 

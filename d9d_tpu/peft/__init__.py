@@ -1,0 +1,21 @@
+"""Parameter-efficient fine-tuning (reference: d9d/peft)."""
+
+from d9d_tpu.peft.base import PeftMethod
+from d9d_tpu.peft.full_tune import FullTune
+from d9d_tpu.peft.lora import LoRA
+from d9d_tpu.peft.stack import PeftStack
+from d9d_tpu.peft.task import (
+    PeftTask,
+    adapter_from_state_dict,
+    adapter_state_dict,
+)
+
+__all__ = [
+    "PeftMethod",
+    "FullTune",
+    "LoRA",
+    "PeftStack",
+    "PeftTask",
+    "adapter_state_dict",
+    "adapter_from_state_dict",
+]

@@ -1,0 +1,48 @@
+"""PEFT method protocol.
+
+Reference: d9d/peft/base.py:28 (``PeftMethod`` inject/merge). The torch
+version mutates ``nn.Module``s in place (swapping Linear for LoraLinear);
+the TPU-native design is a *parameter-tree reparameterization* — modules
+never change, methods split the param pytree into a frozen ``base`` and a
+trainable ``adapters`` tree, and ``materialize`` recombines them as a pure
+jit-safe function inside the loss. This keeps the whole train step a single
+XLA program and makes the optimizer state exactly the adapter tree.
+"""
+
+import abc
+
+import jax
+
+from d9d_tpu.core.types import PyTree
+
+
+class PeftMethod(abc.ABC):
+    """Splits params into (frozen base, trainable adapters)."""
+
+    @abc.abstractmethod
+    def inject(self, params: PyTree, rng: jax.Array) -> tuple[PyTree, PyTree]:
+        """→ (base, adapters). ``base`` is frozen; ``adapters`` is trained."""
+
+    @abc.abstractmethod
+    def materialize(self, base: PyTree, adapters: PyTree) -> PyTree:
+        """Pure: effective params used in forward. Runs under jit; grads
+        must flow only through ``adapters`` (callers stop-gradient base)."""
+
+    @abc.abstractmethod
+    def merge(self, base: PyTree, adapters: PyTree) -> PyTree:
+        """Fold adapters into base weights → a plain param tree for export."""
+
+
+def path_name(path: tuple) -> str:
+    """Stable '/'-joined name for a pytree path (dict keys / indices)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)

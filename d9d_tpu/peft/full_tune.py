@@ -1,0 +1,53 @@
+"""FullTune: select a subset of params to train directly.
+
+Reference: d9d/peft/full_tune/method.py. Matched params move into the
+adapter tree (trained in place); unmatched params freeze in base.
+``target_patterns=('.*',)`` trains everything (the degenerate "no PEFT"
+case, useful inside a PeftStack to unfreeze e.g. norms next to LoRA).
+"""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.peft.base import PeftMethod, path_name
+
+
+@dataclasses.dataclass(frozen=True)
+class FullTune(PeftMethod):
+    target_patterns: tuple[str, ...] = (r".*",)
+
+    def _matches(self, name: str) -> bool:
+        return any(re.fullmatch(p, name) for p in self.target_patterns)
+
+    def inject(self, params: PyTree, rng: jax.Array) -> tuple[PyTree, PyTree]:
+        del rng
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        # copy (not alias) matched leaves: the train step donates the adapter
+        # buffers, which must never invalidate the frozen base tree. jnp.copy
+        # produces a fresh buffer with the source's sharding.
+        adapters = {
+            path_name(path): jnp.copy(leaf)
+            for path, leaf in flat
+            if self._matches(path_name(path))
+        }
+        if not adapters:
+            raise ValueError(
+                f"FullTune target_patterns {self.target_patterns} matched no params"
+            )
+        return params, adapters
+
+    def _combine(self, base: PyTree, adapters: PyTree) -> PyTree:
+        def fix(path, leaf):
+            return adapters.get(path_name(path), leaf)
+
+        return jax.tree_util.tree_map_with_path(fix, base)
+
+    def materialize(self, base: PyTree, adapters: PyTree) -> PyTree:
+        return self._combine(base, adapters)
+
+    def merge(self, base: PyTree, adapters: PyTree) -> PyTree:
+        return self._combine(base, adapters)

@@ -1,0 +1,120 @@
+"""LoRA as parameter-tree reparameterization.
+
+Reference: d9d/peft/lora/method.py:56, lora/layer.py:9,83 — LoRA for
+``nn.Linear`` AND ``GroupedLinear`` (MoE experts). Here both cases are
+handled by rank: matching 2-D kernels ``(in, out)`` get ``A (in, r)`` /
+``B (r, out)``; matching 3-D grouped-expert kernels ``(E, in, out)`` get
+per-expert ``A (E, in, r)`` / ``B (E, r, out)`` — one einsum covers both.
+
+The effective weight is ``W + (alpha / r) * A @ B`` with A ~ Kaiming-ish
+normal and B = 0 (so injection is a no-op at step 0), matching standard
+LoRA initialization.
+"""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.peft.base import PeftMethod, path_name
+
+
+def _shard_like(
+    x: jax.Array, ref: jax.Array, dim_map: tuple[tuple[int, int], ...]
+) -> jax.Array:
+    """Place an adapter on the mesh of its target param: each
+    ``(adapter_dim, ref_dim)`` pair inherits the target dim's partitioning;
+    unmapped dims (the LoRA rank) stay replicated. No-op when the target has
+    no NamedSharding (single-device tests)."""
+    sharding = getattr(ref, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return x
+    ref_spec = list(sharding.spec) + [None] * (ref.ndim - len(sharding.spec))
+    spec = [None] * x.ndim
+    for adapter_dim, ref_dim in dim_map:
+        spec[adapter_dim] = ref_spec[ref_dim]
+    return jax.device_put(x, NamedSharding(sharding.mesh, PartitionSpec(*spec)))
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRA(PeftMethod):
+    """``target_patterns``: regexes matched against the '/'-joined param
+    path (e.g. ``r".*attention.*kernel"``). Non-matching params stay in
+    base untouched."""
+
+    rank: int
+    alpha: float = 1.0
+    target_patterns: tuple[str, ...] = (r".*kernel$",)
+    init_scale: float = 0.01
+
+    def _matches(self, name: str, leaf) -> bool:
+        if not hasattr(leaf, "ndim") or leaf.ndim not in (2, 3):
+            return False
+        return any(re.fullmatch(p, name) for p in self.target_patterns)
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+    # -- protocol ------------------------------------------------------
+
+    def inject(self, params: PyTree, rng: jax.Array) -> tuple[PyTree, PyTree]:
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        adapters = {}
+        for i, (path, leaf) in enumerate(flat):
+            name = path_name(path)
+            if not self._matches(name, leaf):
+                continue
+            leaf_rng = jax.random.fold_in(rng, i)
+            if leaf.ndim == 2:
+                d_in, _d_out = leaf.shape
+                a_shape = (d_in, self.rank)
+                b_shape = (self.rank, leaf.shape[1])
+            else:  # (E, in, out) grouped experts
+                e, d_in, d_out = leaf.shape
+                a_shape = (e, d_in, self.rank)
+                b_shape = (e, self.rank, d_out)
+            a = (
+                jax.random.normal(leaf_rng, a_shape, jnp.float32)
+                * self.init_scale
+            ).astype(leaf.dtype)
+            b = jnp.zeros(b_shape, leaf.dtype)
+            if leaf.ndim == 2:
+                a_map, b_map = ((0, 0),), ((1, 1),)
+            else:  # expert dim 0 shared; a keeps 'in', b keeps 'out'
+                a_map, b_map = ((0, 0), (1, 1)), ((0, 0), (2, 2))
+            adapters[name] = {
+                "lora_a": _shard_like(a, leaf, a_map),
+                "lora_b": _shard_like(b, leaf, b_map),
+            }
+        if not adapters:
+            raise ValueError(
+                f"LoRA target_patterns {self.target_patterns} matched no params"
+            )
+        return params, adapters
+
+    def _delta(self, ad: dict) -> jax.Array:
+        a, b = ad["lora_a"], ad["lora_b"]
+        if a.ndim == 2:
+            return self.scaling * a @ b
+        return self.scaling * jnp.einsum("eir,ero->eio", a, b)
+
+    def _combine(self, params: PyTree, adapters: PyTree) -> PyTree:
+        def fix(path, leaf):
+            name = path_name(path)
+            if name in adapters:
+                ad = adapters[name]
+                return (leaf.astype(jnp.float32) + self._delta(ad).astype(jnp.float32)).astype(leaf.dtype)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(fix, params)
+
+    def materialize(self, base: PyTree, adapters: PyTree) -> PyTree:
+        return self._combine(base, adapters)
+
+    def merge(self, base: PyTree, adapters: PyTree) -> PyTree:
+        return self._combine(base, adapters)

@@ -1,0 +1,38 @@
+"""PeftStack: compose several PEFT methods.
+
+Reference: d9d/peft/all/method.py:14. Adapters are kept per-method (a
+tuple); materialize folds each method's adapters over the running params
+left-to-right, so e.g. (FullTune(norms), LoRA(attn)) trains norms directly
+while LoRA-ing attention.
+"""
+
+import dataclasses
+
+import jax
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.peft.base import PeftMethod
+
+
+@dataclasses.dataclass(frozen=True)
+class PeftStack(PeftMethod):
+    methods: tuple[PeftMethod, ...]
+
+    def inject(self, params: PyTree, rng: jax.Array) -> tuple[PyTree, PyTree]:
+        adapters = []
+        for i, m in enumerate(self.methods):
+            params, a = m.inject(params, jax.random.fold_in(rng, i))
+            adapters.append(a)
+        return params, tuple(adapters)
+
+    def materialize(self, base: PyTree, adapters: PyTree) -> PyTree:
+        p = base
+        for m, a in zip(self.methods, adapters):
+            p = m.materialize(p, a)
+        return p
+
+    def merge(self, base: PyTree, adapters: PyTree) -> PyTree:
+        p = base
+        for m, a in zip(self.methods, adapters):
+            p = m.merge(p, a)
+        return p

@@ -1,0 +1,89 @@
+"""PEFT ↔ training-loop glue.
+
+``PeftTask`` wraps any ``TrainTask`` so the engine's "params" are just the
+adapter tree: the base is closed over (XLA keeps it resident, no copy per
+step) and stop-gradiented, so grads/optimizer state exist only for
+adapters — the reference achieves the same via a trainable-param predicate
+(d9d/loop/component/model_stage_factory.py:25,264).
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+
+from d9d_tpu.core.types import Array, PyTree
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.peft.base import PeftMethod
+
+
+class PeftTask(TrainTask):
+    def __init__(self, inner: TrainTask, method: PeftMethod, base: PyTree):
+        self.inner = inner
+        self.method = method
+        self.base = base
+
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        return self.inner.prepare_batch(batch)
+
+    def loss_fn(
+        self,
+        module: nn.Module,
+        adapters: PyTree,
+        microbatch: PyTree,
+        rng: Array,
+    ) -> tuple[Array, Array, dict[str, Array]]:
+        frozen = jax.lax.stop_gradient(self.base)
+        params = self.method.materialize(frozen, adapters)
+        return self.inner.loss_fn(module, params, microbatch, rng)
+
+    def metrics_postprocess(self, metrics: dict[str, Any]) -> dict[str, Any]:
+        return self.inner.metrics_postprocess(metrics)
+
+
+def adapter_state_dict(adapters: PyTree) -> dict[str, jax.Array]:
+    """Flatten adapters to the repo's canonical dotted-name dict
+    (model_state.io.module.flatten_params), ready for the safetensors
+    writer. PeftStack tuples are namespaced ``method_{i}.``. Adapter keys
+    created from param paths keep their '/' separators inside one segment
+    (they are opaque names, not re-split on load)."""
+    from d9d_tpu.model_state.io.module import flatten_params
+
+    if isinstance(adapters, tuple):
+        out = {}
+        for i, a in enumerate(adapters):
+            for k, v in adapter_state_dict(a).items():
+                out[f"method_{i}.{k}"] = v
+        return out
+    return flatten_params(adapters)
+
+
+def adapter_from_state_dict(
+    adapters_template: PyTree, state: dict[str, jax.Array]
+) -> PyTree:
+    """Inverse of :func:`adapter_state_dict`, shaped like the template."""
+    if isinstance(adapters_template, tuple):
+        parts = []
+        for i, tmpl in enumerate(adapters_template):
+            prefix = f"method_{i}."
+            sub = {
+                k[len(prefix):]: v for k, v in state.items() if k.startswith(prefix)
+            }
+            parts.append(adapter_from_state_dict(tmpl, sub))
+        return tuple(parts)
+
+    from d9d_tpu.model_state.io.module import flatten_params
+
+    flat_tmpl = flatten_params(adapters_template)
+    leaves = {}
+    for key, leaf in flat_tmpl.items():
+        if key not in state:
+            raise KeyError(f"adapter state missing {key}")
+        got = state[key]
+        if got.shape != leaf.shape:
+            raise ValueError(f"{key}: shape {got.shape} != expected {leaf.shape}")
+        leaves[key] = got.astype(leaf.dtype)
+
+    from d9d_tpu.model_state.io.module import unflatten_params
+
+    return unflatten_params(leaves)
