@@ -1,0 +1,50 @@
+"""Host offload primitives (wake/sleep support).
+
+Reference: d9d/core/offload/{tensor.py:26,49, api.py:8-79} — in-place swap
+of tensor storage to pinned host memory and back, identity preserved, used
+for colocated-RL sleep/wake. JAX arrays are immutable, so the TPU design
+swaps *trees*: ``offload_tree`` returns a host-resident copy plus the
+device shardings needed to restore; ``onload_tree`` puts it back. On TPU
+the transfer uses the ``pinned_host`` memory kind (stays addressable by
+the runtime, fast DMA back); elsewhere it falls back to host numpy.
+
+``SleepTag`` mirrors the reference granularity: callers pick which groups
+(model / optimizer) to offload.
+"""
+
+import enum
+import logging
+
+import jax
+
+from d9d_tpu.core.types import PyTree
+
+logger = logging.getLogger("d9d_tpu.offload")
+
+
+class SleepTag(enum.Enum):
+    MODEL = "model"
+    OPTIMIZER = "optimizer"
+
+
+def offload_tree(tree: PyTree) -> tuple[PyTree, PyTree]:
+    """→ (host_tree, device_shardings). Device buffers are released once
+    the transfer completes and no other reference holds them."""
+    shardings = jax.tree.map(lambda x: x.sharding, tree)
+    try:
+        host_shardings = jax.tree.map(
+            lambda s: s.with_memory_kind("pinned_host"), shardings
+        )
+        host = jax.device_put(tree, host_shardings)
+        jax.block_until_ready(host)
+        return host, shardings
+    except (ValueError, TypeError, RuntimeError) as e:
+        logger.debug("pinned_host offload unavailable (%s); using numpy", e)
+        return jax.device_get(tree), shardings
+
+
+def onload_tree(host_tree: PyTree, shardings: PyTree) -> PyTree:
+    """Restore an offloaded tree onto devices with its original shardings."""
+    out = jax.device_put(host_tree, shardings)
+    jax.block_until_ready(out)
+    return out

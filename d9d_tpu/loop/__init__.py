@@ -1,5 +1,10 @@
 from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.components.checkpointer import StateCheckpointer
+from d9d_tpu.loop.components.data_loader import StatefulDataLoader, default_collate
+from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
+from d9d_tpu.loop.components.job_profiler import JobProfiler
 from d9d_tpu.loop.components.stepper import StepActionPeriod, Stepper
+from d9d_tpu.loop.components.timeout_manager import TimeoutManager
 from d9d_tpu.loop.config import InferenceConfig, TrainerConfig
 from d9d_tpu.loop.control.providers import (
     AdamWProvider,
@@ -8,6 +13,7 @@ from d9d_tpu.loop.control.providers import (
     OptimizerProvider,
 )
 from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.loop.tasks import CausalLMTask
 from d9d_tpu.loop.train import Trainer
@@ -15,8 +21,14 @@ from d9d_tpu.loop.train_step import build_train_step
 
 __all__ = [
     "BatchMaths",
+    "StateCheckpointer",
+    "StatefulDataLoader",
+    "default_collate",
+    "ManualGarbageCollector",
+    "JobProfiler",
     "StepActionPeriod",
     "Stepper",
+    "TimeoutManager",
     "InferenceConfig",
     "TrainerConfig",
     "AdamWProvider",
@@ -24,6 +36,7 @@ __all__ = [
     "ModelProvider",
     "OptimizerProvider",
     "TrainTask",
+    "EventBus",
     "init_sharded_params",
     "CausalLMTask",
     "Trainer",

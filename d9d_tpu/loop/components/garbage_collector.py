@@ -1,0 +1,31 @@
+"""Manual garbage collection.
+
+Reference: d9d/loop/component/garbage_collector.py:13 — automatic GC causes
+jittery step times on the hot loop (host must keep up with async dispatch
+on TPU just as with CUDA streams); disable it and collect deterministically
+every N steps instead.
+"""
+
+import gc
+
+
+class ManualGarbageCollector:
+    def __init__(self, every_steps: int | None = 100):
+        self.every_steps = every_steps
+        self._was_enabled = False
+
+    def __enter__(self):
+        if self.every_steps is not None:
+            self._was_enabled = gc.isenabled()
+            gc.disable()
+            gc.collect()
+        return self
+
+    def __exit__(self, *exc):
+        if self.every_steps is not None and self._was_enabled:
+            gc.enable()
+        return False
+
+    def step(self, step: int) -> None:
+        if self.every_steps is not None and step % self.every_steps == 0:
+            gc.collect()

@@ -1,0 +1,60 @@
+"""Periodic jax.profiler tracing.
+
+Reference: d9d/internals/profiling/profile.py:11 + loop/component/
+job_profiler.py:13 — torch.profiler with a wait/warmup/active periodic
+schedule, per-rank chrome traces. TPU equivalent: ``jax.profiler`` traces
+(viewable in XProf/TensorBoard, incl. device HLO timelines); one trace dir
+per cycle, named by step and process index.
+"""
+
+import logging
+from pathlib import Path
+
+import jax
+
+logger = logging.getLogger("d9d_tpu.profiler")
+
+
+class JobProfiler:
+    """Trace ``active_steps`` steps every ``every_steps`` (first cycle after
+    ``wait_steps``). No-op when ``every_steps`` is None."""
+
+    def __init__(
+        self,
+        trace_dir: str | Path | None = None,
+        *,
+        every_steps: int | None = None,
+        active_steps: int = 3,
+        wait_steps: int = 10,
+    ):
+        self.trace_dir = Path(trace_dir) if trace_dir else None
+        self.every_steps = every_steps
+        self.active_steps = active_steps
+        self.wait_steps = wait_steps
+        self._tracing_until: int | None = None
+
+    def _should_start(self, step: int) -> bool:
+        if self.every_steps is None or self.trace_dir is None:
+            return False
+        if step < self.wait_steps:
+            return False
+        return (step - self.wait_steps) % self.every_steps == 0
+
+    def step_begin(self, step: int) -> None:
+        if self._tracing_until is None and self._should_start(step):
+            out = self.trace_dir / f"step_{step}_proc_{jax.process_index()}"
+            out.mkdir(parents=True, exist_ok=True)
+            logger.info("profiler: tracing steps %d..%d -> %s",
+                        step, step + self.active_steps - 1, out)
+            jax.profiler.start_trace(str(out))
+            self._tracing_until = step + self.active_steps
+
+    def step_end(self, step: int) -> None:
+        if self._tracing_until is not None and step + 1 >= self._tracing_until:
+            jax.profiler.stop_trace()
+            self._tracing_until = None
+
+    def close(self) -> None:
+        if self._tracing_until is not None:
+            jax.profiler.stop_trace()
+            self._tracing_until = None

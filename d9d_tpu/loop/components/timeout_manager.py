@@ -1,0 +1,79 @@
+"""Hang detection via a watchdog thread.
+
+Reference: d9d/loop/component/timeout_manager.py:15 — two-phase NCCL
+timeouts (generous at init, tight per-step) so a hung collective kills the
+job fast instead of burning a pod for hours. JAX has no per-collective
+timeout knob, so the TPU equivalent is a host watchdog: the trainer pets it
+at every step boundary; if no heartbeat arrives within the active window
+the watchdog dumps all Python stacks and hard-exits, letting the job
+scheduler restart-and-resume (the reference's recovery model).
+"""
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+
+logger = logging.getLogger("d9d_tpu.timeout")
+
+
+class TimeoutManager:
+    def __init__(
+        self,
+        *,
+        init_timeout_s: float | None = None,
+        step_timeout_s: float | None = None,
+    ):
+        self.init_timeout_s = init_timeout_s
+        self.step_timeout_s = step_timeout_s
+        self._deadline: float | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _arm(self, timeout_s: float | None) -> None:
+        with self._lock:
+            self._deadline = (
+                time.monotonic() + timeout_s if timeout_s is not None else None
+            )
+
+    def set_init(self) -> None:
+        self._arm(self.init_timeout_s)
+
+    def set_periodic(self) -> None:
+        """Heartbeat: call at every step boundary."""
+        self._arm(self.step_timeout_s)
+
+    def disarm(self) -> None:
+        self._arm(None)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(1.0):
+            with self._lock:
+                deadline = self._deadline
+            if deadline is not None and time.monotonic() > deadline:
+                logger.critical(
+                    "watchdog timeout: no step heartbeat — dumping stacks and exiting"
+                )
+                faulthandler.dump_traceback(file=sys.stderr)
+                os._exit(42)
+
+    def __enter__(self):
+        if self.init_timeout_s is not None or self.step_timeout_s is not None:
+            self._stop = threading.Event()  # fresh per entry: reusable
+            self._thread = threading.Thread(
+                target=self._watch, name="d9d-watchdog", daemon=True
+            )
+            self._thread.start()
+            self.set_init()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self.disarm()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        return False

@@ -1,4 +1,8 @@
-"""Trainer configuration (reference: d9d/loop/config/config.py:169)."""
+"""Trainer configuration (reference: d9d/loop/config/config.py:169).
+
+Flat pydantic config; sub-knob groups (checkpoint/profile/watchdog/gc)
+default to off so the minimum slice stays one-screen simple.
+"""
 
 import pydantic
 
@@ -14,6 +18,26 @@ class TrainerConfig(pydantic.BaseModel):
     max_grad_norm: float | None = 1.0
     seed: int = 0
     log_every: int = 10
+    run_name: str | None = None
+
+    # checkpoint/resume (reference component/checkpointer.py:27)
+    checkpoint_dir: str | None = None
+    checkpoint_every_steps: int | None = None
+    checkpoints_to_keep: int | None = 3
+    resume: bool = True
+
+    # profiling (reference component/job_profiler.py:13)
+    profile_dir: str | None = None
+    profile_every_steps: int | None = None
+    profile_active_steps: int = 3
+    profile_wait_steps: int = 10
+
+    # hang watchdog (reference component/timeout_manager.py:15)
+    init_timeout_s: float | None = None
+    step_timeout_s: float | None = None
+
+    # manual GC (reference component/garbage_collector.py:13)
+    gc_every_steps: int | None = 100
 
 
 class InferenceConfig(pydantic.BaseModel):
@@ -22,3 +46,4 @@ class InferenceConfig(pydantic.BaseModel):
     batch_size: int
     seq_len: int
     seed: int = 0
+    log_every: int = 10

@@ -1,0 +1,84 @@
+"""Typed event bus + train/inference event catalogue.
+
+Reference: d9d/loop/event/core.py:25 (EventBus with ``bounded()`` pre/post
+context manager) and event/catalogue/train.py. Components subscribe to
+lifecycle events; user code can hook e.g. STEP_POST for custom logging
+without touching the trainer. Events are plain frozen descriptors; the bus
+is synchronous (handlers run inline, deterministic order).
+"""
+
+import contextlib
+import dataclasses
+from collections import defaultdict
+from collections.abc import Callable
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """A named lifecycle point. ``bounded`` events exist as .pre/.post."""
+
+    name: str
+
+    def __repr__(self):
+        return f"Event({self.name})"
+
+
+@dataclasses.dataclass(frozen=True)
+class BoundedEvent:
+    name: str
+
+    @property
+    def pre(self) -> Event:
+        return Event(f"{self.name}.pre")
+
+    @property
+    def post(self) -> Event:
+        return Event(f"{self.name}.post")
+
+
+class EventBus:
+    def __init__(self):
+        self._handlers: dict[Event, list[Callable[..., None]]] = defaultdict(list)
+
+    def subscribe(self, event: Event, handler: Callable[..., None]) -> None:
+        self._handlers[event].append(handler)
+
+    def unsubscribe(self, event: Event, handler: Callable[..., None]) -> None:
+        self._handlers[event].remove(handler)
+
+    def emit(self, event: Event, /, **payload: Any) -> None:
+        for handler in list(self._handlers.get(event, ())):
+            handler(**payload)
+
+    @contextlib.contextmanager
+    def bounded(self, event: BoundedEvent, /, **payload: Any):
+        """Emit ``event.pre``, run the body, emit ``event.post`` (post fires
+        only on success — an exception propagates without the post event,
+        matching the reference's bounded() semantics)."""
+        self.emit(event.pre, **payload)
+        yield
+        self.emit(event.post, **payload)
+
+
+# -- catalogue (reference loop/event/catalogue/train.py) ----------------
+
+EVENT_TRAIN_CONFIG_STARTED = Event("train.config_started")
+EVENT_DATA_LOADER_READY = Event("train.data_loader_ready")
+EVENT_MODEL_READY = Event("train.model_ready")
+EVENT_OPTIMIZER_READY = Event("train.optimizer_ready")
+EVENT_LR_SCHEDULER_READY = Event("train.lr_scheduler_ready")
+EVENT_TRAIN_READY = Event("train.ready")
+EVENT_TRAIN_FINISHED = Event("train.finished")
+
+EVENT_STEP = BoundedEvent("train.step")
+EVENT_FORWARD_BACKWARD = BoundedEvent("train.forward_backward")
+EVENT_OPTIMIZER_STEP = BoundedEvent("train.optimizer_step")
+EVENT_CHECKPOINT = BoundedEvent("train.checkpoint")
+EVENT_SLEEP = BoundedEvent("train.sleep")
+EVENT_WAKE = BoundedEvent("train.wake")
+
+EVENT_INFER_CONFIG_STARTED = Event("infer.config_started")
+EVENT_INFER_READY = Event("infer.ready")
+EVENT_INFER_FINISHED = Event("infer.finished")
+EVENT_INFER_BATCH = BoundedEvent("infer.batch")

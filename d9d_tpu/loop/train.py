@@ -3,7 +3,10 @@
 Reference: d9d/loop/run/train.py:71,251 (TrainingConfigurator/Trainer).
 The configure step builds mesh→model→optimizer→step-fn; ``train()`` is a
 thin host loop around the jitted step — data staging and metric readback
-are the only per-step host work (hot path is one XLA program).
+are the only per-step host work (hot path is one XLA program). Around it
+sit the reference's loop components: event bus, tracker-backed logger,
+orbax job-state checkpointer with resume, jax.profiler cycles, manual GC,
+hang watchdog, and sleep/wake host offload.
 """
 
 import logging
@@ -15,9 +18,15 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.offload import SleepTag, offload_tree, onload_tree
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.loop import event as ev
 from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.components.checkpointer import StateCheckpointer
+from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
+from d9d_tpu.loop.components.job_profiler import JobProfiler
 from d9d_tpu.loop.components.stepper import Stepper
+from d9d_tpu.loop.components.timeout_manager import TimeoutManager
 from d9d_tpu.loop.config import TrainerConfig
 from d9d_tpu.loop.control.providers import (
     DatasetProvider,
@@ -25,9 +34,11 @@ from d9d_tpu.loop.control.providers import (
     OptimizerProvider,
 )
 from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.loop.train_step import build_eval_step, build_train_step
 from d9d_tpu.pipelining import PipelineStageInfo
+from d9d_tpu.tracker import NullTracker, Tracker
 
 logger = logging.getLogger("d9d_tpu.trainer")
 
@@ -44,10 +55,16 @@ class Trainer:
         optimizer_provider: OptimizerProvider,
         learning_rate: optax.ScalarOrSchedule | None = None,
         peft_method=None,
+        tracker: Tracker | None = None,
+        event_bus: EventBus | None = None,
     ):
         self.ctx = ctx
         self.config = config
         self.task = task
+        self.events = event_bus if event_bus is not None else EventBus()
+        self.tracker = tracker if tracker is not None else NullTracker()
+        self.events.emit(ev.EVENT_TRAIN_CONFIG_STARTED, trainer=self)
+
         self.batch_maths = BatchMaths.from_context(
             ctx, config.global_batch_size, config.microbatch_size
         )
@@ -76,11 +93,13 @@ class Trainer:
             )
             self.params = adapters
             self.task = task = PeftTask(task, peft_method, self.base_params)
+        self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
 
         self.optimizer = optimizer_provider.build(
             learning_rate if learning_rate is not None else config.learning_rate
         )
         self.opt_state = jax.jit(self.optimizer.init)(self.params)
+        self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
 
         self.step_fn = build_train_step(
             module=self.module,
@@ -89,10 +108,37 @@ class Trainer:
             num_microbatches=self.batch_maths.num_microbatches,
             max_grad_norm=config.max_grad_norm,
         )
-        self.dataset = dataset_provider
+
+        self.dataset_provider = dataset_provider
+        self.data_loader = None  # built fresh per train() call
+
+        self.checkpointer = (
+            StateCheckpointer(
+                config.checkpoint_dir,
+                save_every_steps=config.checkpoint_every_steps,
+                num_to_keep=config.checkpoints_to_keep,
+            )
+            if config.checkpoint_dir is not None
+            else None
+        )
+        self.profiler = JobProfiler(
+            config.profile_dir,
+            every_steps=config.profile_every_steps,
+            active_steps=config.profile_active_steps,
+            wait_steps=config.profile_wait_steps,
+        )
+        self.timeout = TimeoutManager(
+            init_timeout_s=config.init_timeout_s,
+            step_timeout_s=config.step_timeout_s,
+        )
+        self.gc = ManualGarbageCollector(config.gc_every_steps)
+        self.run = None  # tracker run, opened in train()
+        self._sleep_store: dict[SleepTag, tuple[PyTree, PyTree]] = {}
+
         self._batch_sharding = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
         self._eval_fn = None
         self._merge_fn = None
+        self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
 
     # ------------------------------------------------------------------
 
@@ -113,32 +159,150 @@ class Trainer:
         batch = jax.tree.map(reshape, batch)
         return jax.device_put(batch, self._batch_sharding)
 
+    # -- checkpoint/resume ---------------------------------------------
+
+    def _job_arrays(self) -> PyTree:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def _job_meta(self) -> dict:
+        meta = {"step": self.stepper.step}
+        if self.data_loader is not None and hasattr(self.data_loader, "state_dict"):
+            meta["data_loader"] = self.data_loader.state_dict()
+        if self.run is not None:
+            meta["tracker"] = self.run.state_dict()
+        return meta
+
+    def _save_checkpoint(self, *, last: bool = False) -> None:
+        if self.checkpointer is None:
+            return
+        step = self.stepper.step
+        if not self.checkpointer.should_checkpoint(step, last=last):
+            return
+        with self.events.bounded(ev.EVENT_CHECKPOINT, trainer=self, step=step):
+            self.checkpointer.save(step, self._job_arrays(), self._job_meta())
+
+    def _try_resume(self) -> None:
+        if self.checkpointer is None or not self.config.resume:
+            return
+        restored = self.checkpointer.restore(self._job_arrays())
+        if restored is None:
+            return
+        step, arrays, meta = restored
+        self.params = arrays["params"]
+        self.opt_state = arrays["opt_state"]
+        self.stepper.load_state_dict({"step": meta["step"]})
+        if (
+            "data_loader" in meta
+            and self.data_loader is not None
+            and hasattr(self.data_loader, "load_state_dict")
+        ):
+            self.data_loader.load_state_dict(meta["data_loader"])
+        if "tracker" in meta and self.run is not None:
+            self.run.load_state_dict(meta["tracker"])
+        logger.info("resumed from checkpoint at step %d", step)
+
+    # -- the loop ------------------------------------------------------
+
     def train(self) -> list[dict]:
         """Run until total_steps or data exhaustion; returns metric history."""
         history: list[dict] = []
+        self.data_loader = self.dataset_provider.build()
+        self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
+        self.run = self.tracker.new_run(self.config.run_name)
+        # resume BEFORE hparams: restoring the tracker run hash re-points
+        # output at the original run
+        self._try_resume()
+        self.run.track_hparams(self.config.model_dump())
         t0 = time.perf_counter()
-        data_iter = iter(self.dataset.build())
-        while not self.stepper.finished:
-            try:
-                raw = next(data_iter)
-            except StopIteration:
-                break
-            batch = self._stage_batch(raw)
-            rng = jax.random.fold_in(self.step_rng, self.stepper.step)
-            self.params, self.opt_state, metrics = self.step_fn(
-                self.params, self.opt_state, batch, rng
-            )
-            step = self.stepper.advance()
-            if step % self.config.log_every == 0 or self.stepper.finished:
-                host_metrics = {
-                    k: float(np.asarray(v)) for k, v in metrics.items()
-                }
-                host_metrics = self.task.metrics_postprocess(host_metrics)
-                host_metrics["step"] = step
-                host_metrics["wall_s"] = time.perf_counter() - t0
-                history.append(host_metrics)
-                logger.info("step %d: %s", step, host_metrics)
+        data_iter = iter(self.data_loader)
+        try:
+            with self.timeout, self.gc:
+                while not self.stepper.finished:
+                    try:
+                        raw = next(data_iter)
+                    except StopIteration:
+                        break
+                    step = self.stepper.step
+                    self.profiler.step_begin(step)
+                    with self.events.bounded(ev.EVENT_STEP, trainer=self, step=step):
+                        batch = self._stage_batch(raw)
+                        rng = jax.random.fold_in(self.step_rng, step)
+                        with self.events.bounded(
+                            ev.EVENT_FORWARD_BACKWARD, trainer=self, step=step
+                        ):
+                            self.params, self.opt_state, metrics = self.step_fn(
+                                self.params, self.opt_state, batch, rng
+                            )
+                    step = self.stepper.advance()
+                    self.profiler.step_end(step - 1)
+                    self.gc.step(step)
+                    self.timeout.set_periodic()
+                    if step % self.config.log_every == 0 or self.stepper.finished:
+                        host_metrics = {
+                            k: float(np.asarray(v)) for k, v in metrics.items()
+                        }
+                        host_metrics = self.task.metrics_postprocess(host_metrics)
+                        host_metrics["step"] = step
+                        host_metrics["wall_s"] = time.perf_counter() - t0
+                        history.append(host_metrics)
+                        for k, v in host_metrics.items():
+                            if k != "step":
+                                self.run.track_scalar(
+                                    f"train/{k}", v, step=step,
+                                    context={"subset": "train"},
+                                )
+                        logger.info("step %d: %s", step, host_metrics)
+                    self._save_checkpoint()
+                self._save_checkpoint(last=True)
+            self.events.emit(ev.EVENT_TRAIN_FINISHED, trainer=self)
+        finally:
+            # release the profiler trace and flush/close the tracker run even
+            # when a step raises (a dangling trace breaks the next train())
+            self.profiler.close()
+            self.run.close()
         return history
+
+    def close(self) -> None:
+        """Release held resources (checkpoint manager IO threads)."""
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+
+    # -- sleep/wake (reference component/train_sleeper.py:22) ----------
+
+    def sleep(self, tags: set[SleepTag] = frozenset(SleepTag)) -> None:
+        """Offload model/optimizer state to host, freeing device HBM."""
+        with self.events.bounded(ev.EVENT_SLEEP, trainer=self):
+            if SleepTag.MODEL in tags and SleepTag.MODEL not in self._sleep_store:
+                self._sleep_store[SleepTag.MODEL] = offload_tree(self.params)
+                self.params = None
+            if (
+                SleepTag.OPTIMIZER in tags
+                and SleepTag.OPTIMIZER not in self._sleep_store
+            ):
+                self._sleep_store[SleepTag.OPTIMIZER] = offload_tree(self.opt_state)
+                self.opt_state = None
+
+    def wake(self) -> None:
+        """Restore everything offloaded by :meth:`sleep`."""
+        with self.events.bounded(ev.EVENT_WAKE, trainer=self):
+            if SleepTag.MODEL in self._sleep_store:
+                host, sh = self._sleep_store.pop(SleepTag.MODEL)
+                self.params = onload_tree(host, sh)
+            if SleepTag.OPTIMIZER in self._sleep_store:
+                host, sh = self._sleep_store.pop(SleepTag.OPTIMIZER)
+                self.opt_state = onload_tree(host, sh)
+
+    # -- export (reference component/model_stage_exporter.py:11) -------
+
+    def export(self, out_dir, mapper=None, shard_size_gb: float = 4.0) -> None:
+        """Write the (merged) model weights as sharded safetensors via the
+        model_state mapper system."""
+        from d9d_tpu.model_state.io.module import save_params
+
+        save_params(
+            out_dir, self.merged_params(), mapper=mapper,
+            shard_size_gb=shard_size_gb,
+        )
 
     def merged_params(self) -> PyTree:
         """Full parameter tree for export: identity without PEFT, adapters

@@ -1,0 +1,22 @@
+"""Experiment trackers (reference: d9d/tracker)."""
+
+from d9d_tpu.tracker.base import Tracker, TrackerRun
+from d9d_tpu.tracker.providers import (
+    AimTracker,
+    JsonlTracker,
+    MemoryTracker,
+    MemoryTrackerRun,
+    NullTracker,
+    build_tracker,
+)
+
+__all__ = [
+    "Tracker",
+    "TrackerRun",
+    "AimTracker",
+    "JsonlTracker",
+    "MemoryTracker",
+    "MemoryTrackerRun",
+    "NullTracker",
+    "build_tracker",
+]

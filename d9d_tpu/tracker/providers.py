@@ -1,0 +1,216 @@
+"""Tracker providers: Null, in-memory, JSONL file, and Aim (gated).
+
+Reference: d9d/tracker/provider/{null.py:40, aim/tracker.py} and
+factory.py:14,31 (import-failure stub). The TPU build adds a JSONL file
+tracker (no external service needed on a pod) and keeps Aim behind a
+lazy import that degrades to Null with a warning, matching the reference
+factory's behavior when the extra isn't installed.
+"""
+
+import json
+import logging
+import time
+import uuid
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from d9d_tpu.tracker.base import Tracker, TrackerRun
+
+logger = logging.getLogger("d9d_tpu.tracker")
+
+
+class NullTrackerRun(TrackerRun):
+    def track_scalar(self, name, value, *, step, context=None):
+        pass
+
+    def track_histogram(self, name, counts, bin_edges, *, step, context=None):
+        pass
+
+
+class NullTracker(Tracker):
+    def new_run(self, run_name=None):
+        return NullTrackerRun()
+
+
+class MemoryTrackerRun(TrackerRun):
+    """Keeps everything in lists — the test/debug tracker."""
+
+    def __init__(self, run_hash: str | None = None):
+        self.run_hash = run_hash or uuid.uuid4().hex
+        self.scalars: list[dict[str, Any]] = []
+        self.histograms: list[dict[str, Any]] = []
+        self.hparams: dict[str, Any] = {}
+        self.closed = False
+
+    def track_scalar(self, name, value, *, step, context=None):
+        self.scalars.append(
+            {"name": name, "value": float(value), "step": step, "context": context or {}}
+        )
+
+    def track_histogram(self, name, counts, bin_edges, *, step, context=None):
+        self.histograms.append(
+            {
+                "name": name,
+                "counts": np.asarray(counts).tolist(),
+                "bin_edges": np.asarray(bin_edges).tolist(),
+                "step": step,
+                "context": context or {},
+            }
+        )
+
+    def track_hparams(self, hparams):
+        self.hparams.update(hparams)
+
+    def close(self):
+        self.closed = True
+
+    def state_dict(self):
+        return {"run_hash": self.run_hash}
+
+    def load_state_dict(self, state):
+        self.run_hash = state.get("run_hash", self.run_hash)
+
+
+class MemoryTracker(Tracker):
+    def __init__(self):
+        self.runs: list[MemoryTrackerRun] = []
+
+    def new_run(self, run_name=None):
+        run = MemoryTrackerRun()
+        self.runs.append(run)
+        return run
+
+
+class JsonlTrackerRun(TrackerRun):
+    """Appends one JSON object per tracked value to ``{dir}/{hash}.jsonl``."""
+
+    def __init__(self, directory: Path, run_hash: str | None = None):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.run_hash = run_hash or uuid.uuid4().hex
+        self._fh = None
+
+    def _file(self):
+        if self._fh is None:
+            self._fh = open(self.directory / f"{self.run_hash}.jsonl", "a")
+        return self._fh
+
+    def _emit(self, obj: dict[str, Any]):
+        obj["ts"] = time.time()
+        self._file().write(json.dumps(obj) + "\n")
+        self._file().flush()
+
+    def track_scalar(self, name, value, *, step, context=None):
+        self._emit(
+            {"kind": "scalar", "name": name, "value": float(value), "step": step,
+             "context": context or {}}
+        )
+
+    def track_histogram(self, name, counts, bin_edges, *, step, context=None):
+        self._emit(
+            {
+                "kind": "histogram",
+                "name": name,
+                "counts": np.asarray(counts).tolist(),
+                "bin_edges": np.asarray(bin_edges).tolist(),
+                "step": step,
+                "context": context or {},
+            }
+        )
+
+    def track_hparams(self, hparams):
+        self._emit({"kind": "hparams", "hparams": hparams})
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def state_dict(self):
+        return {"run_hash": self.run_hash}
+
+    def load_state_dict(self, state):
+        new_hash = state.get("run_hash", self.run_hash)
+        if new_hash != self.run_hash:
+            # re-point the (possibly already opened) file at the restored run
+            self.close()
+            self.run_hash = new_hash
+
+
+class JsonlTracker(Tracker):
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+
+    def new_run(self, run_name=None):
+        return JsonlTrackerRun(self.directory)
+
+
+class AimTrackerRun(TrackerRun):  # pragma: no cover - needs aim installed
+    def __init__(self, repo: str | None, experiment: str | None, run_hash=None):
+        import aim
+
+        self._repo = repo
+        self._experiment = experiment
+        self._run = aim.Run(run_hash=run_hash, repo=repo, experiment=experiment)
+        self._aim = aim
+
+    def track_scalar(self, name, value, *, step, context=None):
+        self._run.track(float(value), name=name, step=step, context=context or {})
+
+    def track_histogram(self, name, counts, bin_edges, *, step, context=None):
+        dist = self._aim.Distribution(
+            hist=np.asarray(counts), bin_range=(bin_edges[0], bin_edges[-1])
+        )
+        self._run.track(dist, name=name, step=step, context=context or {})
+
+    def track_hparams(self, hparams):
+        for k, v in hparams.items():
+            self._run[k] = v
+
+    def close(self):
+        self._run.close()
+
+    def state_dict(self):
+        return {"run_hash": self._run.hash}
+
+    def load_state_dict(self, state):
+        run_hash = state.get("run_hash")
+        if run_hash and run_hash != self._run.hash:
+            # reopen the original run so a resumed job keeps appending to it
+            self._run.close()
+            self._run = self._aim.Run(
+                run_hash=run_hash, repo=self._repo, experiment=self._experiment
+            )
+
+
+class AimTracker(Tracker):  # pragma: no cover - needs aim installed
+    def __init__(self, repo: str | None = None, experiment: str | None = None):
+        self.repo = repo
+        self.experiment = experiment
+
+    def new_run(self, run_name=None):
+        return AimTrackerRun(self.repo, self.experiment or run_name)
+
+
+def build_tracker(kind: str = "null", **kwargs) -> Tracker:
+    """Factory (reference tracker/factory.py:14): unknown/unavailable
+    providers degrade to NullTracker with a warning instead of failing the
+    job."""
+    if kind == "null":
+        return NullTracker()
+    if kind == "memory":
+        return MemoryTracker()
+    if kind == "jsonl":
+        return JsonlTracker(**kwargs)
+    if kind == "aim":
+        try:
+            import aim  # noqa: F401
+
+            return AimTracker(**kwargs)
+        except ImportError:
+            logger.warning("aim not installed; falling back to NullTracker")
+            return NullTracker()
+    logger.warning("unknown tracker %r; falling back to NullTracker", kind)
+    return NullTracker()

@@ -1,0 +1,169 @@
+"""Unit tests for loop components: event bus, stateful dataloader, tracker,
+GC, timeout manager (reference test coverage: loop component/event units)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from d9d_tpu.loop.components.data_loader import StatefulDataLoader
+from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
+from d9d_tpu.loop.components.timeout_manager import TimeoutManager
+from d9d_tpu.loop.event import (
+    EVENT_STEP,
+    EVENT_TRAIN_READY,
+    EventBus,
+)
+from d9d_tpu.tracker import JsonlTracker, MemoryTracker, build_tracker, NullTracker
+
+
+class TestEventBus:
+    def test_emit_order_and_payload(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(EVENT_TRAIN_READY, lambda **kw: seen.append(("a", kw)))
+        bus.subscribe(EVENT_TRAIN_READY, lambda **kw: seen.append(("b", kw)))
+        bus.emit(EVENT_TRAIN_READY, trainer="t")
+        assert [s[0] for s in seen] == ["a", "b"]
+        assert seen[0][1] == {"trainer": "t"}
+
+    def test_bounded_pre_post(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(EVENT_STEP.pre, lambda **kw: seen.append("pre"))
+        bus.subscribe(EVENT_STEP.post, lambda **kw: seen.append("post"))
+        with bus.bounded(EVENT_STEP, step=1):
+            seen.append("body")
+        assert seen == ["pre", "body", "post"]
+
+    def test_bounded_no_post_on_error(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe(EVENT_STEP.post, lambda **kw: seen.append("post"))
+        with pytest.raises(RuntimeError):
+            with bus.bounded(EVENT_STEP, step=1):
+                raise RuntimeError("boom")
+        assert seen == []
+
+    def test_unsubscribe(self):
+        bus = EventBus()
+        seen = []
+        h = lambda **kw: seen.append(1)
+        bus.subscribe(EVENT_TRAIN_READY, h)
+        bus.unsubscribe(EVENT_TRAIN_READY, h)
+        bus.emit(EVENT_TRAIN_READY)
+        assert seen == []
+
+
+class _Items:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.array([i, i + 1])}
+
+
+class TestStatefulDataLoader:
+    def test_batches_and_shapes(self):
+        dl = StatefulDataLoader(_Items(10), 4, shuffle=False)
+        batches = list(dl)
+        assert len(batches) == 2  # drop_last
+        assert batches[0]["x"].shape == (4, 2)
+
+    def test_shuffle_deterministic_per_seed(self):
+        a = [b["x"][:, 0].tolist() for b in StatefulDataLoader(_Items(16), 4, seed=3)]
+        b = [b["x"][:, 0].tolist() for b in StatefulDataLoader(_Items(16), 4, seed=3)]
+        c = [b["x"][:, 0].tolist() for b in StatefulDataLoader(_Items(16), 4, seed=4)]
+        assert a == b
+        assert a != c
+
+    def test_resume_mid_epoch_exact(self):
+        full = [b["x"].tolist() for b in StatefulDataLoader(_Items(32), 4, seed=1, num_epochs=2)]
+
+        dl1 = StatefulDataLoader(_Items(32), 4, seed=1, num_epochs=2)
+        it = iter(dl1)
+        first = [next(it)["x"].tolist() for _ in range(5)]  # crosses nothing
+        state = dl1.state_dict()
+
+        dl2 = StatefulDataLoader(_Items(32), 4, seed=1, num_epochs=2)
+        dl2.load_state_dict(state)
+        rest = [b["x"].tolist() for b in dl2]
+        assert first + rest == full
+
+    def test_resume_across_epoch_boundary(self):
+        full = [b["x"].tolist() for b in StatefulDataLoader(_Items(8), 4, seed=1, num_epochs=3)]
+        dl1 = StatefulDataLoader(_Items(8), 4, seed=1, num_epochs=3)
+        it = iter(dl1)
+        first = [next(it)["x"].tolist() for _ in range(3)]  # 2 per epoch: crosses
+        state = dl1.state_dict()
+        dl2 = StatefulDataLoader(_Items(8), 4, seed=1, num_epochs=3)
+        dl2.load_state_dict(state)
+        rest = [b["x"].tolist() for b in dl2]
+        assert first + rest == full
+
+    def test_state_key_is_process_namespaced(self):
+        dl = StatefulDataLoader(_Items(8), 4)
+        assert list(dl.state_dict().keys()) == ["process_0"]
+
+
+class TestTrackers:
+    def test_memory_tracker(self):
+        t = MemoryTracker()
+        run = t.new_run()
+        run.track_scalar("loss", 1.5, step=1, context={"subset": "train"})
+        run.track_histogram("w", [1, 2], [0.0, 0.5, 1.0], step=1)
+        run.track_hparams({"lr": 0.1})
+        run.close()
+        assert run.scalars[0]["value"] == 1.5
+        assert run.histograms[0]["bin_edges"] == [0.0, 0.5, 1.0]
+        assert run.hparams == {"lr": 0.1}
+        assert run.closed
+
+    def test_jsonl_tracker(self, tmp_path):
+        t = JsonlTracker(tmp_path)
+        run = t.new_run()
+        run.track_scalar("loss", 2.0, step=3)
+        run.close()
+        files = list(tmp_path.glob("*.jsonl"))
+        assert len(files) == 1
+        rec = json.loads(files[0].read_text().splitlines()[0])
+        assert rec["name"] == "loss" and rec["step"] == 3
+
+    def test_run_hash_resume(self):
+        run = MemoryTracker().new_run()
+        state = run.state_dict()
+        run2 = MemoryTracker().new_run()
+        run2.load_state_dict(state)
+        assert run2.run_hash == run.run_hash
+
+    def test_factory_fallbacks(self):
+        assert isinstance(build_tracker("null"), NullTracker)
+        assert isinstance(build_tracker("memory"), MemoryTracker)
+        assert isinstance(build_tracker("definitely-not-a-tracker"), NullTracker)
+
+
+class TestGcAndTimeout:
+    def test_gc_context(self):
+        import gc
+
+        assert gc.isenabled()
+        with ManualGarbageCollector(every_steps=2) as m:
+            assert not gc.isenabled()
+            m.step(2)
+        assert gc.isenabled()
+
+    def test_timeout_noop_without_config(self):
+        with TimeoutManager() as tm:
+            tm.set_periodic()
+            tm.disarm()
+
+    def test_timeout_heartbeat_keeps_alive(self):
+        import time
+
+        with TimeoutManager(init_timeout_s=5.0, step_timeout_s=5.0) as tm:
+            for _ in range(3):
+                time.sleep(0.05)
+                tm.set_periodic()
