@@ -135,7 +135,13 @@ class Trainer:
         self.run = None  # tracker run, opened in train()
         self._sleep_store: dict[SleepTag, tuple[PyTree, PyTree]] = {}
 
-        self._batch_sharding = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
+        # [n_mb, batch, seq, ...]: batch over dp axes; for context-parallel
+        # meshes the sequence dim additionally shards over cp_s (rank-2
+        # leaves like per-example weights only get the batch axes)
+        self._batch_sharding = NamedSharding(
+            ctx.mesh, P(None, ctx.batch_axes, ctx.sequence_axes)
+        )
+        self._batch_sharding_2d = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
         self._eval_fn = None
         self._merge_fn = None
         self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
@@ -157,7 +163,18 @@ class Trainer:
             return x.reshape(n_mb, mb, *x.shape[1:])
 
         batch = jax.tree.map(reshape, batch)
-        return jax.device_put(batch, self._batch_sharding)
+        # the cp sequence sharding applies only to leaves whose dim 2 IS the
+        # sequence (identified by length): other rank-3+ leaves (e.g. [B, k]
+        # per-example features) stay batch-sharded
+        seq_len = self.config.seq_len
+
+        def pick(x):
+            if x.ndim >= 3 and x.shape[2] in (seq_len, seq_len + 1):
+                return self._batch_sharding
+            return self._batch_sharding_2d
+
+        shardings = jax.tree.map(pick, batch)
+        return jax.device_put(batch, shardings)
 
     # -- checkpoint/resume ---------------------------------------------
 

@@ -2,6 +2,7 @@ from d9d_tpu.nn.sdpa.config import (
     SdpaBackendConfig,
     SdpaEagerConfig,
     SdpaPallasFlashConfig,
+    SdpaRingConfig,
 )
 from d9d_tpu.nn.sdpa.factory import build_sdpa_backend
 from d9d_tpu.nn.sdpa.protocol import SdpaBackend
@@ -11,5 +12,6 @@ __all__ = [
     "SdpaBackendConfig",
     "SdpaEagerConfig",
     "SdpaPallasFlashConfig",
+    "SdpaRingConfig",
     "build_sdpa_backend",
 ]

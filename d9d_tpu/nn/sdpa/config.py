@@ -25,7 +25,17 @@ class SdpaPallasFlashConfig(pydantic.BaseModel):
     block_kv: int = 512
 
 
+class SdpaRingConfig(pydantic.BaseModel):
+    """Ring attention over the context-parallel mesh axis (ops/attention/
+    ring.py). Requires the model's sequence dim sharded over ``seq_axis``."""
+
+    type: Literal["ring"] = "ring"
+    seq_axis: str = "cp_s"
+    batch_axes: tuple[str, ...] = ("dp_r", "dp_s")
+    head_axes: tuple[str, ...] = ("tp",)
+
+
 SdpaBackendConfig = Annotated[
-    Union[SdpaEagerConfig, SdpaPallasFlashConfig],
+    Union[SdpaEagerConfig, SdpaPallasFlashConfig, SdpaRingConfig],
     pydantic.Field(discriminator="type"),
 ]

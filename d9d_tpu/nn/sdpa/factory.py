@@ -17,6 +17,7 @@ from d9d_tpu.nn.sdpa.config import (
     SdpaBackendConfig,
     SdpaEagerConfig,
     SdpaPallasFlashConfig,
+    SdpaRingConfig,
 )
 from d9d_tpu.nn.sdpa.protocol import SdpaBackend
 
@@ -51,6 +52,22 @@ def build_sdpa_backend(config: SdpaBackendConfig | None = None) -> SdpaBackend:
 
         return make_pallas_flash_sdpa(
             block_q=config.block_q, block_kv=config.block_kv
+        )
+    if isinstance(config, SdpaRingConfig):
+        from jax.sharding import get_abstract_mesh
+
+        from d9d_tpu.ops.attention.ring import make_ring_sdpa
+
+        mesh = get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            raise ValueError(
+                "ring sdpa needs an ambient mesh — build a MeshContext first"
+            )
+        return make_ring_sdpa(
+            mesh,
+            seq_axis=config.seq_axis,
+            batch_axes=config.batch_axes,
+            head_axes=config.head_axes,
         )
     raise TypeError(f"unknown sdpa config: {config!r}")
 

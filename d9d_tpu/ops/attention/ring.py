@@ -1,0 +1,184 @@
+"""Ring attention: context-parallel SDPA over a mesh axis.
+
+Beyond-reference capability (SURVEY.md §2.9: the reference reserves
+cp_shard/cp_replicate mesh dims but ships no CP implementation — every
+model plan raises). Here CP is first-class: the sequence dim is sharded
+over the ``cp_s`` mesh axis and attention runs as a ring
+(arXiv 2310.01889 style): each device keeps its query block resident and
+the K/V blocks rotate around the ring via ``ppermute`` over ICI, with
+online-softmax accumulation — peak memory per device is O(T/cp · T/cp)
+per block pair, and the rotation overlaps with the block matmuls under
+XLA's async collectives.
+
+Layout: contiguous sequence chunks — device ``i`` of the cp ring owns
+positions ``[i·T_loc, (i+1)·T_loc)``. Causal masking across chunks falls
+out of global position arithmetic (blocks strictly above the diagonal
+contribute zero mass through -inf logits; compute is uniform across steps
+so the program stays SPMD-static).
+
+``ring_attention`` must be called *inside* ``shard_map`` (it uses
+``axis_index``/``ppermute``); ``make_ring_sdpa`` wraps it into an SDPA
+backend usable by the attention blocks under plain jit.
+"""
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from d9d_tpu.core.types import Array
+
+_NEG_INF = float("-inf")
+
+
+def _block_logits(q, k, scale):
+    """q [B,T,Hkv,G,D] × k [B,S,Hkv,D] → logits [B,Hkv,G,T,S] (fp32)."""
+    return jnp.einsum("bthgd,bshd->bhgts", q, k.astype(jnp.float32)) * scale
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    softmax_scale: float | None = None,
+    window_size: int | None = None,
+    sinks: Array | None = None,
+) -> Array:
+    """Per-shard attention: ``q/k/v [B, T_loc, H(q|kv), D]`` → ``[B, T_loc, Hq, D]``.
+
+    Call inside ``shard_map`` with the sequence dim sharded over
+    ``axis_name``. Semantics match :func:`eager_sdpa` on the gathered
+    sequence (GQA broadcast, causal, sliding window, learnable sinks).
+    """
+    b, t_loc, hq, d = q.shape
+    _, s_loc, hkv, dv = v.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    if t_loc != s_loc:
+        raise ValueError("ring attention requires equal q/kv shard lengths")
+    g = hq // hkv
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    cp = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    q_pos = my_idx * t_loc + jnp.arange(t_loc)  # global positions [T_loc]
+
+    qf = q.astype(jnp.float32).reshape(b, t_loc, hkv, g, d)
+
+    # ring rotation: device r sends its current kv block to r+1, so after
+    # step s device i holds the block originally owned by (i - s) % cp
+    perm = [(r, (r + 1) % cp) for r in range(cp)]
+
+    def step(carry, s):
+        o, m, l, k_blk, v_blk = carry
+        src = (my_idx - s) % cp
+        k_pos = src * t_loc + jnp.arange(t_loc)
+
+        logits = _block_logits(qf, k_blk, scale)  # [B,Hkv,G,T,S]
+        neg = jnp.asarray(_NEG_INF, logits.dtype)
+        qp = q_pos[:, None]
+        kp = k_pos[None, :]
+        if causal:
+            logits = jnp.where(kp <= qp, logits, neg)
+        if window_size is not None:
+            logits = jnp.where(kp > qp - window_size, logits, neg)
+
+        blk_max = jnp.max(logits, axis=-1)  # [B,Hkv,G,T]
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked-so-far rows (m == new_m == -inf)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, _NEG_INF))
+        p = jnp.exp(logits - safe_m[..., None])  # rows of -inf -> 0
+        blk_o = jnp.einsum("bhgts,bshd->bthgd", p, v_blk.astype(jnp.float32))
+        o = o * alpha.transpose(0, 3, 1, 2)[..., None] + blk_o
+        l = l * alpha + jnp.sum(p, axis=-1)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, new_m, l, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, t_loc, hkv, g, dv), jnp.float32)
+    m0 = jnp.full((b, hkv, g, t_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, t_loc), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(cp))
+
+    if sinks is not None:
+        # sink logit joins the global softmax denominator (reference
+        # kernel/flash_attn/function.py:34 — autodiff supplies dsink here)
+        sink = sinks.astype(jnp.float32).reshape(1, hkv, g, 1)
+        new_m = jnp.maximum(m, sink)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, _NEG_INF))
+        l = l * alpha + jnp.exp(sink - safe_m)
+        o = o * alpha.transpose(0, 3, 1, 2)[..., None]
+
+    lT = l.transpose(0, 3, 1, 2)[..., None]  # [B,T,Hkv,G,1]
+    out = o / jnp.maximum(lT, 1e-30)
+    return out.reshape(b, t_loc, hq, dv).astype(q.dtype)
+
+
+def make_ring_sdpa(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "cp_s",
+    batch_axes: Sequence[str] = ("dp_r", "dp_s"),
+    head_axes: Sequence[str] = ("tp",),
+):
+    """Build an SDPA backend running ring attention over ``seq_axis``.
+
+    The returned callable takes globally-sharded ``[B, T, H, D]`` arrays
+    under jit and shard_maps them: batch over ``batch_axes``, sequence over
+    ``seq_axis``, heads over ``head_axes`` (TP composes with CP — the ring
+    only moves each device's head slice of K/V).
+    """
+    qkv_spec = P(tuple(batch_axes), seq_axis, tuple(head_axes), None)
+    sink_spec = P(tuple(head_axes))
+
+    def ring_sdpa(
+        q: Array,
+        k: Array,
+        v: Array,
+        *,
+        causal: bool = True,
+        softmax_scale: float | None = None,
+        window_size: int | None = None,
+        sinks: Array | None = None,
+        mask: Array | None = None,
+    ) -> Array:
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention does not support arbitrary masks; use the "
+                "eager/flash backends or express the mask as causal+window"
+            )
+
+        # align activations to the ring layout explicitly — otherwise the
+        # partitioner resharding into shard_map's fixed in_specs can fall
+        # back to replicate-then-repartition around every attention layer
+        q, k, v = (lax.with_sharding_constraint(x, qkv_spec) for x in (q, k, v))
+
+        has_sinks = sinks is not None
+        in_specs = (qkv_spec,) * 3 + ((sink_spec,) if has_sinks else ())
+        args = (q, k, v) + ((sinks,) if has_sinks else ())
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        def run(q, k, v, *rest):
+            return ring_attention(
+                q, k, v, axis_name=seq_axis, causal=causal,
+                softmax_scale=softmax_scale, window_size=window_size,
+                sinks=rest[0] if rest else None,
+            )
+
+        return run(*args)
+
+    return ring_sdpa

@@ -1,0 +1,113 @@
+"""Context-parallel end-to-end training parity.
+
+The decisive CP test: the same model/seed/data trained on a cp-sharded
+mesh with the ring-attention backend must follow the same loss trajectory
+and reach the same parameters as a single-device eager run — proving the
+ring attention + cp batch sharding + grad flow are jointly correct (the
+reference has no CP to compare against; the oracle is the unsharded run).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop import (
+    AdamWProvider,
+    CausalLMTask,
+    DatasetProvider,
+    ModelProvider,
+    Trainer,
+    TrainerConfig,
+)
+from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.nn.sdpa import SdpaRingConfig, build_sdpa_backend
+from d9d_tpu.ops.attention.eager import eager_sdpa
+from d9d_tpu.parallel import fsdp_ep_plan
+
+VOCAB = 32
+STEPS = 4
+
+
+class _Provider(ModelProvider):
+    def __init__(self, sdpa):
+        self.sdpa = sdpa
+
+    def build_module(self, stage):
+        return Qwen3DenseCausalLM(
+            config=Qwen3DenseConfig(
+                vocab_ranges=(("default", VOCAB),),
+                hidden_size=32,
+                num_layers=2,
+                num_heads=4,
+                num_kv_heads=2,
+                head_dim=8,
+                intermediate_size=64,
+                remat=False,
+            ),
+            sdpa=self.sdpa,
+            dtype=jnp.float32,
+        )
+
+    def build_plan(self, c):
+        return fsdp_ep_plan(c)
+
+    def sample_inputs(self, b, t):
+        z = jnp.zeros((b, t), jnp.int32)
+        return (z, z, z)
+
+
+class _Data(DatasetProvider):
+    def build(self):
+        rng = np.random.default_rng(0)
+        for _ in range(STEPS):
+            yield {"input_ids": rng.integers(0, VOCAB, (4, 33))}
+
+
+def _train(ctx, sdpa):
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=4,
+            microbatch_size=4,
+            seq_len=32,
+            total_steps=STEPS,
+            log_every=1,
+            gc_every_steps=None,
+        ),
+        model_provider=_Provider(sdpa),
+        dataset_provider=_Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    hist = trainer.train()
+    params = jax.tree.map(lambda x: np.asarray(x), trainer.params)
+    return hist, params
+
+
+def test_cp_ring_training_matches_single_device(devices):
+    # oracle first: single device, eager attention
+    ctx_ref = MeshParameters().build(devices[:1])
+    hist_ref, params_ref = _train(ctx_ref, eager_sdpa)
+
+    # cp×dp mesh with the ring backend (built from the ambient mesh)
+    ctx_cp = MeshParameters(dp_shard=2, cp_shard=4).build(devices)
+    ring = build_sdpa_backend(
+        SdpaRingConfig(seq_axis="cp_s", batch_axes=("dp_r", "dp_s"), head_axes=())
+    )
+    hist_cp, params_cp = _train(ctx_cp, ring)
+
+    losses_ref = [h["loss"] for h in hist_ref]
+    losses_cp = [h["loss"] for h in hist_cp]
+    np.testing.assert_allclose(losses_cp, losses_ref, rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(params_cp), jax.tree.leaves(params_ref)):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4)
+
+
+def test_cp_with_tp_trains(devices):
+    ctx = MeshParameters(cp_shard=2, cp_replicate=2, tp=2).build(devices)
+    ring = build_sdpa_backend(
+        SdpaRingConfig(seq_axis="cp_s", batch_axes=("dp_r", "dp_s"), head_axes=("tp",))
+    )
+    hist, _ = _train(ctx, ring)
+    assert hist[-1]["loss"] > 0
