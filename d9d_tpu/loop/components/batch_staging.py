@@ -1,0 +1,54 @@
+"""Host batch → device staging, shared by Trainer and Inference.
+
+Reshapes the prepared batch to [num_microbatches, microbatch, ...] and
+places it with dp sharding on the batch dim; on context-parallel meshes
+the sequence dim additionally shards over cp_s — but only for leaves whose
+dim 2 both equals the configured sequence length AND divides evenly by the
+cp size (a [B, T+1] raw-ids leaf or ragged feature leaf falls back to
+batch-only sharding rather than failing device_put).
+"""
+
+from collections.abc import Callable
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import PyTree
+
+
+def make_batch_stager(
+    ctx: MeshContext,
+    *,
+    num_microbatches: int,
+    microbatch_size: int,
+    seq_len: int,
+) -> Callable[[PyTree], PyTree]:
+    seq_sharding = NamedSharding(
+        ctx.mesh, P(None, ctx.batch_axes, ctx.sequence_axes)
+    )
+    flat_sharding = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
+    cp_size = ctx.axis_size(*ctx.sequence_axes)
+
+    def stage(batch: PyTree) -> PyTree:
+        def reshape(x):
+            x = np.asarray(x)
+            if x.shape[0] != num_microbatches * microbatch_size:
+                raise ValueError(
+                    f"batch leading dim {x.shape[0]} != global batch "
+                    f"{num_microbatches * microbatch_size}"
+                )
+            return x.reshape(
+                num_microbatches, microbatch_size, *x.shape[1:]
+            )
+
+        def pick(x):
+            if x.ndim >= 3 and x.shape[2] == seq_len and seq_len % cp_size == 0:
+                return seq_sharding
+            return flat_sharding
+
+        batch_r = jax.tree.map(reshape, batch)
+        return jax.device_put(batch_r, jax.tree.map(pick, batch_r))
+
+    return stage

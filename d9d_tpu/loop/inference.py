@@ -1,0 +1,146 @@
+"""Inference loop: forward-only mirror of the Trainer.
+
+Reference: d9d/loop/run/inference.py:55,176 (InferenceConfigurator/
+Inference) + loop/control/task.py:262 (InferenceTask) + the
+InferenceProcessor path (component/pipeline_result_processing.py:79).
+The jitted forward scans microbatches exactly like the train step; the
+task's ``process_outputs`` runs host-side per batch (generation decode,
+metric accumulation, writing predictions...).
+"""
+
+import abc
+import logging
+import time
+from typing import Any
+
+import flax.linen as nn
+import jax
+import numpy as np
+from jax import lax
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import Array, PyTree
+from d9d_tpu.loop import event as ev
+from d9d_tpu.loop.components.batch_staging import make_batch_stager
+from d9d_tpu.loop.config import InferenceConfig
+from d9d_tpu.loop.control.providers import DatasetProvider, ModelProvider
+from d9d_tpu.loop.event import EventBus
+from d9d_tpu.loop.model_factory import init_sharded_params
+from d9d_tpu.pipelining import PipelineStageInfo
+
+logger = logging.getLogger("d9d_tpu.inference")
+
+
+class InferenceTask(abc.ABC):
+    """What to compute per batch (reference loop/control/task.py:262)."""
+
+    @abc.abstractmethod
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        """Host-side: raw loader batch → device-ready arrays."""
+
+    @abc.abstractmethod
+    def forward_fn(
+        self, module: nn.Module, params: PyTree, microbatch: PyTree, rng: Array
+    ) -> PyTree:
+        """Pure, runs under jit → output pytree (stacked over microbatches)."""
+
+    @abc.abstractmethod
+    def process_outputs(self, outputs: PyTree) -> Any:
+        """Host-side, per batch: consume forward outputs (already on host)."""
+
+
+class Inference:
+    """Forward-only runner.
+
+    ``params`` is normally handed over from a Trainer (colocated eval) or
+    loaded via model_state; if omitted, fresh initialization is used.
+    """
+
+    def __init__(
+        self,
+        *,
+        ctx: MeshContext,
+        config: InferenceConfig,
+        model_provider: ModelProvider,
+        dataset_provider: DatasetProvider,
+        task: InferenceTask,
+        params: PyTree | None = None,
+        microbatch_size: int | None = None,
+        event_bus: EventBus | None = None,
+    ):
+        self.ctx = ctx
+        self.config = config
+        self.task = task
+        self.events = event_bus if event_bus is not None else EventBus()
+        self.events.emit(ev.EVENT_INFER_CONFIG_STARTED, inference=self)
+
+        self.microbatch_size = microbatch_size or config.batch_size
+        if config.batch_size % self.microbatch_size != 0:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"microbatch_size {self.microbatch_size}"
+            )
+        self.num_microbatches = config.batch_size // self.microbatch_size
+
+        self.module = model_provider.build_module(PipelineStageInfo())
+        plan = model_provider.build_plan(ctx)
+        rng = jax.random.PRNGKey(config.seed)
+        self.init_rng, self.step_rng = jax.random.split(rng)
+        if params is not None:
+            self.params = params
+        else:
+            sample = model_provider.sample_inputs(
+                self.microbatch_size, config.seq_len
+            )
+            self.params, _ = init_sharded_params(
+                self.module, sample, self.init_rng, ctx, plan
+            )
+
+        n_mb = self.num_microbatches
+        task_fwd = task.forward_fn
+        module = self.module
+
+        def forward(params, batch, rng):
+            def body(_, mb_and_idx):
+                mb, idx = mb_and_idx
+                out = task_fwd(module, params, mb, jax.random.fold_in(rng, idx))
+                return None, out
+
+            _, outs = lax.scan(
+                body, None, (batch, jax.numpy.arange(n_mb))
+            )
+            return outs  # leading dims [n_mb, mb, ...]
+
+        self._forward = jax.jit(forward)
+        self._stage = make_batch_stager(
+            ctx,
+            num_microbatches=self.num_microbatches,
+            microbatch_size=self.microbatch_size,
+            seq_len=config.seq_len,
+        )
+        self.dataset_provider = dataset_provider
+        self.events.emit(ev.EVENT_INFER_READY, inference=self)
+
+    def _stage_batch(self, raw: PyTree) -> PyTree:
+        return self._stage(self.task.prepare_batch(raw))
+
+    def infer(self) -> list[Any]:
+        """Run the whole dataset; returns task.process_outputs results."""
+        results: list[Any] = []
+        t0 = time.perf_counter()
+        for i, raw in enumerate(iter(self.dataset_provider.build())):
+            with self.events.bounded(ev.EVENT_INFER_BATCH, inference=self, index=i):
+                batch = self._stage_batch(raw)
+                rng = jax.random.fold_in(self.step_rng, i)
+                outs = self._forward(self.params, batch, rng)
+                # merge microbatch dim back and bring to host for the task
+                host = jax.tree.map(
+                    lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), outs
+                )
+                results.append(self.task.process_outputs(host))
+            if (i + 1) % self.config.log_every == 0:
+                logger.info(
+                    "inference batch %d (%.2fs)", i + 1, time.perf_counter() - t0
+                )
+        self.events.emit(ev.EVENT_INFER_FINISHED, inference=self)
+        return results

@@ -22,6 +22,7 @@ from d9d_tpu.core.offload import SleepTag, offload_tree, onload_tree
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.loop import event as ev
 from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.components.batch_staging import make_batch_stager
 from d9d_tpu.loop.components.checkpointer import StateCheckpointer
 from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
 from d9d_tpu.loop.components.job_profiler import JobProfiler
@@ -135,13 +136,12 @@ class Trainer:
         self.run = None  # tracker run, opened in train()
         self._sleep_store: dict[SleepTag, tuple[PyTree, PyTree]] = {}
 
-        # [n_mb, batch, seq, ...]: batch over dp axes; for context-parallel
-        # meshes the sequence dim additionally shards over cp_s (rank-2
-        # leaves like per-example weights only get the batch axes)
-        self._batch_sharding = NamedSharding(
-            ctx.mesh, P(None, ctx.batch_axes, ctx.sequence_axes)
+        self._stage = make_batch_stager(
+            ctx,
+            num_microbatches=self.batch_maths.num_microbatches,
+            microbatch_size=self.batch_maths.microbatch_size,
+            seq_len=config.seq_len,
         )
-        self._batch_sharding_2d = NamedSharding(ctx.mesh, P(None, ctx.batch_axes))
         self._eval_fn = None
         self._merge_fn = None
         self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
@@ -149,32 +149,8 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _stage_batch(self, raw_batch: PyTree) -> PyTree:
-        """prepare → microbatch-reshape → device_put with dp sharding."""
-        batch = self.task.prepare_batch(raw_batch)
-        n_mb = self.batch_maths.num_microbatches
-        mb = self.batch_maths.microbatch_size
-
-        def reshape(x):
-            x = np.asarray(x)
-            if x.shape[0] != n_mb * mb:
-                raise ValueError(
-                    f"batch leading dim {x.shape[0]} != global batch {n_mb * mb}"
-                )
-            return x.reshape(n_mb, mb, *x.shape[1:])
-
-        batch = jax.tree.map(reshape, batch)
-        # the cp sequence sharding applies only to leaves whose dim 2 IS the
-        # sequence (identified by length): other rank-3+ leaves (e.g. [B, k]
-        # per-example features) stay batch-sharded
-        seq_len = self.config.seq_len
-
-        def pick(x):
-            if x.ndim >= 3 and x.shape[2] in (seq_len, seq_len + 1):
-                return self._batch_sharding
-            return self._batch_sharding_2d
-
-        shardings = jax.tree.map(pick, batch)
-        return jax.device_put(batch, shardings)
+        """prepare → microbatch-reshape → device_put (dp + cp sharding)."""
+        return self._stage(self.task.prepare_batch(raw_batch))
 
     # -- checkpoint/resume ---------------------------------------------
 
