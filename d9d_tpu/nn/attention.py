@@ -118,3 +118,133 @@ class GroupedQueryAttention(nn.Module):
             gate = proj(h * d, "gate_proj", (la.EMBED, la.HEADS))(x)
             out = out * nn.sigmoid(gate)
         return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)
+
+
+class LowRankProjection(nn.Module):
+    """down-proj → RMSNorm → up-proj (reference
+    d9d/module/block/attention/multi_head_latent.py:11)."""
+
+    bottleneck: int
+    features: int
+    norm_eps: float = 1e-6
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        def proj(features, name, axes):
+            return nn.Dense(
+                features,
+                use_bias=False,
+                name=name,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+            )
+
+        x = proj(self.bottleneck, "down_proj", (la.EMBED, None))(x)
+        x = RMSNorm(self.bottleneck, eps=self.norm_eps, name="norm",
+                    param_dtype=self.param_dtype)(x)
+        return proj(self.features, "up_proj", (None, la.HEADS))(x)
+
+
+class MultiHeadLatentAttention(nn.Module):
+    """DeepSeek-V2 MLA (reference multi_head_latent.py:46).
+
+    Q through an optional low-rank bottleneck; K/V through a shared latent
+    compression whose up-projection yields per-head content (no-RoPE) keys
+    and values; a decoupled single-head RoPE sub-vector is broadcast to all
+    heads (MQA-style). V is zero-padded to the qk head dim so any SDPA
+    backend (flash/ring included) can run it, then un-padded.
+    """
+
+    hidden_size: int
+    num_heads: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    kv_lora_rank: int
+    sdpa: SdpaBackend
+    q_lora_rank: int | None = None
+    norm_eps: float = 1e-6
+    rope_style: RopeStyle = RopeStyle.HALF
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: Array,
+        cos: Array,
+        sin: Array,
+        mask: Optional[Array] = None,
+    ) -> Array:
+        b, t, _ = x.shape
+        h = self.num_heads
+        d_nope, d_rope = self.qk_nope_head_dim, self.qk_rope_head_dim
+        d_qk = d_nope + d_rope
+        d_v = self.v_head_dim
+        if d_v > d_qk:
+            raise ValueError(
+                f"v_head_dim ({d_v}) must not exceed qk head dim ({d_qk})"
+            )
+
+        def proj(features, name, axes):
+            return nn.Dense(
+                features,
+                use_bias=False,
+                name=name,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+            )
+
+        # --- Q (direct or low-rank) ---
+        if self.q_lora_rank is not None:
+            q = LowRankProjection(
+                bottleneck=self.q_lora_rank,
+                features=h * d_qk,
+                norm_eps=self.norm_eps,
+                name="q_proj",
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+        else:
+            q = proj(h * d_qk, "q_proj", (la.EMBED, la.HEADS))(x)
+        q = q.reshape(b, t, h, d_qk)
+        q_nope, q_rope = q[..., :d_nope], q[..., d_nope:]
+        q_rope = apply_rope(q_rope, cos[..., : d_rope // 2],
+                            sin[..., : d_rope // 2], self.rope_style)
+        q = jnp.concatenate([q_nope, q_rope], axis=-1)
+
+        # --- KV latent + decoupled shared rope key ---
+        kv = proj(self.kv_lora_rank + d_rope, "kv_down_proj", (la.EMBED, None))(x)
+        c_kv, k_rope = kv[..., : self.kv_lora_rank], kv[..., self.kv_lora_rank:]
+        c_kv = RMSNorm(self.kv_lora_rank, eps=self.norm_eps,
+                       name="kv_down_norm", param_dtype=self.param_dtype)(c_kv)
+        kv_up = proj(h * (d_nope + d_v), "kv_up_proj", (None, la.HEADS))(c_kv)
+        kv_up = kv_up.reshape(b, t, h, d_nope + d_v)
+        k_nope, v = kv_up[..., :d_nope], kv_up[..., d_nope:]
+
+        # single-head rope key broadcast to every head (MQA-style)
+        k_rope = jnp.broadcast_to(k_rope[:, :, None, :], (b, t, h, d_rope))
+        k_rope = apply_rope(k_rope, cos[..., : d_rope // 2],
+                            sin[..., : d_rope // 2], self.rope_style)
+        k = jnp.concatenate([k_nope, k_rope], axis=-1)
+
+        # pad V: softmax(QKᵀ)·[V|0] = [out|0] (reference :199-207)
+        pad = d_qk - d_v
+        if pad > 0:
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
+
+        out = self.sdpa(
+            q, k, v, causal=True, softmax_scale=d_qk**-0.5, mask=mask
+        )
+        if pad > 0:
+            out = out[..., :d_v]
+        out = out.reshape(b, t, h * d_v)
+        return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)

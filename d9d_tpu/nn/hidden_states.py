@@ -1,0 +1,70 @@
+"""Per-layer hidden-state aggregation.
+
+Reference: d9d/module/block/hidden_states_aggregator/{base,mean,noop,
+factory}.py — models snapshot per-layer hidden states (masked-mean pooled)
+across pipeline stages for aux losses / analysis; ``pack_with_snapshot``
+prepends the snapshot arriving from the previous stage. The torch version
+is a stateful object mutated during forward; under jit the same contract
+works because the aggregator lives only within one traced call (the model
+constructs it per forward, reference qwen3 model.py usage).
+"""
+
+import enum
+
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+
+class HiddenStatesAggregationMode(str, enum.Enum):
+    no = "no"
+    mean = "mean"
+
+
+def masked_mean_pool(hidden_states: Array, agg_mask: Array) -> Array:
+    """[B,T,D] pooled to [B,D] over mask-valid tokens (fp32 math)."""
+    h = hidden_states.astype(jnp.float32)
+    m = agg_mask.astype(jnp.float32)
+    num = jnp.maximum(m.sum(axis=1)[:, None], 1.0)
+    return ((h * m[:, :, None]).sum(axis=1) / num).astype(hidden_states.dtype)
+
+
+class HiddenStatesAggregatorNoOp:
+    def add_hidden_states(self, hidden_states: Array) -> None:
+        pass
+
+    def pack_with_snapshot(self, snapshot: Array | None) -> Array | None:
+        return None
+
+
+class HiddenStatesAggregatorMean:
+    """Pools each added layer's states immediately; packing stacks the
+    layer snapshots [L,B,D] and prepends the previous-stage snapshot."""
+
+    def __init__(self, agg_mask: Array):
+        self._agg_mask = agg_mask
+        self._collected: list[Array] = []
+
+    def add_hidden_states(self, hidden_states: Array) -> None:
+        self._collected.append(masked_mean_pool(hidden_states, self._agg_mask))
+
+    def pack_with_snapshot(self, snapshot: Array | None) -> Array | None:
+        if not self._collected:
+            return None
+        stacked = jnp.stack(self._collected, axis=0)
+        self._collected.clear()
+        if snapshot is not None:
+            stacked = jnp.concatenate([snapshot, stacked], axis=0)
+        return stacked
+
+
+def create_hidden_states_aggregator(
+    mode: HiddenStatesAggregationMode, agg_mask: Array | None
+):
+    if mode == HiddenStatesAggregationMode.no:
+        return HiddenStatesAggregatorNoOp()
+    if mode == HiddenStatesAggregationMode.mean:
+        if agg_mask is None:
+            raise ValueError("mean aggregation requires an aggregation mask")
+        return HiddenStatesAggregatorMean(agg_mask)
+    raise ValueError(f"unknown hidden states aggregation mode: {mode}")

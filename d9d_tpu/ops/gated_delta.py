@@ -1,0 +1,190 @@
+"""Gated delta rule linear attention (recurrent + chunked forms).
+
+TPU equivalent of the fla-core Triton kernels the reference wraps
+(``chunk_gated_delta_rule`` imported at d9d/module/block/attention/linear/
+gated_deltanet.py:6-8). The recurrence per head, with state
+``S ∈ R^{d_k×d_v}``, log-decay ``g_t ≤ 0`` (α=exp g), write strength
+``β_t ∈ (0,1)``:
+
+    S_t = α_t·S_{t-1} + β_t·k_t·(v_t − α_t·S_{t-1}ᵀk_t)ᵀ
+    o_t = S_tᵀ q_t
+
+- :func:`gated_delta_rule_recurrent` — exact lax.scan over time; the
+  correctness oracle, O(T) sequential steps.
+- :func:`gated_delta_rule_chunked` — chunkwise WY form (Gated DeltaNet,
+  arXiv 2412.06464): within a chunk the implicit per-token recursion is a
+  C×C unit-lower-triangular solve; across chunks only the state carries.
+  All inner products ride the MXU as [C,C] / [C,d] matmuls, and every
+  exponential is of a non-positive number (cumulative decay differences),
+  so the math is stable without rescaling tricks.
+
+Shapes: ``q/k [B,T,H,Dk]``, ``v [B,T,H,Dv]``, ``g/beta [B,T,H]``.
+Computation runs in fp32 regardless of input dtype (matching fla).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from d9d_tpu.core.types import Array
+
+
+def l2norm(x: Array, eps: float = 1e-6) -> Array:
+    return x * lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
+
+
+def _prep(q, k, v, g, beta, use_qk_l2norm):
+    q = q.astype(jnp.float32)
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
+    if use_qk_l2norm:
+        q = l2norm(q)
+        k = l2norm(k)
+    q = q * (q.shape[-1] ** -0.5)
+    return q, k, v, g, beta
+
+
+def gated_delta_rule_recurrent(
+    q: Array,
+    k: Array,
+    v: Array,
+    g: Array,
+    beta: Array,
+    *,
+    use_qk_l2norm: bool = True,
+    initial_state: Array | None = None,
+) -> tuple[Array, Array]:
+    """Sequential oracle. Returns (o [B,T,H,Dv], final_state [B,H,Dk,Dv])."""
+    q, k, v, g, beta = _prep(q, k, v, g, beta, use_qk_l2norm)
+    b, t, h, dk = q.shape
+    dv = v.shape[-1]
+
+    s0 = (
+        initial_state.astype(jnp.float32)
+        if initial_state is not None
+        else jnp.zeros((b, h, dk, dv), jnp.float32)
+    )
+
+    def step(s, inputs):
+        q_t, k_t, v_t, g_t, b_t = inputs  # [B,H,D*] / [B,H]
+        alpha = jnp.exp(g_t)[..., None, None]  # [B,H,1,1]
+        s = s * alpha
+        pred = jnp.einsum("bhkv,bhk->bhv", s, k_t)
+        err = (v_t - pred) * b_t[..., None]
+        s = s + jnp.einsum("bhk,bhv->bhkv", k_t, err)
+        o_t = jnp.einsum("bhkv,bhk->bhv", s, q_t)
+        return s, o_t
+
+    xs = (
+        q.transpose(1, 0, 2, 3),
+        k.transpose(1, 0, 2, 3),
+        v.transpose(1, 0, 2, 3),
+        g.transpose(1, 0, 2),
+        beta.transpose(1, 0, 2),
+    )
+    s_final, o = lax.scan(step, s0, xs)
+    return o.transpose(1, 0, 2, 3), s_final
+
+
+@functools.partial(jax.jit, static_argnames=("use_qk_l2norm", "chunk_size"))
+def gated_delta_rule_chunked(
+    q: Array,
+    k: Array,
+    v: Array,
+    g: Array,
+    beta: Array,
+    *,
+    use_qk_l2norm: bool = True,
+    chunk_size: int = 64,
+    initial_state: Array | None = None,
+) -> tuple[Array, Array]:
+    """Chunkwise WY form; numerically matches the recurrent oracle.
+
+    Derivation: with c_i = Σ_{j≤i} g_j (within-chunk cumulative log decay)
+    and S₀ the incoming state,
+
+        u_i = v_i − e^{c_i}·S₀ᵀk_i − Σ_{j<i} e^{c_i−c_j}(k_iᵀk_j)β_j u_j
+        o_i = e^{c_i}·S₀ᵀq_i + Σ_{j≤i} e^{c_i−c_j}(q_iᵀk_j)β_j u_j
+        S_C = e^{c_C}·S₀ + Σ_i e^{c_C−c_i}·β_i·k_i u_iᵀ
+
+    The u-recursion is ``(I + M)u = v − r`` with strictly-lower-triangular
+    M — one triangular solve per chunk.
+    """
+    q, k, v, g, beta = _prep(q, k, v, g, beta, use_qk_l2norm)
+    b, t, h, dk = q.shape
+    dv = v.shape[-1]
+    c = chunk_size
+
+    pad = (-t) % c
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, pad), (0, 0)))
+        beta = jnp.pad(beta, ((0, 0), (0, pad), (0, 0)))
+    n_chunks = (t + pad) // c
+
+    # [B,H,N,C,D*] chunked, head-major layouts
+    def chunked(x):
+        return x.reshape(b, n_chunks, c, h, -1).transpose(0, 3, 1, 2, 4)
+
+    qc, kc, vc = chunked(q), chunked(k), chunked(v)
+    gc = g.reshape(b, n_chunks, c, h).transpose(0, 3, 1, 2)
+    bc = beta.reshape(b, n_chunks, c, h).transpose(0, 3, 1, 2)
+
+    cum = jnp.cumsum(gc, axis=-1)  # c_i per chunk [B,H,N,C]
+    # pairwise decay e^{c_i - c_j}, lower-triangular valid region
+    diff = cum[..., :, None] - cum[..., None, :]  # [B,H,N,C,C]
+    idx = jnp.arange(c)
+    lower = idx[:, None] > idx[None, :]  # strict
+    lower_eq = idx[:, None] >= idx[None, :]
+
+    decay_strict = jnp.where(lower, jnp.exp(jnp.where(lower, diff, 0.0)), 0.0)
+    decay_incl = jnp.where(lower_eq, jnp.exp(jnp.where(lower_eq, diff, 0.0)), 0.0)
+
+    kk = jnp.einsum("bhnik,bhnjk->bhnij", kc, kc)  # k_iᵀk_j
+    qk = jnp.einsum("bhnik,bhnjk->bhnij", qc, kc)  # q_iᵀk_j
+    m_mat = decay_strict * kk * bc[..., None, :]  # M_{ij} strict lower
+    attn = decay_incl * qk * bc[..., None, :]  # A_{ij} incl diagonal
+
+    eye = jnp.eye(c, dtype=jnp.float32)
+    im = eye + m_mat  # unit lower-triangular
+
+    s0 = (
+        initial_state.astype(jnp.float32)
+        if initial_state is not None
+        else jnp.zeros((b, h, dk, dv), jnp.float32)
+    )
+
+    def chunk_step(s, inputs):
+        q_n, k_n, v_n, cum_n, beta_n, im_n, attn_n = inputs
+        # r_i = e^{c_i} S₀ᵀ k_i
+        r = jnp.exp(cum_n)[..., None] * jnp.einsum("bhkv,bhik->bhiv", s, k_n)
+        rhs = v_n - r
+        u = jax.scipy.linalg.solve_triangular(
+            im_n, rhs, lower=True, unit_diagonal=True
+        )
+        o = (
+            jnp.exp(cum_n)[..., None] * jnp.einsum("bhkv,bhik->bhiv", s, q_n)
+            + jnp.einsum("bhij,bhjv->bhiv", attn_n, u)
+        )
+        # state to next chunk
+        last = cum_n[..., -1]  # c_C
+        w = jnp.exp(last[..., None] - cum_n) * beta_n  # e^{c_C - c_i} β_i
+        s = jnp.exp(last)[..., None, None] * s + jnp.einsum(
+            "bhik,bhiv->bhkv", k_n * w[..., None], u
+        )
+        return s, o
+
+    xs = tuple(
+        x.transpose(2, 0, 1, *range(3, x.ndim))
+        for x in (qc, kc, vc, cum, bc, im, attn)
+    )
+    s_final, o = lax.scan(chunk_step, s0, xs)
+    # o: [N,B,H,C,Dv] → [B,T,H,Dv]
+    o = o.transpose(1, 0, 3, 2, 4).reshape(b, t + pad, h, dv)
+    return o[:, :t], s_final

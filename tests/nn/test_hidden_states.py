@@ -1,0 +1,49 @@
+"""Hidden-state aggregator tests (reference hidden_states_aggregator/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.nn.hidden_states import (
+    HiddenStatesAggregationMode,
+    create_hidden_states_aggregator,
+    masked_mean_pool,
+)
+
+
+def test_masked_mean_pool():
+    h = jnp.arange(2 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 3)
+    mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]])
+    out = masked_mean_pool(h, mask)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(h[0, :2].mean(0)), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[1]), np.asarray(h[1].mean(0)), atol=1e-6
+    )
+
+
+def test_mean_aggregator_pack_and_snapshot_chain():
+    mask = jnp.ones((2, 4))
+    agg = create_hidden_states_aggregator(HiddenStatesAggregationMode.mean, mask)
+    agg.add_hidden_states(jnp.ones((2, 4, 3)))
+    agg.add_hidden_states(jnp.full((2, 4, 3), 2.0))
+    packed = agg.pack_with_snapshot(None)
+    assert packed.shape == (2, 2, 3)  # [layers, batch, dim]
+    # next stage prepends the previous snapshot
+    agg2 = create_hidden_states_aggregator(HiddenStatesAggregationMode.mean, mask)
+    agg2.add_hidden_states(jnp.full((2, 4, 3), 3.0))
+    packed2 = agg2.pack_with_snapshot(packed)
+    assert packed2.shape == (3, 2, 3)
+    np.testing.assert_allclose(np.asarray(packed2[0]), 1.0)
+    np.testing.assert_allclose(np.asarray(packed2[2]), 3.0)
+    # pack clears the buffer
+    assert agg2.pack_with_snapshot(None) is None
+
+
+def test_noop_and_errors():
+    agg = create_hidden_states_aggregator(HiddenStatesAggregationMode.no, None)
+    agg.add_hidden_states(jnp.ones((1, 2, 3)))
+    assert agg.pack_with_snapshot(jnp.ones((1, 1, 3))) is None
+    with pytest.raises(ValueError, match="aggregation mask"):
+        create_hidden_states_aggregator(HiddenStatesAggregationMode.mean, None)

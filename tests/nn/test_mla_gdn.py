@@ -1,0 +1,122 @@
+"""MLA + GatedDeltaNet block tests: shapes, causality, grads, variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.nn.attention import MultiHeadLatentAttention
+from d9d_tpu.nn.linear_attention import DecayGateKind, GatedDeltaNet
+from d9d_tpu.ops.attention.eager import eager_sdpa
+from d9d_tpu.ops.rope import compute_rope_frequencies, make_rope_cos_sin
+
+
+def _rope(b, t, d_rope):
+    inv, scale = compute_rope_frequencies(d_rope, 10000.0)
+    pos = jnp.broadcast_to(jnp.arange(t), (b, t))
+    return make_rope_cos_sin(pos, inv, scale)
+
+
+class TestMLA:
+    def _block(self, q_lora=None):
+        return MultiHeadLatentAttention(
+            hidden_size=64,
+            num_heads=4,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=12,
+            kv_lora_rank=32,
+            q_lora_rank=q_lora,
+            sdpa=eager_sdpa,
+            dtype=jnp.float32,
+        )
+
+    @pytest.mark.parametrize("q_lora", [None, 24])
+    def test_shapes_and_grads(self, q_lora):
+        blk = self._block(q_lora)
+        b, t = 2, 10
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+        cos, sin = _rope(b, t, 8)
+        params = blk.init(jax.random.PRNGKey(1), x, cos, sin)
+        out = blk.apply(params, x, cos, sin)
+        assert out.shape == (b, t, 64)
+        if q_lora is not None:
+            assert "down_proj" in params["params"]["q_proj"]
+
+        g = jax.grad(lambda p: jnp.sum(blk.apply(p, x, cos, sin) ** 2))(params)
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+    def test_causality(self):
+        blk = self._block()
+        b, t = 1, 8
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+        cos, sin = _rope(b, t, 8)
+        params = blk.init(jax.random.PRNGKey(1), x, cos, sin)
+        out1 = blk.apply(params, x, cos, sin)
+        x2 = x.at[:, -1].set(99.0)  # perturb the future
+        out2 = blk.apply(params, x2, cos, sin)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
+        )
+
+
+class TestGatedDeltaNet:
+    def _block(self, gate=DecayGateKind.mamba, hqk=2, hv=4):
+        return GatedDeltaNet(
+            hidden_size=64,
+            num_qk_heads=hqk,
+            num_v_heads=hv,
+            head_qk_dim=16,
+            head_v_dim=8,
+            conv_size=4,
+            decay_gate=gate,
+            chunk_size=8,
+            dtype=jnp.float32,
+        )
+
+    @pytest.mark.parametrize("gate", [DecayGateKind.mamba, DecayGateKind.logsigmoid])
+    @pytest.mark.parametrize("hqk,hv", [(2, 4), (4, 4)])
+    def test_shapes_and_grads(self, gate, hqk, hv):
+        blk = self._block(gate, hqk, hv)
+        b, t = 2, 24
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+        params = blk.init(jax.random.PRNGKey(1), x)
+        out = blk.apply(params, x)
+        assert out.shape == (b, t, 64)
+        g = jax.grad(lambda p: jnp.sum(blk.apply(p, x) ** 2))(params)
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+    def test_causality(self):
+        blk = self._block()
+        b, t = 1, 16
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+        params = blk.init(jax.random.PRNGKey(1), x)
+        out1 = blk.apply(params, x)
+        x2 = x.at[:, -1].set(7.0)
+        out2 = blk.apply(params, x2)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
+        )
+
+    def test_mask_zeroes_padding_influence(self):
+        blk = self._block()
+        b, t = 1, 12
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+        params = blk.init(jax.random.PRNGKey(1), x)
+        mask = jnp.ones((b, t)).at[:, 6:].set(0.0)
+        out_masked = blk.apply(params, x, mask)
+        x_zeroed = x * mask[..., None]
+        out_zeroed = blk.apply(params, x_zeroed, mask)
+        np.testing.assert_allclose(
+            np.asarray(out_masked[:, :6]), np.asarray(out_zeroed[:, :6]), atol=1e-5
+        )
+
+    def test_dt_bias_init_is_inverse_softplus(self):
+        blk = self._block()
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 64))
+        import flax.linen as nn
+
+        params = nn.unbox(blk.init(jax.random.PRNGKey(1), x))
+        dt_bias = params["params"]["decay_gate"]["dt_bias"]
+        dt = np.asarray(jax.nn.softplus(dt_bias))
+        assert (dt >= 1e-4 - 1e-9).all() and (dt <= 0.2).all()
