@@ -1,0 +1,46 @@
+"""Determinism helpers.
+
+Reference: d9d/internals/determinism/seed.py:18 (``set_seeds`` — seed
+torch/python/numpy/hash shifted by PP rank so pipeline stages draw
+different init noise) and d9d/internals/state/main_process.py:8
+(main-process-only statefuls). On TPU the model RNG is an explicit
+``jax.random`` key threaded by the trainer, so this module covers the
+*host-side* RNGs (python/numpy used by dataloaders and augmentation) and
+derives the jax root key with the same stage shift.
+"""
+
+import random
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def set_seeds(seed: int, *, pp_rank: int = 0) -> jax.Array:
+    """Seed python/numpy (shifted by pipeline stage) and return the jax
+    root key for that stage."""
+    shifted = seed + pp_rank
+    random.seed(shifted)
+    np.random.seed(shifted % (2**32))
+    return jax.random.fold_in(jax.random.PRNGKey(seed), pp_rank)
+
+
+class MainProcessOnlyState:
+    """Wraps a stateful object so only process 0 saves/loads its state
+    (reference internals/state/main_process.py:8,29)."""
+
+    def __init__(self, inner: Any):
+        self.inner = inner
+
+    def state_dict(self) -> dict:
+        if jax.process_index() == 0 and hasattr(self.inner, "state_dict"):
+            return {"state": self.inner.state_dict()}
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        if (
+            jax.process_index() == 0
+            and "state" in state
+            and hasattr(self.inner, "load_state_dict")
+        ):
+            self.inner.load_state_dict(state["state"])

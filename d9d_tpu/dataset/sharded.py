@@ -9,6 +9,7 @@ the process, not the per-device dp rank; ``shard_dataset_data_parallel``
 derives (total, current) from ``jax.process_{count,index}``.
 """
 
+import base64
 import math
 import pickle
 from enum import Enum
@@ -201,8 +202,10 @@ class BufferSortedDataset:
         return len(self._base_dataset)
 
     def state_dict(self) -> dict[str, Any]:
+        # base64-wrap the pickled RNG state: loader state rides the job
+        # checkpoint's JSON meta item, which cannot carry raw bytes
         ret: dict[str, Any] = {
-            "seed": pickle.dumps(self._rng.getstate()),
+            "seed": base64.b64encode(pickle.dumps(self._rng.getstate())).decode(),
             "buffer_idx": self._buffer_idx,
             "buffer_indices": self._buffer_indices,
         }
@@ -211,7 +214,7 @@ class BufferSortedDataset:
         return ret
 
     def load_state_dict(self, state_dict: dict[str, Any]) -> None:
-        self._rng.setstate(pickle.loads(state_dict["seed"]))
+        self._rng.setstate(pickle.loads(base64.b64decode(state_dict["seed"])))
         self._buffer_idx = state_dict["buffer_idx"]
         self._buffer_indices = state_dict["buffer_indices"]
         if hasattr(self._base_dataset, "load_state_dict"):

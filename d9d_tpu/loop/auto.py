@@ -1,0 +1,100 @@
+"""Config-driven optimizer / LR-scheduler factories.
+
+Reference: d9d/loop/auto/{auto_optimizer.py, auto_lr_scheduler.py} —
+pydantic discriminated unions so the whole optimization setup rides the
+job's single JSON config. The LR side reuses the piecewise scheduler
+config (d9d_tpu/lr_scheduler/config.py); optimizers cover optax AdamW and
+the bf16 StochasticAdamW.
+"""
+
+from typing import Annotated, Literal, Union
+
+import optax
+import pydantic
+
+from d9d_tpu.lr_scheduler.config import (
+    PiecewiseSchedulerConfig,
+    piecewise_scheduler_from_config,
+)
+
+
+class AdamWConfig(pydantic.BaseModel):
+    type: Literal["adamw"] = "adamw"
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+
+class StochasticAdamWConfig(pydantic.BaseModel):
+    type: Literal["stochastic_adamw"] = "stochastic_adamw"
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    moment_dtype: Literal["float32", "bfloat16"] = "float32"
+    seed: int = 0
+
+
+OptimizerConfig = Annotated[
+    Union[AdamWConfig, StochasticAdamWConfig],
+    pydantic.Field(discriminator="type"),
+]
+
+
+def build_optimizer(config: OptimizerConfig, learning_rate):
+    """learning_rate: float or optax schedule."""
+    if isinstance(config, AdamWConfig):
+        return optax.adamw(
+            learning_rate,
+            b1=config.b1,
+            b2=config.b2,
+            eps=config.eps,
+            weight_decay=config.weight_decay,
+        )
+    if isinstance(config, StochasticAdamWConfig):
+        import jax.numpy as jnp
+
+        from d9d_tpu.optim import StochasticAdamW
+
+        return StochasticAdamW(
+            learning_rate,
+            b1=config.b1,
+            b2=config.b2,
+            eps=config.eps,
+            weight_decay=config.weight_decay,
+            moment_dtype=jnp.bfloat16
+            if config.moment_dtype == "bfloat16"
+            else jnp.float32,
+            seed=config.seed,
+        )
+    raise TypeError(f"unknown optimizer config: {config!r}")
+
+
+class ConstantLRConfig(pydantic.BaseModel):
+    type: Literal["constant"] = "constant"
+    value: float
+
+
+class PiecewiseLRConfig(pydantic.BaseModel):
+    type: Literal["piecewise"] = "piecewise"
+    base_lr: float
+    schedule: PiecewiseSchedulerConfig
+
+
+LRSchedulerConfig = Annotated[
+    Union[ConstantLRConfig, PiecewiseLRConfig],
+    pydantic.Field(discriminator="type"),
+]
+
+
+def build_lr_schedule(config: LRSchedulerConfig, total_steps: int | None = None):
+    """Returns an optax-compatible schedule (step -> lr) or a float."""
+    if isinstance(config, ConstantLRConfig):
+        return config.value
+    if isinstance(config, PiecewiseLRConfig):
+        schedule = piecewise_scheduler_from_config(
+            config.schedule, total_steps=total_steps
+        )
+        return lambda step: config.base_lr * schedule(step)
+    raise TypeError(f"unknown lr config: {config!r}")
