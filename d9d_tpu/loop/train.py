@@ -152,6 +152,26 @@ class Trainer:
         """prepare → microbatch-reshape → device_put (dp + cp sharding)."""
         return self._stage(self.task.prepare_batch(raw_batch))
 
+    def run_step(self, raw_batch: PyTree) -> dict:
+        """Public single-step API: stage ``raw_batch``, run one optimizer
+        step and advance the step counter.
+
+        Returns the step's metric dict with values still on device (call
+        ``jax.block_until_ready`` to synchronize). This is the stable hook
+        for benchmarks and external drivers; ``train()`` runs through the
+        same staging/step internals.
+        """
+        metrics = self._optimizer_step(self._stage_batch(raw_batch))
+        self.stepper.advance()
+        return metrics
+
+    def _optimizer_step(self, batch: PyTree) -> dict:
+        rng = jax.random.fold_in(self.step_rng, self.stepper.step)
+        self.params, self.opt_state, metrics = self.step_fn(
+            self.params, self.opt_state, batch, rng
+        )
+        return metrics
+
     # -- checkpoint/resume ---------------------------------------------
 
     def _job_arrays(self) -> PyTree:
@@ -199,16 +219,17 @@ class Trainer:
     def train(self) -> list[dict]:
         """Run until total_steps or data exhaustion; returns metric history."""
         history: list[dict] = []
-        self.data_loader = self.dataset_provider.build()
-        self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
-        self.run = self.tracker.new_run(self.config.run_name)
-        # resume BEFORE hparams: restoring the tracker run hash re-points
-        # output at the original run
-        self._try_resume()
-        self.run.track_hparams(self.config.model_dump())
-        t0 = time.perf_counter()
-        data_iter = iter(self.data_loader)
+        self.run = None
         try:
+            self.data_loader = self.dataset_provider.build()
+            self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
+            self.run = self.tracker.new_run(self.config.run_name)
+            # resume BEFORE hparams: restoring the tracker run hash re-points
+            # output at the original run
+            self._try_resume()
+            self.run.track_hparams(self.config.model_dump())
+            t0 = time.perf_counter()
+            data_iter = iter(self.data_loader)
             with self.timeout, self.gc:
                 while not self.stepper.finished:
                     try:
@@ -219,16 +240,18 @@ class Trainer:
                     self.profiler.step_begin(step)
                     with self.events.bounded(ev.EVENT_STEP, trainer=self, step=step):
                         batch = self._stage_batch(raw)
-                        rng = jax.random.fold_in(self.step_rng, step)
                         with self.events.bounded(
                             ev.EVENT_FORWARD_BACKWARD, trainer=self, step=step
                         ):
-                            self.params, self.opt_state, metrics = self.step_fn(
-                                self.params, self.opt_state, batch, rng
-                            )
+                            metrics = self._optimizer_step(batch)
                     step = self.stepper.advance()
                     self.profiler.step_end(step - 1)
                     self.gc.step(step)
+                    if self.timeout.step_timeout_s is not None:
+                        # async dispatch lets the host run ahead of the device;
+                        # a heartbeat only counts once this step really finished,
+                        # so a hung collective trips the watchdog within one step
+                        jax.block_until_ready(metrics)
                     self.timeout.set_periodic()
                     if step % self.config.log_every == 0 or self.stepper.finished:
                         host_metrics = {
@@ -252,7 +275,8 @@ class Trainer:
             # release the profiler trace and flush/close the tracker run even
             # when a step raises (a dangling trace breaks the next train())
             self.profiler.close()
-            self.run.close()
+            if self.run is not None:
+                self.run.close()
         return history
 
     def close(self) -> None:

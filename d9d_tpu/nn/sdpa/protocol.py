@@ -24,4 +24,6 @@ class SdpaBackend(Protocol):
         window_size: int | None = None,
         sinks: Array | None = None,
         mask: Array | None = None,
+        q_segments: Array | None = None,
+        kv_segments: Array | None = None,
     ) -> Array: ...

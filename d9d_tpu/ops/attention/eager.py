@@ -27,6 +27,8 @@ def eager_sdpa(
     window_size: int | None = None,
     sinks: Array | None = None,
     mask: Array | None = None,
+    q_segments: Array | None = None,
+    kv_segments: Array | None = None,
 ) -> Array:
     """Attention over ``q [B,T,Hq,D]``, ``k/v [B,S,Hkv,D]`` → ``[B,T,Hq,Dv]``.
 
@@ -37,6 +39,9 @@ def eager_sdpa(
       kernel/flash_attn/function.py:34 handles the analytic dsink — here
       autodiff derives it for free).
     - ``mask``: boolean, broadcastable to ``[B, Hq, T, S]``; True = attend.
+    - ``q_segments [B,T]`` / ``kv_segments [B,S]``: packed-sequence ids
+      (varlen equivalent — reference flash_attn_varlen_func,
+      kernel/flash_attn/function.py:384); attention only within equal ids.
     """
     b, t, hq, d = q.shape
     _, s, hkv, dv = v.shape
@@ -63,6 +68,11 @@ def eager_sdpa(
     if mask is not None:
         m = jnp.broadcast_to(mask, (b, hq, t, s)).reshape(b, hkv, g, t, s)
         logits = jnp.where(m, logits, neg)
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments must be provided together")
+    if q_segments is not None:
+        seg = q_segments[:, None, None, :, None] == kv_segments[:, None, None, None, :]
+        logits = jnp.where(seg, logits, neg)
 
     if sinks is not None:
         sink = jnp.broadcast_to(

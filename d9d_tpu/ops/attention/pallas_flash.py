@@ -1,18 +1,26 @@
 """Pallas TPU flash attention (forward + backward), with GQA, causal,
-sliding-window, and learnable attention sinks.
+sliding-window, learnable attention sinks, and packed-sequence segment ids.
 
 TPU-native replacement for the reference's flash-attn wheel wrapper
-(d9d/kernel/flash_attn/function.py:331 — FA4/CuTe with sinks, window,
+(d9d/kernel/flash_attn/function.py:331,384 — FA4/CuTe with sinks, window,
 varlen): an online-softmax forward and a two-kernel backward (dq; dk/dv)
-with fp32 accumulation in VMEM scratch. The analytic sink gradient the
-reference computes in-kernel (function.py:34) is done here with one cheap
-XLA reduction over the saved LSE instead.
+with fp32 accumulation in VMEM scratch. Varlen batches map to segment ids
+(packed layout), the TPU-friendly equivalent of cu_seqlens.
 
-Layout: flash-style ``[batch, seq, heads, head_dim]``. The kv-block grid
-dim is innermost, so per-(b, h, q-block) running max / denominator / output
-accumulators persist in scratch across kv steps (TPU grids execute
-sequentially). Causal and window block-skipping happens via ``pl.when`` —
-skipped blocks cost a grid step but no MXU work.
+Public layout is flash-style ``[batch, seq, heads, head_dim]``; internally
+tensors run as ``[batch, heads, seq, head_dim]`` so every block puts
+(seq, head_dim) in the minor-two positions as the Mosaic tiling rules
+require (second-minor %8, minor %128-or-full).
+
+The kv-block grid dim is innermost, so per-(b, h, q-block) running max /
+denominator / output accumulators persist in scratch across kv steps (TPU
+grids execute sequentially). Causal and window block-skipping happens via
+``pl.when`` — skipped blocks cost a grid step but no MXU work.
+
+The sink joins only the softmax denominator, so it is folded in *outside*
+the kernel as an elementwise correction on (o, lse); the backward kernels
+then see the corrected lse and need no sink plumbing. The analytic dsink
+(reference function.py:34) is one XLA reduction over the saved lse.
 
 Falls back to the eager XLA path for explicit boolean masks or
 cross-length (decode) attention — those are not training hot paths.
@@ -39,14 +47,16 @@ class _FlashConfig:
     scale: float
     window: int | None
     has_sinks: bool
+    has_segments: bool
     block_q: int
     block_kv: int
     seq_len: int  # real (unpadded) length
     interpret: bool
 
 
-def _mask_block(s, cfg: _FlashConfig, iq, ik):
-    """Apply causal / window / length masking to one [bq, bkv] logit block."""
+def _mask_block(s, cfg: _FlashConfig, iq, ik, q_seg, k_seg):
+    """Apply length / causal / window / segment masking to one [bq, bkv]
+    logit block."""
     bq, bkv = s.shape
     q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
     k_pos = ik * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
@@ -55,6 +65,8 @@ def _mask_block(s, cfg: _FlashConfig, iq, ik):
         mask &= k_pos <= q_pos
     if cfg.window is not None:
         mask &= k_pos > q_pos - cfg.window
+    if q_seg is not None:
+        mask &= q_seg == k_seg
     return jnp.where(mask, s, NEG_BIG)
 
 
@@ -68,8 +80,23 @@ def _skip_block(cfg: _FlashConfig, iq, ik):
     return skip
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, sinks_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, cfg: _FlashConfig):
+def _read_segs(cfg: _FlashConfig, qseg_ref, kseg_ref):
+    if not cfg.has_segments:
+        return None, None
+    # q segs ride a [B, T, 1] column buffer; kv segs a [B, 1, T] row one —
+    # singleton minor/second-minor dims are tiling-legal (block == array dim)
+    q_seg = qseg_ref[0, :, :]  # [bq, 1]
+    k_seg = kseg_ref[0, :, :]  # [1, bkv]
+    return q_seg, k_seg
+
+
+def _fwd_kernel(*refs, cfg: _FlashConfig):
+    if cfg.has_segments:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref = refs[:5]
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        qseg_ref = kseg_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     n_kv = pl.num_programs(3)
 
@@ -81,13 +108,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sinks_ref, o_ref, lse_ref,
 
     @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        q_seg, k_seg = _read_segs(cfg, qseg_ref, kseg_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
 
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -104,19 +132,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sinks_ref, o_ref, lse_ref,
     def _finalize():
         m = m_ref[:, :1]
         l = l_ref[:, :1]
-        if cfg.has_sinks:
-            sink = sinks_ref[0].astype(jnp.float32)
-            # the sink joins the softmax denominator (but contributes no value)
-            m_out = jnp.maximum(m, sink)
-            l = l * jnp.exp(m - m_out) + jnp.exp(sink - m_out)
-            m = m_out
-        o = acc_ref[:] * jnp.exp(m_ref[:, :1] - m) / jnp.maximum(l, 1e-30)
-        o_ref[0, :, 0, :] = o.astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m[:, 0] + jnp.log(jnp.maximum(l, 1e-30)[:, 0]))
+        o_ref[0, 0, :, :] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype
+        )
+        lse_ref[0, 0, :, :] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, cfg: _FlashConfig):
+def _bwd_dq_kernel(*refs, cfg: _FlashConfig):
+    if cfg.has_segments:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref = refs[:8]
+        dq_ref, dq_acc = refs[8:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        qseg_ref = kseg_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     n_kv = pl.num_programs(3)
 
@@ -126,17 +154,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        do = do_ref[0, :, 0, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, :][:, None]
-        delta = delta_ref[0, 0, :][:, None]
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]  # [bq, 1]
+        delta = delta_ref[0, 0, :, :]  # [bq, 1]
+        q_seg, k_seg = _read_segs(cfg, qseg_ref, kseg_ref)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -146,12 +175,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ik == n_kv - 1)
     def _finalize():
-        dq_ref[0, :, 0, :] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _FlashConfig,
-                    n_q_blocks: int):
+def _bwd_dkv_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
+    if cfg.has_segments:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref = refs[:8]
+        dk_ref, dv_ref, dk_acc, dv_acc = refs[8:]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
     ik, inner = pl.program_id(2), pl.program_id(3)
     n_inner = pl.num_programs(3)
     iq = inner % n_q_blocks
@@ -163,17 +197,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        do = do_ref[0, :, 0, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, :][:, None]
-        delta = delta_ref[0, 0, :][:, None]
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        q_seg, k_seg = _read_segs(cfg, qseg_ref, kseg_ref)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
         p = jnp.exp(s - lse)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -188,30 +223,63 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(inner == n_inner - 1)
     def _finalize():
-        dk_ref[0, :, 0, :] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0, :, 0, :] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _pad_len(n: int, block: int) -> int:
     return (-n) % block
 
 
+def _compiler_params(cfg: _FlashConfig):
+    if cfg.interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+    )
+
+
+def _seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k):
+    """Column/row segment-id buffers (padded regions get sentinel ids that
+    can never match a real segment or each other)."""
+    if not cfg.has_segments:
+        return ()
+    qs = jnp.pad(q_seg, ((0, 0), (0, pad_q)), constant_values=-1)
+    ks = jnp.pad(kv_seg, ((0, 0), (0, pad_k)), constant_values=-2)
+    return qs[:, :, None], ks[:, None, :]
+
+
+def _seg_specs(cfg, block_q_map, block_kv_map):
+    if not cfg.has_segments:
+        return ()
+    return (
+        pl.BlockSpec((1, cfg.block_q, 1), block_q_map),
+        pl.BlockSpec((1, 1, cfg.block_kv), block_kv_map),
+    )
+
+
+def _to_bhtd(x, pad):
+    """[B, T, H, D] → [B, H, T, D] (+ seq padding): blocks must keep
+    (seq, head_dim) in the minor-two positions."""
+    x = jnp.transpose(x, (0, 2, 1, 3))
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else x
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash(cfg: _FlashConfig, q, k, v, sinks):
-    o, _ = _flash_fwd(cfg, q, k, v, sinks)
+def _flash(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
+    o, _ = _flash_fwd(cfg, q, k, v, sinks, q_seg, kv_seg)
     return o
 
 
-def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks):
+def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
     b, t, h, d = q.shape
     _, s, hkv, _ = k.shape
     g = h // hkv
     pad_q, pad_k = _pad_len(t, cfg.block_q), _pad_len(s, cfg.block_kv)
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
     tq, tk = t + pad_q, s + pad_k
     n_q, n_kv = tq // cfg.block_q, tk // cfg.block_kv
+
+    qp, kp, vp = _to_bhtd(q, pad_q), _to_bhtd(k, pad_k), _to_bhtd(v, pad_k)
 
     grid = (b, h, n_q, n_kv)
     kernel = functools.partial(_fwd_kernel, cfg=cfg)
@@ -219,120 +287,159 @@ def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, cfg.block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, qi, ki, g=g: (bi, ki, hi // g, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, qi, ki, g=g: (bi, ki, hi // g, 0)),
-            pl.BlockSpec((1,), lambda bi, hi, qi, ki: (hi,)),
+            pl.BlockSpec((1, 1, cfg.block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, qi, ki, g=g: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, qi, ki, g=g: (bi, hi // g, ki, 0)),
+            *_seg_specs(
+                cfg,
+                lambda bi, hi, qi, ki: (bi, qi, 0),
+                lambda bi, hi, qi, ki: (bi, 0, ki),
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((1, cfg.block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, 1, cfg.block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, cfg.block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, cfg.block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, tq, h, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((cfg.block_q, LANES), jnp.float32),
             pltpu.VMEM((cfg.block_q, LANES), jnp.float32),
             pltpu.VMEM((cfg.block_q, d), jnp.float32),
         ],
+        compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, sinks)
-    o = o[:, :t] if pad_q else o
-    return o, (q, k, v, sinks, lse)
+    )(qp, kp, vp, *_seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k))
+
+    o = o[:, :, :t]
+    lse = lse[:, :, :t, 0]  # [B, H, T]
+    if cfg.has_sinks:
+        # sink joins only the denominator: l' = l + exp(sink - m), so
+        # o' = o / (1 + exp(sink - lse)) and lse' = lse + log1p(same).
+        z = jnp.clip(sinks.astype(jnp.float32)[None, :, None] - lse, max=60.0)
+        corr = jnp.exp(z)
+        o = (o.astype(jnp.float32) / (1.0 + corr)[..., None]).astype(o.dtype)
+        lse = lse + jnp.log1p(corr)
+    o_out = jnp.transpose(o, (0, 2, 1, 3))  # back to [B, T, H, D]
+    return o_out, (q, k, v, sinks, q_seg, kv_seg, o_out, lse)
 
 
 def _flash_bwd(cfg: _FlashConfig, residuals, do):
-    q, k, v, sinks, lse = residuals
+    q, k, v, sinks, q_seg, kv_seg, o, lse = residuals
     b, t, h, d = q.shape
     _, s, hkv, _ = k.shape
     g = h // hkv
     pad_q, pad_k = _pad_len(t, cfg.block_q), _pad_len(s, cfg.block_kv)
-    # recompute forward output contribution Δ = rowsum(dO ⊙ O) without
-    # storing O: O = flash forward (cheap relative to backward, and padded
-    # consistently). Instead of rerunning the kernel we use the saved lse
-    # only; Δ must come from O, so recompute O via the forward kernel.
-    o = _flash(cfg, q, k, v, sinks)
-    delta = jnp.einsum("bthd,bthd->bht", do.astype(jnp.float32), o.astype(jnp.float32))
-
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
-    dop = jnp.pad(do, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else do
-    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q else delta
-    # lse was saved padded already
     tq, tk = t + pad_q, s + pad_k
     n_q, n_kv = tq // cfg.block_q, tk // cfg.block_kv
+
+    # Δ = rowsum(dO ⊙ O) per (b, h, t); O was saved by the forward.
+    delta = jnp.einsum(
+        "bthd,bthd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
+    )
+
+    def col(x, pad):  # [B, H, T] → padded [B, H, Tq, 1]
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad))) if pad else x
+        return x[..., None]
+
+    qp, kp, vp = _to_bhtd(q, pad_q), _to_bhtd(k, pad_k), _to_bhtd(v, pad_k)
+    dop = _to_bhtd(do, pad_q)
+    lsep, deltap = col(lse, pad_q), col(delta, pad_q)
+    segs = _seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k)
+
+    q_like = pl.BlockSpec((1, 1, cfg.block_q, d),
+                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kv_like = pl.BlockSpec((1, 1, cfg.block_kv, d),
+                           lambda bi, hi, qi, ki, g=g: (bi, hi // g, ki, 0))
+    col_like = pl.BlockSpec((1, 1, cfg.block_q, 1),
+                            lambda bi, hi, qi, ki: (bi, hi, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, cfg=cfg),
         grid=(b, h, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, cfg.block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, qi, ki, g=g: (bi, ki, hi // g, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, qi, ki, g=g: (bi, ki, hi // g, 0)),
-            pl.BlockSpec((1, cfg.block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, 1, cfg.block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, cfg.block_q), lambda bi, hi, qi, ki: (bi, hi, qi)),
+            q_like, kv_like, kv_like, q_like, col_like, col_like,
+            *_seg_specs(
+                cfg,
+                lambda bi, hi, qi, ki: (bi, qi, 0),
+                lambda bi, hi, qi, ki: (bi, 0, ki),
+            ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, cfg.block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, tq, h, d), q.dtype),
+        out_specs=q_like,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, dop, lse, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *segs)
+
+    # grid: (b, hkv, kv-block, g·q-block) — q heads and q blocks share the
+    # inner sequential dim so dk/dv accumulate across both
+    q_gather = pl.BlockSpec(
+        (1, 1, cfg.block_q, d),
+        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
+    )
+    col_gather = pl.BlockSpec(
+        (1, 1, cfg.block_q, 1),
+        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
+    )
+    kv_self = pl.BlockSpec((1, 1, cfg.block_kv, d),
+                           lambda bi, hi, ki, t_: (bi, hi, ki, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, cfg=cfg, n_q_blocks=n_q),
         grid=(b, hkv, n_kv, g * n_q),
         in_specs=[
-            pl.BlockSpec(
-                (1, cfg.block_q, 1, d),
-                lambda bi, hi, ki, t_, n=n_q, g=g: (bi, t_ % n, hi * g + t_ // n, 0),
-            ),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, ki, t_: (bi, ki, hi, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, ki, t_: (bi, ki, hi, 0)),
-            pl.BlockSpec(
-                (1, cfg.block_q, 1, d),
-                lambda bi, hi, ki, t_, n=n_q, g=g: (bi, t_ % n, hi * g + t_ // n, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, cfg.block_q),
-                lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n),
-            ),
-            pl.BlockSpec(
-                (1, 1, cfg.block_q),
-                lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n),
+            q_gather, kv_self, kv_self, q_gather, col_gather, col_gather,
+            *_seg_specs(
+                cfg,
+                lambda bi, hi, ki, t_, n=n_q: (bi, t_ % n, 0),
+                lambda bi, hi, ki, t_: (bi, 0, ki),
             ),
         ],
-        out_specs=[
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, ki, t_: (bi, ki, hi, 0)),
-            pl.BlockSpec((1, cfg.block_kv, 1, d), lambda bi, hi, ki, t_: (bi, ki, hi, 0)),
-        ],
+        out_specs=[kv_self, kv_self],
         out_shape=[
-            jax.ShapeDtypeStruct((b, tk, hkv, d), k.dtype),
-            jax.ShapeDtypeStruct((b, tk, hkv, d), v.dtype),
+            jax.ShapeDtypeStruct((b, hkv, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, tk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((cfg.block_kv, d), jnp.float32),
             pltpu.VMEM((cfg.block_kv, d), jnp.float32),
         ],
+        compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, dop, lse, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *segs)
 
-    dq = dq[:, :t] if pad_q else dq
-    dk = dk[:, :s] if pad_k else dk
-    dv = dv[:, :s] if pad_k else dv
+    dq = jnp.transpose(dq[:, :, :t], (0, 2, 1, 3))
+    dk = jnp.transpose(dk[:, :, :s], (0, 2, 1, 3))
+    dv = jnp.transpose(dv[:, :, :s], (0, 2, 1, 3))
 
     if cfg.has_sinks:
-        # p_sink[b,h,t] = exp(sink_h - lse); dsink = -Σ p_sink * Δ
-        p_sink = jnp.exp(sinks.astype(jnp.float32)[None, :, None] - lse[:, :, :t])
+        # p_sink[b,h,t] = exp(sink_h - lse); dsink = -Σ p_sink · Δ
+        p_sink = jnp.exp(
+            jnp.clip(sinks.astype(jnp.float32)[None, :, None] - lse, max=60.0)
+        )
         dsinks = -(p_sink * delta).sum(axis=(0, 2)).astype(sinks.dtype)
     else:
         dsinks = jnp.zeros_like(sinks)
-    return dq, dk, dv, dsinks
+    return dq, dk, dv, dsinks, _zero_cotangent(q_seg), _zero_cotangent(kv_seg)
+
+
+def _zero_cotangent(x):
+    """Zero cotangent matching JAX's expectations: float0 for int arrays."""
+    if x is None:
+        return None
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.zeros_like(x)
+    import numpy as np
+
+    return np.zeros(x.shape, dtype=jax.dtypes.float0)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -351,6 +458,8 @@ def make_pallas_flash_sdpa(block_q: int = 512, block_kv: int = 512):
         window_size: int | None = None,
         sinks: Array | None = None,
         mask: Array | None = None,
+        q_segments: Array | None = None,
+        kv_segments: Array | None = None,
     ) -> Array:
         if mask is not None or q.shape[1] != k.shape[1]:
             from d9d_tpu.ops.attention.eager import eager_sdpa
@@ -358,6 +467,11 @@ def make_pallas_flash_sdpa(block_q: int = 512, block_kv: int = 512):
             return eager_sdpa(
                 q, k, v, causal=causal, softmax_scale=softmax_scale,
                 window_size=window_size, sinks=sinks, mask=mask,
+                q_segments=q_segments, kv_segments=kv_segments,
+            )
+        if (q_segments is None) != (kv_segments is None):
+            raise ValueError(
+                "q_segments and kv_segments must be provided together"
             )
         t = q.shape[1]
         d = q.shape[-1]
@@ -366,6 +480,7 @@ def make_pallas_flash_sdpa(block_q: int = 512, block_kv: int = 512):
             scale=softmax_scale if softmax_scale is not None else d**-0.5,
             window=window_size,
             has_sinks=sinks is not None,
+            has_segments=q_segments is not None,
             block_q=min(block_q, max(8, 2 ** math.ceil(math.log2(max(t, 1))))),
             block_kv=min(block_kv, max(8, 2 ** math.ceil(math.log2(max(t, 1))))),
             seq_len=t,
@@ -374,6 +489,6 @@ def make_pallas_flash_sdpa(block_q: int = 512, block_kv: int = 512):
         sinks_arr = (
             sinks if sinks is not None else jnp.zeros((q.shape[2],), jnp.float32)
         )
-        return _flash(cfg, q, k, v, sinks_arr)
+        return _flash(cfg, q, k, v, sinks_arr, q_segments, kv_segments)
 
     return sdpa

