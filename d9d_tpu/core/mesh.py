@@ -254,6 +254,29 @@ class MeshContext:
     def pp_size(self) -> int:
         return self.params.pp
 
+    # --- pipeline submeshes ----------------------------------------------
+
+    def stage_mesh(self, pp_rank: int) -> Mesh:
+        """The non-pp submesh owned by pipeline rank ``pp_rank``.
+
+        Pipeline stages are SPMD programs over their own device group
+        (reference: per-rank NCCL process slice); here each pp coordinate's
+        devices form a mesh with the same non-pp axis vocabulary, so one
+        parallel plan (fsdp/tp/ep rules) applies unchanged per stage.
+        """
+        if not 0 <= pp_rank < self.params.pp:
+            raise ValueError(
+                f"pp_rank {pp_rank} out of range for pp={self.params.pp}"
+            )
+        # per-instance memo (direct __dict__ write: dataclass is frozen);
+        # an lru_cache on the method would pin every MeshContext forever
+        cache = self.__dict__.setdefault("_stage_meshes", {})
+        if pp_rank not in cache:
+            cache[pp_rank] = Mesh(
+                self.mesh.devices[pp_rank], MESH_AXIS_NAMES[1:]
+            )
+        return cache[pp_rank]
+
     # --- sharding helpers ------------------------------------------------
 
     def spec(self, *dims: str | tuple[str, ...] | None) -> P:

@@ -6,6 +6,8 @@ default to off so the minimum slice stays one-screen simple.
 
 import pydantic
 
+from d9d_tpu.pipelining.factory import PipelineScheduleConfig
+
 
 class TrainerConfig(pydantic.BaseModel):
     model_config = pydantic.ConfigDict(extra="forbid")
@@ -14,6 +16,10 @@ class TrainerConfig(pydantic.BaseModel):
     microbatch_size: int
     seq_len: int
     total_steps: int
+
+    # pipeline schedule; used when the mesh has pp > 1
+    # (reference: d9d/pipelining/factory/config.py:70 discriminated union)
+    pipeline: PipelineScheduleConfig | None = None
     learning_rate: float = 3e-4
     max_grad_norm: float | None = 1.0
     seed: int = 0

@@ -46,3 +46,57 @@ class TrainTask(abc.ABC):
     def metrics_postprocess(self, metrics: dict[str, Any]) -> dict[str, Any]:
         """Optional host-side metric transformation before logging."""
         return metrics
+
+
+class PipelineTrainTask(TrainTask):
+    """A TrainTask that can also drive a pipeline-parallel schedule.
+
+    Adds the per-stage decomposition the pipeline executor needs (the
+    ``StageTask`` surface of d9d_tpu.pipelining.runtime.stage, mirroring
+    the reference's TrainTask + LossComputer split, loop/control/task.py:180
+    + component/pipeline_result_processing.py:18): what part of a
+    microbatch flows stage-to-stage (the carry), what every stage needs
+    (kwargs), what only the loss needs (state), and how the last stage
+    turns activations into a weighted loss.
+    """
+
+    @abc.abstractmethod
+    def sample_microbatch(self, microbatch_size: int, seq_len: int) -> PyTree:
+        """Zero-filled microbatch matching ``prepare_batch``'s output
+        structure — drives stage shape inference and parameter init."""
+
+    @abc.abstractmethod
+    def split_microbatch(
+        self, microbatch: PyTree
+    ) -> tuple[PyTree, PyTree, PyTree]:
+        """→ (first_stage_carry, per_stage_kwargs, last_stage_state)."""
+
+    @abc.abstractmethod
+    def stage_forward(
+        self, module: nn.Module, params: PyTree, carry: PyTree, kwargs: PyTree
+    ) -> PyTree:
+        """Non-last stage: carry in → carry out."""
+
+    @abc.abstractmethod
+    def last_stage_loss(
+        self,
+        module: nn.Module,
+        params: PyTree,
+        carry: PyTree,
+        kwargs: PyTree,
+        state: PyTree,
+    ) -> tuple[Array, Array, dict[str, Array]]:
+        """Last stage: → (loss_sum, weight, metrics)."""
+
+    @abc.abstractmethod
+    def stage_init(
+        self,
+        module: nn.Module,
+        rng: Array,
+        carry: PyTree,
+        kwargs: PyTree,
+        state: PyTree,
+        is_last: bool,
+    ) -> PyTree:
+        """Initialize one stage's variables (must trace the same module
+        call graph as ``stage_forward``/``last_stage_loss``)."""

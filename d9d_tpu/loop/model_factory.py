@@ -19,16 +19,13 @@ from d9d_tpu.core.types import PyTree
 from d9d_tpu.parallel.plan import ParallelPlan, logical_to_mesh_sharding
 
 
-def init_sharded_params(
-    module: nn.Module,
-    sample_inputs: tuple,
-    rng: jax.Array,
-    ctx: MeshContext,
+def init_sharded_from_fn(
+    raw_init,
+    mesh,
     plan: ParallelPlan,
 ) -> tuple[PyTree, PyTree]:
-    """Returns (params, shardings); params are unboxed jax.Arrays already
-    placed according to ``plan``."""
-    raw_init = functools.partial(module.init, rng, *sample_inputs)
+    """Materialize ``raw_init()``'s variables directly into their shards on
+    ``mesh`` according to ``plan``; returns (params, shardings)."""
 
     def init_fn():
         variables = raw_init()
@@ -38,10 +35,24 @@ def init_sharded_params(
 
     abstract = jax.eval_shape(init_fn)
     logical_spec = nn.get_partition_spec(abstract)
-    shardings = logical_to_mesh_sharding(logical_spec, ctx.mesh, plan.rules)
+    shardings = logical_to_mesh_sharding(logical_spec, mesh, plan.rules)
     boxed = jax.jit(init_fn, out_shardings=shardings)()
     params = nn.unbox(boxed)
     return params, jax.tree.map(lambda x: x.sharding, params)
+
+
+def init_sharded_params(
+    module: nn.Module,
+    sample_inputs: tuple,
+    rng: jax.Array,
+    ctx: MeshContext,
+    plan: ParallelPlan,
+) -> tuple[PyTree, PyTree]:
+    """Returns (params, shardings); params are unboxed jax.Arrays already
+    placed according to ``plan``."""
+    return init_sharded_from_fn(
+        functools.partial(module.init, rng, *sample_inputs), ctx.mesh, plan
+    )
 
 
 def abstract_param_shapes(module: nn.Module, sample_inputs: tuple, rng: jax.Array) -> PyTree:

@@ -1,0 +1,228 @@
+"""Pipeline-parallel training engine: builds per-stage models over the pp
+axis and drives the schedule executor as the Trainer's step backend.
+
+Reference: d9d/loop/run/train.py:251 (Trainer stepping through
+``schedule.step``), d9d/loop/component/model_stage_factory.py:187
+(per-stage module build) and d9d/pipelining/factory/factory.py:92
+(schedule assembly). The TPU composition: each pipeline stage is an SPMD
+program over its pp-rank's submesh (fsdp/tp/ep shardings apply per stage
+unchanged), the executor moves carries between submeshes, and a
+``PipelinedOptimizer`` steps the disjoint per-stage parameter groups.
+
+Stage input shapes are inferred by chaining ``jax.eval_shape`` through the
+task's ``stage_forward`` (the reference's meta-device
+``infer_stage_inputs_from_pipeline_inputs`` protocol, module/model/*/model.py).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.loop.components.batch_maths import BatchMaths
+from d9d_tpu.loop.control.providers import ModelProvider
+from d9d_tpu.loop.control.task import PipelineTrainTask
+from d9d_tpu.loop.model_factory import init_sharded_from_fn
+from d9d_tpu.pipelining import (
+    PipelineScheduleExecutor,
+    PipelineStageInfo,
+    PipelineStageRuntime,
+)
+from d9d_tpu.pipelining.factory import (
+    GPipeScheduleConfig,
+    PipelineScheduleConfig,
+    build_program_builder,
+)
+from d9d_tpu.pipelining.program import add_communication_ops
+from d9d_tpu.pipelining.training import PipelinedOptimizer
+
+logger = logging.getLogger("d9d_tpu.pipeline")
+
+
+def _zeros_like_sdt(tree: PyTree) -> PyTree:
+    return jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), tree)
+
+
+def _deep_merge(trees: list[PyTree]) -> PyTree:
+    """Merge disjoint-leaved nested dicts (stage param trees → full model)."""
+    out: dict = {}
+    for tree in trees:
+        stack = [(out, tree)]
+        while stack:
+            dst, src = stack.pop()
+            for k, v in src.items():
+                if isinstance(v, dict):
+                    stack.append((dst.setdefault(k, {}), v))
+                elif k in dst:
+                    raise ValueError(f"stage param trees collide on key {k!r}")
+                else:
+                    dst[k] = v
+    return out
+
+
+class PipelineTrainEngine:
+    """Owns stages, program, executor, and per-stage optimizer state."""
+
+    def __init__(
+        self,
+        *,
+        ctx: MeshContext,
+        schedule: PipelineScheduleConfig | None,
+        model_provider: ModelProvider,
+        task: PipelineTrainTask,
+        optimizer,
+        batch_maths: BatchMaths,
+        seq_len: int,
+        init_rng: jax.Array,
+        max_grad_norm: float | None = 1.0,
+        grad_dtype=jnp.float32,
+    ):
+        if not isinstance(task, PipelineTrainTask):
+            raise TypeError(
+                "pipeline parallelism needs a PipelineTrainTask (the task "
+                "defines the stage carry decomposition); got "
+                f"{type(task).__name__}"
+            )
+        self.ctx = ctx
+        self.task = task
+        self.num_microbatches = batch_maths.num_microbatches
+
+        builder = build_program_builder(
+            schedule if schedule is not None else GPipeScheduleConfig(),
+            pp=ctx.pp_size,
+        )
+        self.num_stages = builder.num_stages
+        self.stage_owner = builder.stage_owner
+
+        plan = model_provider.build_plan(ctx)
+        sample_mb = task.sample_microbatch(
+            batch_maths.microbatch_size, seq_len
+        )
+        carry, kwargs_s, state_s = task.split_microbatch(sample_mb)
+        carry_sdt = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            carry,
+        )
+
+        self.stages: dict[int, PipelineStageRuntime] = {}
+        for s in range(self.num_stages):
+            info = PipelineStageInfo(stage_index=s, num_stages=self.num_stages)
+            module = model_provider.build_module(info)
+            submesh = ctx.stage_mesh(self.stage_owner[s])
+            # commit the stage's init key to its submesh: keys minted under
+            # the ambient full mesh carry that mesh in their sharding type
+            # and would poison the submesh-scoped init jit
+            rng_s = jax.device_put(
+                jax.random.fold_in(init_rng, s), NamedSharding(submesh, P())
+            )
+            carry_zero = _zeros_like_sdt(carry_sdt)
+
+            def raw_init(
+                module=module, rng=rng_s, carry=carry_zero, last=info.is_last
+            ):
+                return task.stage_init(
+                    module, rng, carry, kwargs_s, state_s, last
+                )
+
+            with jax.set_mesh(submesh):
+                params, _ = init_sharded_from_fn(raw_init, submesh, plan)
+
+            data_spec = P(ctx.batch_axes, ctx.sequence_axes)
+            self.stages[s] = PipelineStageRuntime(
+                info=info,
+                module=module,
+                params=params,
+                task=task,
+                carry_sharding=NamedSharding(submesh, data_spec),
+                kwargs_sharding=NamedSharding(submesh, data_spec),
+                state_sharding=NamedSharding(submesh, data_spec),
+                grad_dtype=grad_dtype,
+                mesh=submesh,
+            )
+
+            if not info.is_last:
+                # chain shapes: this stage's output is the next stage's carry
+                carry_sdt = jax.eval_shape(
+                    lambda p, c, kw, module=module: task.stage_forward(
+                        module, p, c, kw
+                    ),
+                    params,
+                    carry_sdt,
+                    kwargs_s,
+                )
+
+        program = add_communication_ops(
+            builder.compose(self.num_microbatches),
+            num_stages=self.num_stages,
+            stage_owner=self.stage_owner,
+        )
+        self.executor = PipelineScheduleExecutor(
+            stages=self.stages,
+            program=program,
+            stage_owner=self.stage_owner,
+            num_microbatches=self.num_microbatches,
+            train=True,
+        )
+        self.optimizer = PipelinedOptimizer(
+            optimizer=optimizer,
+            scalar_shardings={
+                s: NamedSharding(ctx.stage_mesh(self.stage_owner[s]), P())
+                for s in range(self.num_stages)
+            },
+            max_grad_norm=max_grad_norm,
+        )
+        self.opt_states = self.optimizer.init(
+            {s: rt.params for s, rt in self.stages.items()}
+        )
+        logger.info(
+            "pipeline engine: %d stages over pp=%d (%s), %d microbatches",
+            self.num_stages,
+            ctx.pp_size,
+            type(builder).__name__,
+            self.num_microbatches,
+        )
+
+    # ------------------------------------------------------------------
+
+    def step(self, microbatches: list[PyTree]) -> dict:
+        """One optimizer step over the microbatch list → device metrics."""
+        result = self.executor.step(microbatches)
+        params = {s: rt.params for s, rt in self.stages.items()}
+        new_params, self.opt_states, grad_norm = self.optimizer.step(
+            params, self.opt_states, result.grads, result.weight_sum
+        )
+        for s, rt in self.stages.items():
+            rt.params = new_params[s]
+        with jax.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
+            inv_w = 1.0 / jnp.maximum(result.weight_sum, 1e-8)
+            loss = result.loss_sum * inv_w
+        return {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "loss_weight": result.weight_sum,
+            **{f"task/{k}": v for k, v in result.metrics.items()},
+        }
+
+    # -- state surface for checkpoint/export ---------------------------
+
+    def job_arrays(self) -> PyTree:
+        return {
+            "params": {str(s): rt.params for s, rt in self.stages.items()},
+            "opt_state": {str(s): v for s, v in self.opt_states.items()},
+        }
+
+    def load_job_arrays(self, arrays: PyTree) -> None:
+        for s, rt in self.stages.items():
+            rt.params = arrays["params"][str(s)]
+        self.opt_states = {
+            s: arrays["opt_state"][str(s)] for s in self.stages
+        }
+
+    def merged_params(self) -> PyTree:
+        """Full model parameter tree (stage trees are key-disjoint by
+        design: layers are named by global id)."""
+        return _deep_merge([rt.params for rt in self.stages.values()])

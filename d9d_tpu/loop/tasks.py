@@ -5,11 +5,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from d9d_tpu.core.types import Array, PyTree
-from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.control.task import PipelineTrainTask
 from d9d_tpu.ops import LM_IGNORE_INDEX
 
 
-class CausalLMTask(TrainTask):
+class CausalLMTask(PipelineTrainTask):
     """Next-token prediction with token-count loss weighting.
 
     Equivalent of the reference example's SFT task
@@ -39,3 +39,37 @@ class CausalLMTask(TrainTask):
         loss_sum = per_token.sum()
         weight = valid.sum()
         return loss_sum, weight, {"tokens": weight}
+
+    # -- pipeline surface (PipelineTrainTask) --------------------------
+    # carry = token ids on stage 0, hidden states after; positions ride
+    # kwargs (every stage's RoPE needs them); labels ride last-stage state.
+
+    def sample_microbatch(self, microbatch_size: int, seq_len: int) -> PyTree:
+        z = np.zeros((microbatch_size, seq_len), np.int32)
+        return {"tokens": z, "labels": z, "positions": z}
+
+    def split_microbatch(self, mb: PyTree) -> tuple[PyTree, PyTree, PyTree]:
+        return (
+            mb["tokens"],
+            {"positions": mb["positions"]},
+            {"labels": mb["labels"]},
+        )
+
+    def stage_forward(
+        self, module: nn.Module, params: PyTree, carry: PyTree, kwargs: PyTree
+    ) -> PyTree:
+        return module.apply(params, carry, kwargs["positions"])
+
+    def last_stage_loss(self, module, params, carry, kwargs, state):
+        per_token = module.apply(
+            params, carry, kwargs["positions"], state["labels"]
+        )
+        valid = (state["labels"] != LM_IGNORE_INDEX).astype(jnp.float32)
+        return per_token.sum(), valid.sum(), {"tokens": valid.sum()}
+
+    def stage_init(self, module, rng, carry, kwargs, state, is_last):
+        if is_last:
+            return module.init(
+                rng, carry, kwargs["positions"], state["labels"]
+            )
+        return module.init(rng, carry, kwargs["positions"])

@@ -71,44 +71,73 @@ class Trainer:
         )
         self.stepper = Stepper(total_steps=config.total_steps)
 
-        self.module = model_provider.build_module(PipelineStageInfo())
-        plan = model_provider.build_plan(ctx)
         rng = jax.random.PRNGKey(config.seed)
         self.init_rng, self.step_rng = jax.random.split(rng)
-        sample = model_provider.sample_inputs(
-            self.batch_maths.microbatch_size, config.seq_len
-        )
-        self.params, self.param_shardings = init_sharded_params(
-            self.module, sample, self.init_rng, ctx, plan
-        )
-
         self.peft_method = peft_method
         self.base_params = None
-        if peft_method is not None:
-            # engine "params" become the adapter tree; base stays frozen
-            from d9d_tpu.peft import PeftTask
-
-            inject_rng = jax.random.fold_in(self.init_rng, 1)
-            self.base_params, adapters = peft_method.inject(
-                self.params, inject_rng
-            )
-            self.params = adapters
-            self.task = task = PeftTask(task, peft_method, self.base_params)
-        self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
+        self.pp_engine = None
+        self.module = None
+        self.params = self.param_shardings = None
+        self.opt_state = None
+        self.step_fn = None
 
         self.optimizer = optimizer_provider.build(
             learning_rate if learning_rate is not None else config.learning_rate
         )
-        self.opt_state = jax.jit(self.optimizer.init)(self.params)
-        self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
 
-        self.step_fn = build_train_step(
-            module=self.module,
-            task=self.task,
-            optimizer=self.optimizer,
-            num_microbatches=self.batch_maths.num_microbatches,
-            max_grad_norm=config.max_grad_norm,
-        )
+        if ctx.pp_size > 1:
+            if peft_method is not None:
+                raise NotImplementedError(
+                    "PEFT is not yet supported together with pipeline "
+                    "parallelism"
+                )
+            from d9d_tpu.loop.pipeline_driver import PipelineTrainEngine
+
+            self.pp_engine = PipelineTrainEngine(
+                ctx=ctx,
+                schedule=config.pipeline,
+                model_provider=model_provider,
+                task=task,
+                optimizer=self.optimizer,
+                batch_maths=self.batch_maths,
+                seq_len=config.seq_len,
+                init_rng=self.init_rng,
+                max_grad_norm=config.max_grad_norm,
+            )
+            self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
+            self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
+        else:
+            self.module = model_provider.build_module(PipelineStageInfo())
+            plan = model_provider.build_plan(ctx)
+            sample = model_provider.sample_inputs(
+                self.batch_maths.microbatch_size, config.seq_len
+            )
+            self.params, self.param_shardings = init_sharded_params(
+                self.module, sample, self.init_rng, ctx, plan
+            )
+
+            if peft_method is not None:
+                # engine "params" become the adapter tree; base stays frozen
+                from d9d_tpu.peft import PeftTask
+
+                inject_rng = jax.random.fold_in(self.init_rng, 1)
+                self.base_params, adapters = peft_method.inject(
+                    self.params, inject_rng
+                )
+                self.params = adapters
+                self.task = task = PeftTask(task, peft_method, self.base_params)
+            self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
+
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+            self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
+
+            self.step_fn = build_train_step(
+                module=self.module,
+                task=self.task,
+                optimizer=self.optimizer,
+                num_microbatches=self.batch_maths.num_microbatches,
+                max_grad_norm=config.max_grad_norm,
+            )
 
         self.dataset_provider = dataset_provider
         self.data_loader = None  # built fresh per train() call
@@ -149,8 +178,30 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _stage_batch(self, raw_batch: PyTree) -> PyTree:
-        """prepare → microbatch-reshape → device_put (dp + cp sharding)."""
-        return self._stage(self.task.prepare_batch(raw_batch))
+        """prepare → microbatch-reshape → device_put (dp + cp sharding).
+
+        Pipeline mode returns the host microbatch *list* instead — the
+        executor places each carry/kwargs/state on its stage's submesh.
+        """
+        prepared = self.task.prepare_batch(raw_batch)
+        if self.pp_engine is not None:
+            return self._split_microbatches(prepared)
+        return self._stage(prepared)
+
+    def _split_microbatches(self, prepared: PyTree) -> list[PyTree]:
+        n = self.batch_maths.num_microbatches
+        m = self.batch_maths.microbatch_size
+
+        def cut(x):
+            x = np.asarray(x)
+            if x.shape[0] != n * m:
+                raise ValueError(
+                    f"batch leading dim {x.shape[0]} != global batch {n * m}"
+                )
+            return x.reshape(n, m, *x.shape[1:])
+
+        stacked = jax.tree.map(cut, prepared)
+        return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
 
     def run_step(self, raw_batch: PyTree) -> dict:
         """Public single-step API: stage ``raw_batch``, run one optimizer
@@ -166,6 +217,8 @@ class Trainer:
         return metrics
 
     def _optimizer_step(self, batch: PyTree) -> dict:
+        if self.pp_engine is not None:
+            return self.pp_engine.step(batch)
         rng = jax.random.fold_in(self.step_rng, self.stepper.step)
         self.params, self.opt_state, metrics = self.step_fn(
             self.params, self.opt_state, batch, rng
@@ -175,6 +228,8 @@ class Trainer:
     # -- checkpoint/resume ---------------------------------------------
 
     def _job_arrays(self) -> PyTree:
+        if self.pp_engine is not None:
+            return self.pp_engine.job_arrays()
         return {"params": self.params, "opt_state": self.opt_state}
 
     def _job_meta(self) -> dict:
@@ -201,8 +256,11 @@ class Trainer:
         if restored is None:
             return
         step, arrays, meta = restored
-        self.params = arrays["params"]
-        self.opt_state = arrays["opt_state"]
+        if self.pp_engine is not None:
+            self.pp_engine.load_job_arrays(arrays)
+        else:
+            self.params = arrays["params"]
+            self.opt_state = arrays["opt_state"]
         self.stepper.load_state_dict({"step": meta["step"]})
         if (
             "data_loader" in meta
@@ -290,24 +348,51 @@ class Trainer:
         """Offload model/optimizer state to host, freeing device HBM."""
         with self.events.bounded(ev.EVENT_SLEEP, trainer=self):
             if SleepTag.MODEL in tags and SleepTag.MODEL not in self._sleep_store:
-                self._sleep_store[SleepTag.MODEL] = offload_tree(self.params)
-                self.params = None
+                if self.pp_engine is not None:
+                    store = {}
+                    for s, rt in self.pp_engine.stages.items():
+                        store[s] = offload_tree(rt.params)
+                        rt.params = None
+                    self._sleep_store[SleepTag.MODEL] = store
+                else:
+                    self._sleep_store[SleepTag.MODEL] = offload_tree(self.params)
+                    self.params = None
             if (
                 SleepTag.OPTIMIZER in tags
                 and SleepTag.OPTIMIZER not in self._sleep_store
             ):
-                self._sleep_store[SleepTag.OPTIMIZER] = offload_tree(self.opt_state)
-                self.opt_state = None
+                if self.pp_engine is not None:
+                    store = {
+                        s: offload_tree(v)
+                        for s, v in self.pp_engine.opt_states.items()
+                    }
+                    self.pp_engine.opt_states = None
+                    self._sleep_store[SleepTag.OPTIMIZER] = store
+                else:
+                    self._sleep_store[SleepTag.OPTIMIZER] = offload_tree(
+                        self.opt_state
+                    )
+                    self.opt_state = None
 
     def wake(self) -> None:
         """Restore everything offloaded by :meth:`sleep`."""
         with self.events.bounded(ev.EVENT_WAKE, trainer=self):
             if SleepTag.MODEL in self._sleep_store:
-                host, sh = self._sleep_store.pop(SleepTag.MODEL)
-                self.params = onload_tree(host, sh)
+                stored = self._sleep_store.pop(SleepTag.MODEL)
+                if self.pp_engine is not None:
+                    for s, (host, sh) in stored.items():
+                        self.pp_engine.stages[s].params = onload_tree(host, sh)
+                else:
+                    self.params = onload_tree(*stored)
             if SleepTag.OPTIMIZER in self._sleep_store:
-                host, sh = self._sleep_store.pop(SleepTag.OPTIMIZER)
-                self.opt_state = onload_tree(host, sh)
+                stored = self._sleep_store.pop(SleepTag.OPTIMIZER)
+                if self.pp_engine is not None:
+                    self.pp_engine.opt_states = {
+                        s: onload_tree(host, sh)
+                        for s, (host, sh) in stored.items()
+                    }
+                else:
+                    self.opt_state = onload_tree(*stored)
 
     # -- export (reference component/model_stage_exporter.py:11) -------
 
@@ -323,7 +408,9 @@ class Trainer:
 
     def merged_params(self) -> PyTree:
         """Full parameter tree for export: identity without PEFT, adapters
-        folded into the frozen base with it."""
+        folded into the frozen base with it; stage trees merged under PP."""
+        if self.pp_engine is not None:
+            return self.pp_engine.merged_params()
         if self.peft_method is None:
             return self.params
         if self._merge_fn is None:
@@ -333,6 +420,11 @@ class Trainer:
     # convenience for tests / evaluation -------------------------------
 
     def loss_on_batch(self, raw_batch: PyTree) -> float:
+        if self.pp_engine is not None:
+            raise NotImplementedError(
+                "loss_on_batch under pipeline parallelism: use the "
+                "InferenceLoop with an inference schedule instead"
+            )
         if self._eval_fn is None:
             self._eval_fn = build_eval_step(
                 module=self.module,

@@ -134,10 +134,17 @@ class PipelineScheduleExecutor:
         def add_loss(aux):
             nonlocal loss_sum, weight_sum
             loss, weight, metrics = aux
-            loss_sum = loss if loss_sum is None else loss_sum + loss
-            weight_sum = weight if weight_sum is None else weight_sum + weight
-            for k, v in metrics.items():
-                metrics_sum[k] = v if k not in metrics_sum else metrics_sum[k] + v
+            # scalar accumulation runs on the last stage's devices; scope its
+            # mesh so an ambient full mesh never conflicts with them
+            with last._scoped():
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                weight_sum = (
+                    weight if weight_sum is None else weight_sum + weight
+                )
+                for k, v in metrics.items():
+                    metrics_sum[k] = (
+                        v if k not in metrics_sum else metrics_sum[k] + v
+                    )
 
         def add_grads(s: int, gp: PyTree):
             stage = self.stages[s]

@@ -17,6 +17,7 @@ cotangent chain, weight-backward only the parameter grads, matching the
 compute split that zero-bubble schedules rely on (splitgrad.py:220,290).
 """
 
+import contextlib
 import dataclasses
 from typing import Any, Protocol
 
@@ -84,6 +85,10 @@ class PipelineStageRuntime:
     kwargs_sharding: Any | None = None
     state_sharding: Any | None = None
     grad_dtype: Any | None = None
+    # the stage's submesh; scoped ambient during compute so an outer full
+    # mesh (jax.set_mesh in MeshParameters.build) never conflicts with this
+    # stage's device group, and shard_map-based modules resolve it
+    mesh: Any | None = None
 
     def __post_init__(self) -> None:
         self._fwd = jax.jit(self._fwd_impl)
@@ -106,12 +111,19 @@ class PipelineStageRuntime:
     def _fwd_loss_impl(self, params, carry, kwargs, state):
         return self.task.last_stage_loss(self.module, params, carry, kwargs, state)
 
+    def _scoped(self):
+        return jax.set_mesh(self.mesh) if self.mesh is not None else (
+            contextlib.nullcontext()
+        )
+
     def forward(self, carry, kwargs):
-        return self._fwd(self.params, carry, kwargs)
+        with self._scoped():
+            return self._fwd(self.params, carry, kwargs)
 
     def forward_loss(self, carry, kwargs, state):
         """Last stage forward → (loss_sum, weight, metrics)."""
-        return self._fwd_loss(self.params, carry, kwargs, state)
+        with self._scoped():
+            return self._fwd_loss(self.params, carry, kwargs, state)
 
     # ---- backward (remat: recompute fwd inside each jit) ----------------
 
@@ -179,13 +191,16 @@ class PipelineStageRuntime:
         return gp
 
     def backward_full(self, carry, kwargs, cot=None, state=None):
-        return self._bwd_full(self.params, carry, kwargs, cot, state)
+        with self._scoped():
+            return self._bwd_full(self.params, carry, kwargs, cot, state)
 
     def backward_input(self, carry, kwargs, cot=None, state=None):
-        return self._bwd_input(self.params, carry, kwargs, cot, state)
+        with self._scoped():
+            return self._bwd_input(self.params, carry, kwargs, cot, state)
 
     def backward_weight(self, carry, kwargs, cot=None, state=None):
-        return self._bwd_weight(self.params, carry, kwargs, cot, state)
+        with self._scoped():
+            return self._bwd_weight(self.params, carry, kwargs, cot, state)
 
     # ---- gradient accumulator -------------------------------------------
 
@@ -193,7 +208,9 @@ class PipelineStageRuntime:
         """First microbatch: adopt grads as the accumulator (cast to
         ``grad_dtype``); preserves the vjp output sharding, so no separate
         zero-init is needed."""
-        return self._cast(grads)
+        with self._scoped():
+            return self._cast(grads)
 
     def accumulate(self, acc: PyTree, grads: PyTree) -> PyTree:
-        return self._acc(acc, grads)
+        with self._scoped():
+            return self._acc(acc, grads)
