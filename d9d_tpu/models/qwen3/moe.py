@@ -59,6 +59,10 @@ class Qwen3MoeConfig:
     remat: bool = True
     # mesh axes carrying expert parallelism; None = local experts
     ep_axes: Optional[tuple[str, ...]] = None
+    # EP dispatch buffer sizing (see MoELayer.ep_capacity_factor): a factor
+    # like 2.0 gives N·k/ep per-shard compute with deterministic drops;
+    # None = dropless worst-case buffer
+    ep_capacity_factor: Optional[float] = None
 
     @property
     def vocab_size(self) -> int:
@@ -146,6 +150,7 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 router_renormalize_probabilities=cfg.norm_topk_prob,
                 shared_expert=cfg.shared_expert,
                 ep_axes=cfg.ep_axes,
+                ep_capacity_factor=cfg.ep_capacity_factor,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="mlp",

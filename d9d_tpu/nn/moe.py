@@ -9,13 +9,13 @@ TPU-native design:
   shape) instead of the nv-grouped-gemm wheel.
 - The local (no-EP) path is the reference's NoCommunicationHandler: a
   stable argsort permute, expert compute, scatter-add combine.
-- The EP path replaces DeepEP's NVSHMEM all-to-all with an
-  all-gather → compute-local-experts → reduce-scatter flow inside a
-  partial-manual ``shard_map`` over the expert mesh axes. On ICI this is
-  bandwidth-comparable to an all-to-all for k≈8 while being dropless and
-  fully differentiable (the VJP of all_gather is psum_scatter and vice
-  versa, so the backward re-crosses the network exactly like DeepEP's
-  dispatch/combine backward pair, deepep.py:91-150).
+- The EP path replaces DeepEP's NVSHMEM all-to-all with a
+  ``ragged_all_to_all`` dispatch/compute/combine flow inside a
+  ``shard_map`` over the expert mesh axes (ops/ep_dispatch.py): tokens
+  travel only to their experts' owners and per-shard grouped-GEMM work is
+  ``N·k/ep`` (+capacity padding), differentiable end to end with the
+  backward re-crossing the network like DeepEP's dispatch/combine pair
+  (deepep.py:91-150).
 - Load stats are sown into the ``moe_stats`` collection instead of a
   mutable buffer (layer.py:16 tokens_per_expert).
 """
@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn import logical_axes as la
 from d9d_tpu.nn.mlp import SwiGLU
+from d9d_tpu.ops.ep_dispatch import ep_dispatch_compute_combine
 from d9d_tpu.ops.moe import (
     grouped_matmul,
     permute_tokens,
@@ -230,6 +231,14 @@ class MoELayer(nn.Module):
     router_enable_expert_bias: bool = False
     shared_expert: Optional[SharedExpertParameters] = None
     ep_axes: Optional[tuple[str, ...]] = None
+    # receive-buffer rows per shard = capacity_factor × n_loc·k (rounded) —
+    # this is also the per-shard grouped-GEMM row count, so a factor like
+    # 2.0 gives the N·k/ep compute scaling; overflow drops assignment tails
+    # deterministically, contributing exact zeros (DeepSeek capacity style).
+    # None = dropless worst-case buffer (n_loc·k·ep rows): exact results,
+    # but memory AND compute back at all-gather scale — use it for parity
+    # testing or tiny EP degrees, set a factor for production
+    ep_capacity_factor: Optional[float] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -331,54 +340,31 @@ class MoELayer(nn.Module):
             )
         e_loc = num_experts // ep_size
         dtype = self.dtype
+        capacity = self.ep_capacity_factor
 
         def ep_body(x_loc, ids_loc, probs_loc, gate_w, up_w, down_w):
             # x_loc: [n_loc, D] — this shard's tokens
             # gate_w/up_w/down_w: [e_loc, ...] — this shard's experts
-            my_shard = lax.axis_index(ep_axes)
-            x_g = lax.all_gather(x_loc, ep_axes, axis=0, tiled=True)
-            ids_g = lax.all_gather(ids_loc, ep_axes, axis=0, tiled=True)
-            probs_g = lax.all_gather(probs_loc, ep_axes, axis=0, tiled=True)
+            def expert_fn(rows, group_sizes):
+                return grouped_swiglu_apply(
+                    rows,
+                    jnp.ones((rows.shape[0],), jnp.float32),
+                    group_sizes,
+                    gate_w,
+                    up_w,
+                    down_w,
+                    dtype,
+                )
 
-            n_global, k = ids_g.shape
-            flat_ids = ids_g.reshape(-1)
-            local_e = flat_ids - my_shard * e_loc
-            mine = (local_e >= 0) & (local_e < e_loc)
-            # Rows not owned here ride the last local group with prob 0:
-            # they compute through a real expert but contribute (and
-            # backprop) exactly zero. This keeps the weight tensors
-            # unconcatenated — a sentinel zero-expert would copy all three
-            # [e_loc, ...] tensors every forward and their grads every
-            # backward.
-            sort_key = jnp.where(mine, local_e, e_loc - 1)
-            sort_idx = jnp.argsort(sort_key, stable=True)
-            group_sizes = jnp.bincount(sort_key, length=e_loc).astype(
-                jnp.int32
-            )
-
-            token_idx = sort_idx // k
-            permuted_x = jnp.take(x_g, token_idx, axis=0)
-            mine_sorted = jnp.take(mine, sort_idx, axis=0)
-            permuted_probs = (
-                jnp.take(probs_g.reshape(-1), sort_idx, axis=0)
-                * mine_sorted.astype(probs_g.dtype)
-            )
-
-            y = grouped_swiglu_apply(
-                permuted_x,
-                permuted_probs,
-                group_sizes,
-                gate_w,
-                up_w,
-                down_w,
-                dtype,
-            )
-            combined = jnp.zeros((n_global, x_g.shape[-1]), y.dtype)
-            combined = combined.at[token_idx].add(y)
-            # sum each token's contributions across expert shards and
-            # return it to its owner
-            return lax.psum_scatter(
-                combined, ep_axes, scatter_dimension=0, tiled=True
+            return ep_dispatch_compute_combine(
+                x_loc,
+                ids_loc,
+                probs_loc,
+                expert_fn,
+                ep_axes=ep_axes,
+                e_loc=e_loc,
+                ep_world=ep_size,
+                capacity_factor=capacity,
             )
 
         out = jax.shard_map(

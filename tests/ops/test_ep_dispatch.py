@@ -1,0 +1,183 @@
+"""ragged-all-to-all EP dispatch: routing math, compute-scaling contract,
+capacity clamping, differentiability (VERDICT r1 item 3).
+
+Uses a transparent expert_fn (adds a per-expert constant) so routing
+errors can't hide inside GEMM numerics. The local oracle computes the same
+top-k combine on unsharded arrays.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from d9d_tpu.ops.ep_dispatch import ep_buffer_rows, ep_dispatch_compute_combine
+
+W = 4  # ep world
+E = 8  # global experts
+E_LOC = E // W
+K = 2
+N_LOC = 6  # tokens per shard
+D = 16
+
+
+def _mesh(devices):
+    return Mesh(np.array(devices[:W]), ("ep",))
+
+
+def _expert_fn_factory(shard_offset, seen_rows):
+    """Expert e transforms rows as x * (2 + global_e). Records GEMM size."""
+
+    def fn(rows, group_sizes):
+        seen_rows.append(rows.shape[0])
+        # build per-row scale from group membership
+        bounds = jnp.cumsum(group_sizes)
+        local_e = (jnp.arange(rows.shape[0])[:, None] >= bounds[None, :]).sum(1)
+        global_e = shard_offset + jnp.clip(local_e, 0, group_sizes.shape[0] - 1)
+        return rows * (2.0 + global_e[:, None])
+
+    return fn
+
+
+def _run_dispatch(devices, x, ids, probs, capacity_factor):
+    mesh = _mesh(devices)
+    seen: list[int] = []
+
+    def body(x_loc, ids_loc, probs_loc):
+        shard_offset = jax.lax.axis_index(("ep",)) * E_LOC
+        return ep_dispatch_compute_combine(
+            x_loc,
+            ids_loc,
+            probs_loc,
+            _expert_fn_factory(shard_offset, seen),
+            ep_axes=("ep",),
+            e_loc=E_LOC,
+            ep_world=W,
+            capacity_factor=capacity_factor,
+        )
+
+    run = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P("ep"), P("ep"), P("ep")),
+            out_specs=P("ep"),
+            check_vma=False,
+        )
+    )
+    # scope the mesh: earlier tests may have left a process-wide full mesh
+    # (MeshParameters.build calls jax.set_mesh) that would conflict
+    with jax.set_mesh(mesh):
+        out = run(x, ids, probs)
+    return np.asarray(out), seen
+
+
+def _oracle(x, ids, probs):
+    """Unsharded top-k combine with the same transparent experts."""
+    scale = 2.0 + ids.astype(np.float32)  # [N, K]
+    return (x[:, None, :] * scale[..., None] * probs[..., None]).sum(axis=1)
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    n = W * N_LOC
+    x = rng.randn(n, D).astype(np.float32)
+    ids = rng.randint(0, E, size=(n, K)).astype(np.int32)
+    # distinct experts per row keep the oracle simple
+    ids[:, 1] = (ids[:, 0] + 1 + ids[:, 1] % (E - 1)) % E
+    probs = rng.rand(n, K).astype(np.float32)
+    return x, ids.astype(np.int32), probs
+
+
+def test_dropless_matches_oracle(devices):
+    x, ids, probs = _data()
+    out, seen = _run_dispatch(devices, x, ids, probs, capacity_factor=None)
+    np.testing.assert_allclose(out, _oracle(x, ids, probs), rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_rows_follow_capacity_contract(devices):
+    """Per-shard GEMM row count must be the static buffer size, i.e.
+    capacity_factor × N_global·k/ep — not the all-gather's N_global·k."""
+    x, ids, probs = _data()
+    m = N_LOC * K
+    _, seen = _run_dispatch(devices, x, ids, probs, capacity_factor=2.0)
+    expected = ep_buffer_rows(m, W, 2.0)
+    assert all(s == expected for s in seen)
+    assert expected < m * W  # strictly below the all-gather row count
+
+    _, seen_dropless = _run_dispatch(devices, x, ids, probs, None)
+    assert all(s == ep_buffer_rows(m, W, None) for s in seen_dropless)
+
+
+def test_generous_capacity_matches_oracle(devices):
+    """A capacity that no shard overflows must be numerically dropless."""
+    x, ids, probs = _data(seed=3)
+    out, _ = _run_dispatch(devices, x, ids, probs, capacity_factor=float(W))
+    np.testing.assert_allclose(out, _oracle(x, ids, probs), rtol=1e-5, atol=1e-5)
+
+
+def test_capacity_drops_are_deterministic_zeros(devices):
+    """Force overflow: all assignments target shard 0's experts. The kept
+    rows must match the oracle; dropped ones contribute exactly zero."""
+    rng = np.random.RandomState(1)
+    n = W * N_LOC
+    x = rng.randn(n, D).astype(np.float32)
+    ids = np.zeros((n, K), np.int32)
+    ids[:, 1] = 1  # all rows → experts 0 and 1 (both shard 0)
+    probs = np.full((n, K), 0.5, np.float32)
+
+    out, _ = _run_dispatch(devices, x, ids, probs, capacity_factor=1.0)
+    m = N_LOC * K
+    cap = ep_buffer_rows(m, W, 1.0)  # 16: shard 0's whole 12 + 4 of shard 1
+    assert cap == 16
+    full = _oracle(x, ids, probs)
+    # earliest source wins: shard 0's tokens fully kept
+    np.testing.assert_allclose(out[:N_LOC], full[:N_LOC], rtol=1e-5, atol=1e-5)
+    # shard 1 got 4 rows in — the expert-0 assignments of its first 4
+    # tokens (its block is expert-sorted); expert 0 scales by 2.0
+    np.testing.assert_allclose(
+        out[N_LOC : N_LOC + 4], x[N_LOC : N_LOC + 4] * 2.0 * 0.5,
+        rtol=1e-5, atol=1e-5,
+    )
+    # everything else dropped → exact zeros
+    np.testing.assert_array_equal(out[N_LOC + 4 :], 0.0)
+
+
+def test_dispatch_is_differentiable(devices):
+    x, ids, probs = _data(seed=5)
+    mesh = _mesh(devices)
+
+    def loss(x, probs):
+        def body(x_loc, ids_loc, probs_loc):
+            shard_offset = jax.lax.axis_index(("ep",)) * E_LOC
+            return ep_dispatch_compute_combine(
+                x_loc, ids_loc, probs_loc,
+                _expert_fn_factory(shard_offset, []),
+                ep_axes=("ep",), e_loc=E_LOC, ep_world=W,
+                capacity_factor=None,
+            )
+
+        out = jax.shard_map(
+            body, mesh=mesh, in_specs=(P("ep"), P("ep"), P("ep")),
+            out_specs=P("ep"), check_vma=False,
+        )(x, ids, probs)
+        return (out ** 2).sum()
+
+    with jax.set_mesh(mesh):
+        gx, gp = jax.grad(loss, argnums=(0, 1))(
+            jnp.asarray(x), jnp.asarray(probs)
+        )
+
+    def oracle_loss(x, probs):
+        scale = 2.0 + jnp.asarray(ids, jnp.float32)
+        out = (x[:, None, :] * scale[..., None] * probs[..., None]).sum(axis=1)
+        return (out ** 2).sum()
+
+    egx, egp = jax.grad(oracle_loss, argnums=(0, 1))(
+        jnp.asarray(x), jnp.asarray(probs)
+    )
+    np.testing.assert_allclose(gx, egx, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gp, egp, rtol=1e-4, atol=1e-4)
