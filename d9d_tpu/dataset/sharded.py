@@ -1,17 +1,18 @@
 """Data-parallel dataset sharding.
 
-Parity: reference d9d/dataset/sharded.py:38 (ShardedDataset with
+Parity target: reference d9d/dataset/sharded.py:38 (ShardedDataset with
 sequential/chunked indexing and pad-to-equal-length) and
-shard_dataset_data_parallel. TPU-native note: under single-controller JAX,
-each *process* feeds its addressable slice of the global batch
-(``jax.make_array_from_process_local_data``), so the natural shard axis is
-the process, not the per-device dp rank; ``shard_dataset_data_parallel``
-derives (total, current) from ``jax.process_{count,index}``.
+d9d/dataset/buffer_sorted.py:38 (buffered length sorting). TPU-native note:
+under single-controller JAX each *process* feeds its addressable slice of
+the global batch (``jax.make_array_from_process_local_data``), so the
+natural shard axis is the process, not the per-device dp rank;
+``shard_dataset_data_parallel`` derives (total, current) from
+``jax.process_{count,index}``.
 """
 
 import base64
-import math
 import pickle
+import random
 from enum import Enum
 from typing import Any, Protocol, Sized, TypeVar
 
@@ -38,10 +39,10 @@ class ShardIndexingMode(str, Enum):
 class ShardedDataset:
     """A view onto one shard of an underlying dataset.
 
-    With ``pad_to_equal_size_across_shards`` every shard reports the ceiling
-    length and out-of-range reads clamp to the last element — required so
-    data-parallel groups never diverge in step count (reference rationale,
-    sharded.py:44).
+    With ``pad_to_equal_size_across_shards`` every shard reports the
+    ceiling length and out-of-range reads clamp to the dataset's final
+    element — data-parallel groups must never diverge in step count
+    (reference rationale, sharded.py:44).
     """
 
     def __init__(
@@ -53,11 +54,13 @@ class ShardedDataset:
         pad_to_equal_size_across_shards: bool = True,
     ):
         if not isinstance(dataset, Sized):
-            raise ValueError("Dataset should implement __len__ method")
+            raise ValueError(
+                "sharding needs a sized dataset (no __len__ found)"
+            )
         if not 0 <= current_shard < total_shards:
             raise ValueError(
-                f"current_shard {current_shard} out of range for "
-                f"{total_shards} shards"
+                f"shard index {current_shard} invalid for a "
+                f"{total_shards}-way split"
             )
         self._dataset = dataset
         self._total_shards = total_shards
@@ -65,51 +68,51 @@ class ShardedDataset:
         self._indexing_mode = indexing_mode
         self._pad = pad_to_equal_size_across_shards
 
-    def _base_index_unsafe(self, index: int) -> int:
-        match self._indexing_mode:
-            case ShardIndexingMode.sequential:
-                return index * self._total_shards + self._current_shard
-            case ShardIndexingMode.chunked:
-                ceil_len = math.ceil(len(self._dataset) / self._total_shards)
-                return ceil_len * self._current_shard + index
-        raise ValueError(f"Unknown shard indexing mode: {self._indexing_mode}")
+    # Layout: sequential interleaves shards with stride = total_shards;
+    # chunked hands each shard one contiguous block of ceil(n/shards).
 
-    def __getitem__(self, index: int) -> _T_co:
-        if index < 0 or index >= len(self):
-            raise IndexError(index)
-        base_index = self._base_index_unsafe(index)
-        if base_index >= len(self._dataset):
-            base_index = len(self._dataset) - 1
-        return self._dataset[base_index]
+    @property
+    def _padded_len(self) -> int:
+        return -(-len(self._dataset) // self._total_shards)
+
+    @property
+    def _true_len(self) -> int:
+        n, k, me = len(self._dataset), self._total_shards, self._current_shard
+        if self._indexing_mode is ShardIndexingMode.sequential:
+            return n // k + (1 if me < n % k else 0)
+        start = self._padded_len * me
+        return min(self._padded_len, max(0, n - start))
+
+    def _global_index(self, index: int) -> int:
+        if self._indexing_mode is ShardIndexingMode.sequential:
+            return index * self._total_shards + self._current_shard
+        return self._padded_len * self._current_shard + index
 
     def __len__(self) -> int:
-        n = len(self._dataset)
-        ceil_len = math.ceil(n / self._total_shards)
-        if self._pad:
-            return ceil_len
-        remainder = n % self._total_shards
-        match self._indexing_mode:
-            case ShardIndexingMode.sequential:
-                full = n // self._total_shards
-                return full + 1 if self._current_shard < remainder else full
-            case ShardIndexingMode.chunked:
-                # actual items in [ceil_len*shard, min(n, ceil_len*(shard+1)))
-                start = ceil_len * self._current_shard
-                return max(0, min(n - start, ceil_len))
-        raise ValueError(f"Unknown ShardIndexingMode: {self._indexing_mode}")
+        return self._padded_len if self._pad else self._true_len
+
+    def __getitem__(self, index: int) -> _T_co:
+        if not 0 <= index < len(self):
+            raise IndexError(index)
+        # padding reads (only possible with pad enabled) clamp to the end
+        g = min(self._global_index(index), len(self._dataset) - 1)
+        return self._dataset[g]
 
     def state_dict(self) -> dict[str, Any]:
-        dct: dict[str, Any] = {
+        out: dict[str, Any] = {
             "total_shards": self._total_shards,
             "current_shard": self._current_shard,
         }
         if hasattr(self._dataset, "state_dict"):
-            dct["dataset"] = self._dataset.state_dict()
-        return dct
+            out["dataset"] = self._dataset.state_dict()
+        return out
 
     def load_state_dict(self, state_dict: dict[str, Any]) -> None:
         if state_dict["total_shards"] != self._total_shards:
-            raise ValueError("Shard count mismatch")
+            raise ValueError(
+                f"cannot restore a {state_dict['total_shards']}-way shard "
+                f"state into a {self._total_shards}-way split"
+            )
         self._current_shard = state_dict["current_shard"]
         if hasattr(self._dataset, "load_state_dict"):
             self._dataset.load_state_dict(state_dict["dataset"])
@@ -146,10 +149,10 @@ class DatasetImplementingSortKeyProtocol(Protocol[_T_co]):
 class BufferSortedDataset:
     """Buffered length-sorting with pack-level + intra-pack shuffling.
 
-    Parity: reference d9d/dataset/buffer_sorted.py:38. Groups similar-length
-    items (minimizing padding) while keeping stochasticity: take a buffer of
-    ``buffer_size`` indices, sort by (sort_key, random tiebreak), cut into
-    ``pack_size`` packs, shuffle packs, shuffle within packs.
+    Groups similar-length items (minimizing padding waste) while keeping
+    stochasticity: materialize a window of ``buffer_size`` indices, order
+    it by (sort_key, random jitter), cut into ``pack_size`` packs, then
+    shuffle the packs and the items inside each pack.
     """
 
     def __init__(
@@ -159,63 +162,57 @@ class BufferSortedDataset:
         pack_size: int,
         init_seed: int | None = None,
     ):
-        import random
-
-        self._base_dataset = base_dataset
-        self._buffer_size = buffer_size
-        self._pack_size = pack_size
+        self._base = base_dataset
+        self._window = buffer_size
+        self._pack = pack_size
         self._rng = random.Random(
             init_seed ^ 0x105E7 if init_seed is not None else None
         )
-        self._buffer_indices: list[int] = []
-        self._buffer_idx: int = -1
+        self._order: list[int] = []  # global indices, current window only
+        self._window_id = -1
 
-    def _update_buffer_idx(self, buffer_idx: int) -> None:
-        select_start = buffer_idx * self._buffer_size
-        select_end = min(
-            (buffer_idx + 1) * self._buffer_size, len(self._base_dataset)
+    def _fill_window(self, window_id: int) -> None:
+        lo = window_id * self._window
+        hi = min(lo + self._window, len(self._base))
+        decorated = sorted(
+            (self._base.sort_key(g), self._rng.random(), g)
+            for g in range(lo, hi)
         )
-        base_idx = list(range(select_start, select_end))
-        sort_keys = [
-            (self._base_dataset.sort_key(idx), self._rng.random())
-            for idx in base_idx
-        ]
-        local_idx = sorted(range(len(base_idx)), key=lambda i: sort_keys[i])
+        ranked = [g for _, _, g in decorated]
         packs = [
-            local_idx[i : i + self._pack_size]
-            for i in range(0, len(local_idx), self._pack_size)
+            ranked[i : i + self._pack]
+            for i in range(0, len(ranked), self._pack)
         ]
         self._rng.shuffle(packs)
         for pack in packs:
             self._rng.shuffle(pack)
-        flat = [y for pack in packs for y in pack]
-        self._buffer_indices = [base_idx[i] for i in flat]
-        self._buffer_idx = buffer_idx
+        self._order = [g for pack in packs for g in pack]
+        self._window_id = window_id
 
     def __getitem__(self, index: int) -> _T_co:
-        needs = index // self._buffer_size
-        if self._buffer_idx != needs:
-            self._update_buffer_idx(needs)
-        return self._base_dataset[self._buffer_indices[index % self._buffer_size]]
+        window_id, offset = divmod(index, self._window)
+        if self._window_id != window_id:
+            self._fill_window(window_id)
+        return self._base[self._order[offset]]
 
     def __len__(self) -> int:
-        return len(self._base_dataset)
+        return len(self._base)
 
     def state_dict(self) -> dict[str, Any]:
         # base64-wrap the pickled RNG state: loader state rides the job
         # checkpoint's JSON meta item, which cannot carry raw bytes
-        ret: dict[str, Any] = {
-            "seed": base64.b64encode(pickle.dumps(self._rng.getstate())).decode(),
-            "buffer_idx": self._buffer_idx,
-            "buffer_indices": self._buffer_indices,
+        out: dict[str, Any] = {
+            "rng": base64.b64encode(pickle.dumps(self._rng.getstate())).decode(),
+            "window_id": self._window_id,
+            "order": self._order,
         }
-        if hasattr(self._base_dataset, "state_dict"):
-            ret["base_dataset"] = self._base_dataset.state_dict()
-        return ret
+        if hasattr(self._base, "state_dict"):
+            out["base_dataset"] = self._base.state_dict()
+        return out
 
     def load_state_dict(self, state_dict: dict[str, Any]) -> None:
-        self._rng.setstate(pickle.loads(base64.b64decode(state_dict["seed"])))
-        self._buffer_idx = state_dict["buffer_idx"]
-        self._buffer_indices = state_dict["buffer_indices"]
-        if hasattr(self._base_dataset, "load_state_dict"):
-            self._base_dataset.load_state_dict(state_dict["base_dataset"])
+        self._rng.setstate(pickle.loads(base64.b64decode(state_dict["rng"])))
+        self._window_id = state_dict["window_id"]
+        self._order = list(state_dict["order"])
+        if hasattr(self._base, "load_state_dict"):
+            self._base.load_state_dict(state_dict["base_dataset"])

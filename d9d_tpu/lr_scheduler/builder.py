@@ -1,7 +1,7 @@
 """Fluent builder for multiphase LR schedules.
 
-Parity: reference d9d/lr_scheduler/piecewise/builder.py
-(PiecewiseScheduleBuilder.for_steps/until_percentage/fill_rest). The
+Parity target: reference d9d/lr_scheduler/piecewise/builder.py
+(``for_steps`` / ``until_percentage`` / ``fill_rest`` fluent surface). The
 reference's ``build`` wraps a torch optimizer in LambdaLR; here ``build``
 returns an optax schedule (multiplier) and ``build_lr`` a ready-to-use
 learning-rate schedule, pluggable into any optax optimizer.
@@ -17,27 +17,32 @@ Schedule = Callable[[int | Array], Array]
 
 
 class PiecewiseScheduleBuilder:
+    """Accumulates phases left to right; the cursor (step, multiplier)
+    always sits at the end of the last phase added."""
+
     def __init__(self, initial_multiplier: float, total_steps: int | None):
         self._phases: list[SchedulePhase] = []
         self._total_steps = total_steps
-        self._last_end_step = 0
-        self._last_multiplier = initial_multiplier
+        self._cursor = (0, initial_multiplier)  # (step, multiplier)
+
+    def _push(self, steps: int, target: float, curve: CurveBase) -> None:
+        at, value = self._cursor
+        self._phases.append(
+            SchedulePhase(
+                start_step=at,
+                end_step=at + steps,
+                start_value=value,
+                end_value=target,
+                curve=curve,
+            )
+        )
+        self._cursor = (at + steps, target)
 
     def for_steps(
         self, steps: int, target_multiplier: float, curve: CurveBase
     ) -> "PiecewiseScheduleBuilder":
         """Add a phase lasting ``steps`` steps ending at ``target_multiplier``."""
-        self._phases.append(
-            SchedulePhase(
-                start_step=self._last_end_step,
-                end_step=self._last_end_step + steps,
-                start_value=self._last_multiplier,
-                end_value=target_multiplier,
-                curve=curve,
-            )
-        )
-        self._last_end_step += steps
-        self._last_multiplier = target_multiplier
+        self._push(steps, target_multiplier, curve)
         return self
 
     def until_percentage(
@@ -46,18 +51,20 @@ class PiecewiseScheduleBuilder:
         """Add a phase ending at fraction ``p`` of total_steps."""
         if self._total_steps is None:
             raise ValueError(
-                "total_steps is required for percentage-based phases"
+                "percentage-based phases need the builder constructed with "
+                "total_steps"
             )
         if not 0.0 <= p <= 1.0:
-            raise ValueError("Percentage should be in range of [0.0, 1.0]")
-        target_step_abs = int(self._total_steps * p)
-        duration = target_step_abs - self._last_end_step
-        if duration < 0:
+            raise ValueError(f"phase end fraction {p} outside [0, 1]")
+        end_step = int(self._total_steps * p)
+        at, _ = self._cursor
+        if end_step < at:
             raise ValueError(
-                f"Target percentage {p} (step {target_step_abs}) is behind "
-                f"current cursor (step {self._last_end_step})."
+                f"phase ending at fraction {p} (step {end_step}) precedes "
+                f"the schedule cursor (step {at})"
             )
-        return self.for_steps(duration, target_multiplier, curve)
+        self._push(end_step - at, target_multiplier, curve)
+        return self
 
     def fill_rest(
         self, target_multiplier: float, curve: CurveBase
@@ -67,10 +74,11 @@ class PiecewiseScheduleBuilder:
 
     def build(self) -> Schedule:
         """Finalize into a ``step -> multiplier`` schedule."""
-        if self._total_steps is not None and self._last_end_step > self._total_steps:
+        at, _ = self._cursor
+        if self._total_steps is not None and at > self._total_steps:
             raise ValueError(
-                f"Schedule defined for {self._last_end_step} steps, but "
-                f"total_steps is {self._total_steps}."
+                f"phases cover {at} steps but the schedule was declared for "
+                f"{self._total_steps}"
             )
         return PiecewiseScheduleEngine(self._phases)
 

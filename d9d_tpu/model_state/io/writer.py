@@ -1,17 +1,23 @@
 """Streaming checkpoint writer.
 
-Parity: reference d9d/model_state/io/writer.py:175,210,252: consume a
-(name, array) generator, fire mapper groups as inputs complete, spill
-≤shard_size_gb safetensors shards under temp names, then a master pass
-renames shards to ``model-XXXXX-of-YYYYY.safetensors`` and writes one
-global index. Three modes: local (single process), distributed (every
-process holds the full state; only master writes), and pipeline-parallel
-(each process writes only its stages' states; indices merged via
-host object gather — the reference's all_gather_object at writer.py:285-309).
+Parity target: reference d9d/model_state/io/writer.py:175,210,252 — consume
+a (name, array) generator, fire mapper groups as their inputs complete,
+spill ≤shard_size_gb safetensors files, publish a global index. Three
+modes: local (single process), distributed (replicated state, one writing
+process), and pipeline-parallel (each stage group writes its own slice;
+indices merged via host object gather, the reference's all_gather_object
+pattern at writer.py:285-309).
+
+Structure here: a ``_ShardSpool`` owns size-capped spilling to
+process-unique temp files, a ``_GroupStream`` owns reactive group firing
+(arriving keys are matched through a key→groups index rather than
+rescanning every group per tensor), and ``_publish`` renames spooled files
+into the final ``model-XXXXX-of-YYYYY.safetensors`` numbering with one
+HF-compatible index JSON.
 """
 
 import warnings
-from collections.abc import Iterable
+from collections.abc import Callable, Iterable
 from pathlib import Path
 
 import numpy as np
@@ -26,145 +32,155 @@ from d9d_tpu.model_state.io.dto import (
 from d9d_tpu.model_state.mapper.abc import ModelStateMapper
 
 
-class _StateWritingFlowLocal:
+class _ShardSpool:
+    """Size-capped safetensors spooler writing ``.spool-{tag}-N`` files."""
+
+    def __init__(self, dest_dir: Path, cap_bytes: int, tag: str):
+        self._dir = Path(dest_dir)
+        self._cap = cap_bytes
+        self._tag = tag
+        self._buffer: dict[str, np.ndarray] = {}
+        self._buffered = 0
+        self._spilled_files: list[str] = []
+        self._locations: dict[str, str] = {}  # weight name → temp file name
+        self._bytes_total = 0
+
+    def add(self, name: str, arr: np.ndarray) -> None:
+        if arr.nbytes > self._cap:
+            raise ValueError(
+                f"tensor {name!r} is {arr.nbytes} bytes — larger than the "
+                f"shard size cap ({self._cap}); raise shard_size_gb"
+            )
+        if self._buffered + arr.nbytes > self._cap:
+            self._spill()
+        self._buffer[name] = arr
+        self._buffered += arr.nbytes
+
+    def _spill(self) -> None:
+        if not self._buffer:
+            return
+        fname = f".spool-{self._tag}-{len(self._spilled_files)}.safetensors"
+        save_file(
+            {k: np.ascontiguousarray(v) for k, v in self._buffer.items()},
+            str(self._dir / fname),
+        )
+        self._spilled_files.append(fname)
+        self._locations.update({k: fname for k in self._buffer})
+        self._bytes_total += self._buffered
+        self._buffer.clear()
+        self._buffered = 0
+
+    def finish(self) -> ModelStateIndex:
+        self._spill()
+        return ModelStateIndex(
+            metadata=ModelStateIndexMeta(total_size=self._bytes_total),
+            weight_map=dict(self._locations),
+        )
+
+
+class _GroupStream:
+    """Reactive mapper-group execution over a stream of named tensors."""
+
     def __init__(
         self,
-        dest_dir: Path,
         mapper: ModelStateMapper,
-        shard_size_gb: float,
-        sharding_rank: int,
-        is_current_process_rank_master: bool,
+        emit: Callable[[str, np.ndarray], None],
     ):
-        self._dest_dir = Path(dest_dir)
         self._mapper = mapper
-        self._shard_size_bytes = int(shard_size_gb * (1024**3))
-        self._groups_to_process = set(mapper.state_dependency_groups())
-        self._available_source_states: dict[str, np.ndarray] = {}
-        self._total_size = 0
-        self._pending_write_tensors: dict[str, np.ndarray] = {}
-        self._current_shard_size = 0
-        self._sharding_rank = sharding_rank
-        self._weight_name_to_local_shard_idx: dict[str, int] = {}
-        self._local_shard_idx_to_tmp_path: dict[int, Path] = {}
-        self._is_master = is_current_process_rank_master
+        self._emit = emit
+        self._inbox: dict[str, np.ndarray] = {}
+        self._open: set = set(mapper.state_dependency_groups())
+        self._by_key: dict[str, list] = {}
+        for group in self._open:
+            for key in group.inputs:
+                self._by_key.setdefault(key, []).append(group)
 
-    def _flush_shard(self) -> None:
-        if not self._pending_write_tensors:
-            return
-        local_shard_num = len(self._local_shard_idx_to_tmp_path) + 1
-        shard_tmp_path = (
-            self._dest_dir
-            / f".tmp-rank{self._sharding_rank}-shard-{local_shard_num}.safetensors"
-        )
-        self._local_shard_idx_to_tmp_path[local_shard_num] = shard_tmp_path
-        save_file(
-            {
-                k: np.ascontiguousarray(v)
-                for k, v in self._pending_write_tensors.items()
-            },
-            str(shard_tmp_path),
-        )
-        for state_name in self._pending_write_tensors:
-            self._weight_name_to_local_shard_idx[state_name] = local_shard_num
-        self._total_size += self._current_shard_size
-        self._pending_write_tensors.clear()
-        self._current_shard_size = 0
-
-    def _process_available_groups(self) -> None:
-        for group in self._groups_to_process.copy():
-            if not group.inputs.issubset(self._available_source_states.keys()):
+    def push(self, name: str, arr: np.ndarray) -> None:
+        self._inbox[name] = np.asarray(arr)
+        for group in self._by_key.get(name, ()):
+            if group not in self._open:
                 continue
-            self._groups_to_process.remove(group)
-            states_to_save = self._mapper.apply(
-                {
-                    k: self._available_source_states[k]
-                    for k in group.inputs
-                }
+            if not group.inputs <= self._inbox.keys():
+                continue
+            self._open.discard(group)
+            produced = self._mapper.apply(
+                {k: self._inbox[k] for k in group.inputs}
             )
-            for input_name in group.inputs:
-                del self._available_source_states[input_name]
-            if not self._is_master:
-                continue
-            for name, tensor in states_to_save.items():
-                tensor = np.asarray(tensor)
-                update_size = tensor.nbytes
-                if update_size > self._shard_size_bytes:
-                    raise ValueError(
-                        f"Cannot save state {name} larger than shard size"
-                    )
-                if (
-                    self._current_shard_size + update_size
-                    > self._shard_size_bytes
-                ):
-                    self._flush_shard()
-                self._pending_write_tensors[name] = tensor
-                self._current_shard_size += update_size
+            for key in group.inputs:
+                # a key may feed exactly one group (mapper contract), so it
+                # is dead once that group fired
+                del self._inbox[key]
+            for out_name, out_arr in produced.items():
+                self._emit(out_name, np.asarray(out_arr))
 
-    def _finalize_locally(self) -> ModelStateIndex:
-        self._flush_shard()
-        if self._groups_to_process:
-            missing = {g.inputs for g in self._groups_to_process}
+    def finish(self) -> None:
+        if self._open:
+            unfired = sorted(
+                tuple(sorted(g.inputs)) for g in self._open
+            )
             raise ValueError(
-                f"Writing failed: not all source tensors were provided. "
-                f"Missing inputs for groups: {missing}"
+                "state stream ended with dependency groups still waiting "
+                f"for inputs: {unfired}"
             )
-        if self._available_source_states:
+        if self._inbox:
             warnings.warn(
-                f"State Writing: unconsumed source tensors ignored: "
-                f"{sorted(self._available_source_states.keys())}",
+                "state stream carried tensors no mapper group consumes: "
+                f"{sorted(self._inbox)}",
                 stacklevel=2,
             )
-        weight_map_local = {
-            name: self._local_shard_idx_to_tmp_path[shard_idx].name
-            for name, shard_idx in self._weight_name_to_local_shard_idx.items()
-        }
-        return ModelStateIndex(
-            metadata=ModelStateIndexMeta(total_size=self._total_size),
-            weight_map=weight_map_local,
-        )
-
-    def write(
-        self, state_generator: Iterable[tuple[str, np.ndarray]]
-    ) -> ModelStateIndex | None:
-        self._dest_dir.mkdir(parents=True, exist_ok=True)
-        for name, tensor in state_generator:
-            self._available_source_states[name] = np.asarray(tensor)
-            self._process_available_groups()
-        if self._is_master:
-            return self._finalize_locally()
-        # non-masters still validate that every group fired
-        self._finalize_locally()
-        return None
 
 
-def _finalize_master(dest_dir: Path, indices: list[ModelStateIndex]) -> None:
-    """Rename temp shards into the global numbering and write one index."""
+def _run_stream(
+    dest_dir: Path,
+    mapper: ModelStateMapper,
+    states: Iterable[tuple[str, np.ndarray]],
+    shard_size_gb: float,
+    tag: str,
+    writes: bool,
+) -> ModelStateIndex | None:
+    """Drive the stream; spool to disk only when ``writes`` is set (other
+    processes still validate group completeness)."""
     dest_dir = Path(dest_dir)
-    total_size = sum(index.metadata.total_size for index in indices)
-    total_weight_map_local = {
-        name: file
-        for index in indices
-        for name, file in index.weight_map.items()
-    }
-    shard_count = len(
-        {file for index in indices for file in index.weight_map.values()}
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    spool = (
+        _ShardSpool(dest_dir, int(shard_size_gb * (1024**3)), tag)
+        if writes
+        else None
     )
-    total_weight_map: dict[str, str] = {}
-    local_to_global: dict[str, str] = {}
-    used = 0
-    for weight_name, old_file in total_weight_map_local.items():
-        if old_file not in local_to_global:
-            used += 1
-            new_file = f"model-{used:05d}-of-{shard_count:05d}.safetensors"
-            (dest_dir / old_file).rename(dest_dir / new_file)
-            local_to_global[old_file] = new_file
-        total_weight_map[weight_name] = local_to_global[old_file]
+    sink = spool.add if spool is not None else (lambda name, arr: None)
+    stream = _GroupStream(mapper, sink)
+    for name, arr in states:
+        stream.push(name, arr)
+    stream.finish()
+    return spool.finish() if spool is not None else None
+
+
+def _publish(dest_dir: Path, spooled: list[ModelStateIndex]) -> None:
+    """Rename spool files into the global shard numbering + write the index."""
+    dest_dir = Path(dest_dir)
+    temp_files: list[str] = []
+    for index in spooled:
+        for fname in index.weight_map.values():
+            if fname not in temp_files:
+                temp_files.append(fname)
+    renamed = {
+        old: f"model-{i + 1:05d}-of-{len(temp_files):05d}.safetensors"
+        for i, old in enumerate(temp_files)
+    }
+    for old, new in renamed.items():
+        (dest_dir / old).rename(dest_dir / new)
+    merged = ModelStateIndex(
+        metadata=ModelStateIndexMeta(
+            total_size=sum(ix.metadata.total_size for ix in spooled)
+        ),
+        weight_map={
+            name: renamed[fname]
+            for ix in spooled
+            for name, fname in ix.weight_map.items()
+        },
+    )
     (dest_dir / MODEL_STATE_INDEX_FILE_NAME).write_text(
-        ModelStateIndex(
-            metadata=ModelStateIndexMeta(total_size=total_size),
-            weight_map=total_weight_map,
-        ).model_dump_json(indent=4),
-        encoding="utf-8",
+        merged.model_dump_json(indent=4), encoding="utf-8"
     )
 
 
@@ -175,15 +191,10 @@ def write_model_state_local(
     shard_size_gb: float = 4.0,
 ) -> None:
     """Single-process save."""
-    index = _StateWritingFlowLocal(
-        dest_dir=dest_dir,
-        mapper=mapper,
-        shard_size_gb=shard_size_gb,
-        sharding_rank=0,
-        is_current_process_rank_master=True,
-    ).write(state_generator)
-    assert index is not None
-    _finalize_master(dest_dir, [index])
+    index = _run_stream(
+        dest_dir, mapper, state_generator, shard_size_gb, tag="0", writes=True
+    )
+    _publish(dest_dir, [index])
 
 
 def write_model_state_distributed(
@@ -196,16 +207,12 @@ def write_model_state_distributed(
     import jax
 
     is_master = jax.process_index() == 0
-    index = _StateWritingFlowLocal(
-        dest_dir=dest_dir,
-        mapper=mapper,
-        shard_size_gb=shard_size_gb,
-        sharding_rank=0,
-        is_current_process_rank_master=is_master,
-    ).write(state_generator)
+    index = _run_stream(
+        dest_dir, mapper, state_generator, shard_size_gb,
+        tag="0", writes=is_master,
+    )
     if is_master:
-        assert index is not None
-        _finalize_master(dest_dir, [index])
+        _publish(dest_dir, [index])
     # barrier: no process may observe the directory before the master
     # finished renaming shards + writing the index
     host_allgather_object(None)
@@ -223,18 +230,15 @@ def write_model_state_pipeline_parallel(
 
     ``is_local_writer`` selects one process per stage group (the reference's
     coordinate-sum-0 rule, writer.py:285-309); ``writer_rank`` must be
-    unique among writers (e.g. the pp rank) so temp shard names don't
+    unique among writers (e.g. the pp rank) so temp spool names don't
     collide.
     """
     import jax
 
-    index = _StateWritingFlowLocal(
-        dest_dir=dest_dir,
-        mapper=mapper,
-        shard_size_gb=shard_size_gb,
-        sharding_rank=writer_rank,
-        is_current_process_rank_master=is_local_writer,
-    ).write(state_generator)
-    indices = [i for i in host_allgather_object(index) if i is not None]
+    index = _run_stream(
+        dest_dir, mapper, state_generator, shard_size_gb,
+        tag=str(writer_rank), writes=is_local_writer,
+    )
+    spooled = [ix for ix in host_allgather_object(index) if ix is not None]
     if jax.process_index() == 0:
-        _finalize_master(dest_dir, indices)
+        _publish(dest_dir, spooled)

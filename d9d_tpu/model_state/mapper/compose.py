@@ -1,213 +1,224 @@
 """Compose mappers: parallel union, sequential pipeline, prefix scoping,
 group sharding.
 
-Parity: reference d9d/model_state/mapper/compose/{parallel,sequential,
-prefix_scope,shard,helper}.py. Sequential keeps the reference's two key
-behaviors: gap-filling (identity pass-through injection between stages) and
-net dependency-group computation with transitive merging, so a chain
-A:{x}->{y}, B:{y}->{z} reports a single group {x}->{z}.
+Parity targets: reference d9d/model_state/mapper/compose/{parallel,
+sequential,prefix_scope,shard}.py — same behavioral contract, different
+machinery. The sequential composition here is built around a *static carry
+plan* instead of rewriting the mapper list with injected identity mappers:
+at construction we compute, per stage boundary, which keys must flow past
+the stage untouched (needed downstream but not produced in between, or
+produced earlier and never consumed again), and ``apply`` consults that
+plan at runtime. Net dependency groups come from a union-find over the
+key graph, which gives the same transitive merging (stage A ``{x}→{y}``
+then stage B ``{y}→{z}`` reports one net group ``{x}→{z}``).
 """
 
-from collections.abc import Sequence
+from collections.abc import Iterable, Sequence
 
 from d9d_tpu.model_state.mapper.abc import (
     ModelStateMapper,
     StateDict,
     StateGroup,
 )
-from d9d_tpu.model_state.mapper.leaf import ModelStateMapperIdentity
+
+
+def _union(sets: Iterable[frozenset[str]]) -> frozenset[str]:
+    out: set[str] = set()
+    for s in sets:
+        out |= s
+    return frozenset(out)
+
+
+def _stage_io(mapper: ModelStateMapper) -> tuple[frozenset[str], frozenset[str]]:
+    groups = mapper.state_dependency_groups()
+    return _union(g.inputs for g in groups), _union(g.outputs for g in groups)
 
 
 def filter_empty_mappers(
     mappers: Sequence[ModelStateMapper],
 ) -> list[ModelStateMapper]:
-    """Drop mappers with no non-empty dependency group."""
-    result = []
-    for mapper in mappers:
-        for group in mapper.state_dependency_groups():
-            if len(group.inputs) > 0 or len(group.outputs) > 0:
-                result.append(mapper)
-                break
-    return result
+    """Drop mappers whose every dependency group is empty."""
+    return [
+        m
+        for m in mappers
+        if any(g.inputs or g.outputs for g in m.state_dependency_groups())
+    ]
+
+
+class _KeyComponents:
+    """Union-find over state keys; one component per connected transform."""
+
+    def __init__(self) -> None:
+        self._parent: dict[str, str] = {}
+
+    def _root(self, key: str) -> str:
+        parent = self._parent.setdefault(key, key)
+        if parent == key:
+            return key
+        root = self._root(parent)
+        self._parent[key] = root
+        return root
+
+    def connect(self, keys: Iterable[str]) -> None:
+        it = iter(keys)
+        first = next(it, None)
+        if first is None:
+            return
+        anchor = self._root(first)
+        for key in it:
+            self._parent[self._root(key)] = anchor
+
+    def components(self) -> dict[str, set[str]]:
+        out: dict[str, set[str]] = {}
+        for key in list(self._parent):
+            out.setdefault(self._root(key), set()).add(key)
+        return out
 
 
 class ModelStateMapperParallel(ModelStateMapper):
-    """Disjoint union of mappers; input/output key collisions are errors."""
+    """Side-by-side union of independent mappers.
+
+    Every sub-mapper keeps exclusive ownership of its input and output
+    keys; overlap is a construction-time error. ``apply`` dispatches a
+    complete input group to whichever sub-mapper declared it.
+    """
 
     def __init__(self, mappers: Sequence[ModelStateMapper]):
-        mappers_lst = filter_empty_mappers(mappers)
-
-        all_groups: set[StateGroup] = set()
-        inputs_to_mapper: dict[frozenset[str], ModelStateMapper] = {}
-        seen_inputs: set[str] = set()
-        seen_outputs: set[str] = set()
-        for mapper in mappers_lst:
-            for sub_group in mapper.state_dependency_groups():
-                if not seen_inputs.isdisjoint(sub_group.inputs):
+        members = filter_empty_mappers(mappers)
+        self._route: dict[frozenset[str], ModelStateMapper] = {}
+        claimed_in: set[str] = set()
+        claimed_out: set[str] = set()
+        for member in members:
+            for g in member.state_dependency_groups():
+                overlap_in = claimed_in & g.inputs
+                if overlap_in:
                     raise ValueError(
-                        f"Found a colliding input group: {sub_group.inputs}"
+                        f"parallel mapper: input keys {sorted(overlap_in)} "
+                        "claimed by more than one sub-mapper"
                     )
-                seen_inputs.update(sub_group.inputs)
-                if not seen_outputs.isdisjoint(sub_group.outputs):
+                overlap_out = claimed_out & g.outputs
+                if overlap_out:
                     raise ValueError(
-                        f"Found colliding output keys: {sub_group.outputs}"
+                        f"parallel mapper: output keys {sorted(overlap_out)} "
+                        "produced by more than one sub-mapper"
                     )
-                seen_outputs.update(sub_group.outputs)
-                all_groups.add(sub_group)
-                inputs_to_mapper[sub_group.inputs] = mapper
-
-        self._all_groups = frozenset(all_groups)
-        self._inputs_to_mapper = inputs_to_mapper
-
-    def state_dependency_groups(self) -> frozenset[StateGroup]:
-        return self._all_groups
-
-    def apply(self, group: StateDict) -> StateDict:
-        group_keys = frozenset(group.keys())
-        if group_keys not in self._inputs_to_mapper:
-            raise ValueError(
-                "Tried to run a parallel mapper with undefined group. "
-                "Perhaps you sent groups that are not isolated?"
-            )
-        return self._inputs_to_mapper[group_keys].apply(group)
-
-
-class ModelStateMapperSequential(ModelStateMapper):
-    """Pipeline of mappers with automatic gap filling and group merging."""
-
-    def __init__(self, mappers: list[ModelStateMapper]):
-        mappers = filter_empty_mappers(mappers)
-        if not mappers:
-            raise ValueError("Mappers list cannot be empty.")
-        mappers = self._fill_gaps(mappers)
-        self._groups = self._compute_pipeline_groups(mappers)
-        self._mappers = mappers
-
-    @staticmethod
-    def _fill_gaps(
-        mappers: list[ModelStateMapper],
-    ) -> list[ModelStateMapper]:
-        mappers = mappers.copy()
-        # inputs needed downstream but not produced upstream pass through
-        for stage_i in reversed(range(1, len(mappers))):
-            current_requires = frozenset().union(
-                *(
-                    g.inputs
-                    for g in mappers[stage_i].state_dependency_groups()
-                )
-            )
-            prev_produces = frozenset().union(
-                *(
-                    g.outputs
-                    for g in mappers[stage_i - 1].state_dependency_groups()
-                )
-            )
-            pass_through = current_requires - prev_produces
-            mappers[stage_i - 1] = ModelStateMapperParallel(
-                [mappers[stage_i - 1]]
-                + [ModelStateMapperIdentity(x) for x in pass_through]
-            )
-        # outputs produced upstream but not consumed downstream also pass
-        for stage_i in range(0, len(mappers) - 1):
-            current_produces = frozenset().union(
-                *(
-                    g.outputs
-                    for g in mappers[stage_i].state_dependency_groups()
-                )
-            )
-            next_requires = frozenset().union(
-                *(
-                    g.inputs
-                    for g in mappers[stage_i + 1].state_dependency_groups()
-                )
-            )
-            pass_through = current_produces - next_requires
-            mappers[stage_i + 1] = ModelStateMapperParallel(
-                [mappers[stage_i + 1]]
-                + [ModelStateMapperIdentity(x) for x in pass_through]
-            )
-        return mappers
-
-    @staticmethod
-    def _compute_pipeline_groups(
-        mappers: list[ModelStateMapper],
-    ) -> frozenset[StateGroup]:
-        outputs_depend_on_inputs = {}
-        for last_group in mappers[-1].state_dependency_groups():
-            required_inputs = last_group.inputs
-            for mapper_i in reversed(range(0, len(mappers) - 1)):
-                hit_groups = [
-                    g
-                    for g in mappers[mapper_i].state_dependency_groups()
-                    if not g.outputs.isdisjoint(required_inputs)
-                ]
-                required_inputs = frozenset().union(
-                    *(g.inputs for g in hit_groups)
-                )
-            outputs_depend_on_inputs[last_group.outputs] = required_inputs
-        return ModelStateMapperSequential._merge_groups(
-            list(outputs_depend_on_inputs.items())
-        )
-
-    @staticmethod
-    def _merge_groups(groups) -> frozenset[StateGroup]:
-        # Transitively union groups sharing any input or output key
-        # (union-find; a group is (outputs, inputs) as produced by
-        # _compute_pipeline_groups).
-        items = [(set(outs), set(ins)) for outs, ins in groups]
-        parent = list(range(len(items)))
-
-        def find(i: int) -> int:
-            while parent[i] != i:
-                parent[i] = parent[parent[i]]
-                i = parent[i]
-            return i
-
-        key_owner: dict[tuple[str, str], int] = {}
-        for i, (outs, ins) in enumerate(items):
-            for kind, keys in (("in", ins), ("out", outs)):
-                for key in keys:
-                    owner = key_owner.setdefault((kind, key), i)
-                    if owner != i:
-                        parent[find(i)] = find(owner)
-
-        merged: dict[int, tuple[set[str], set[str]]] = {}
-        for i, (outs, ins) in enumerate(items):
-            root = find(i)
-            acc = merged.setdefault(root, (set(), set()))
-            acc[0].update(outs)
-            acc[1].update(ins)
-        return frozenset(
-            StateGroup(inputs=frozenset(ins), outputs=frozenset(outs))
-            for outs, ins in merged.values()
+                claimed_in |= g.inputs
+                claimed_out |= g.outputs
+                self._route[g.inputs] = member
+        self._groups = frozenset(
+            g for m in members for g in m.state_dependency_groups()
         )
 
     def state_dependency_groups(self) -> frozenset[StateGroup]:
         return self._groups
 
     def apply(self, group: StateDict) -> StateDict:
-        current_state = group
-        next_state: StateDict = {}
-        for mapper in self._mappers:
-            for deps in mapper.state_dependency_groups():
-                if not deps.inputs <= current_state.keys():
-                    continue
-                next_state.update(
-                    mapper.apply(
-                        {
-                            k: v
-                            for k, v in current_state.items()
-                            if k in deps.inputs
-                        }
-                    )
+        member = self._route.get(frozenset(group))
+        if member is None:
+            raise ValueError(
+                f"parallel mapper: keys {sorted(group)} do not form a "
+                "declared dependency group (groups must be applied whole)"
+            )
+        return member.apply(group)
+
+
+class ModelStateMapperSequential(ModelStateMapper):
+    """Pipeline of mappers with automatic key pass-through.
+
+    Keys a later stage needs that an earlier stage does not produce flow
+    through untouched; keys produced mid-pipeline and never consumed again
+    flow to the output. Net dependency groups are transitively merged
+    across stages.
+    """
+
+    def __init__(self, mappers: list[ModelStateMapper]):
+        stages = filter_empty_mappers(mappers)
+        if not stages:
+            raise ValueError(
+                "sequential mapper needs at least one stage with a "
+                "non-empty dependency group"
+            )
+        self._stages = stages
+        io = [_stage_io(m) for m in stages]
+
+        # needed[i] = keys stages i..end must see entering stage i
+        needed: list[frozenset[str]] = [frozenset()] * (len(stages) + 1)
+        for i in reversed(range(len(stages))):
+            ins, outs = io[i]
+            needed[i] = ins | (needed[i + 1] - outs)
+
+        # keys that must pass over stage i untouched; consuming one of them
+        # at stage i would leave downstream starved — reject at build time
+        self._carry: list[frozenset[str]] = []
+        for i, (ins, outs) in enumerate(io):
+            over = needed[i + 1] - outs
+            stuck = over & ins
+            if stuck:
+                raise ValueError(
+                    f"sequential mapper: keys {sorted(stuck)} are consumed "
+                    f"by stage {i} but later stages still need them and no "
+                    "stage in between re-produces them"
                 )
-            current_state = next_state
-            next_state = {}
-        return current_state
+            self._carry.append(over)
+
+        self._net_inputs = needed[0]
+        self._groups = self._compute_net_groups(io)
+
+    def _compute_net_groups(self, io) -> frozenset[StateGroup]:
+        # simulate key flow to find the final key set
+        live = set(self._net_inputs)
+        made: set[str] = set()
+        for i, stage in enumerate(self._stages):
+            nxt: set[str] = set()
+            used: set[str] = set()
+            for g in stage.state_dependency_groups():
+                if g.inputs <= live:
+                    nxt |= g.outputs
+                    made |= g.outputs
+                    used |= g.inputs
+            for key in live - used:
+                if key in self._carry[i] or key in made:
+                    nxt.add(key)
+            live = nxt
+        net_outputs = frozenset(live)
+
+        comps = _KeyComponents()
+        for stage in self._stages:
+            for g in stage.state_dependency_groups():
+                comps.connect(g.inputs | g.outputs)
+        groups = []
+        for keys in comps.components().values():
+            ins = frozenset(keys) & self._net_inputs
+            outs = frozenset(keys) & net_outputs
+            if ins or outs:
+                groups.append(StateGroup(inputs=ins, outputs=outs))
+        return frozenset(groups)
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return self._groups
+
+    def apply(self, group: StateDict) -> StateDict:
+        state = dict(group)
+        made: set[str] = set()
+        for i, stage in enumerate(self._stages):
+            nxt: StateDict = {}
+            used: set[str] = set()
+            for g in stage.state_dependency_groups():
+                if g.inputs <= state.keys():
+                    nxt.update(
+                        stage.apply({k: state[k] for k in g.inputs})
+                    )
+                    made.update(g.outputs)
+                    used |= g.inputs
+            for key, value in state.items():
+                if key not in used and (key in self._carry[i] or key in made):
+                    nxt.setdefault(key, value)
+            state = nxt
+        return state
 
 
 class ModelStateMapperPrefixScope(ModelStateMapper):
-    """Scope a child mapper under source/target key prefixes."""
+    """Run a child mapper under source/target key-name prefixes."""
 
     def __init__(
         self,
@@ -215,31 +226,29 @@ class ModelStateMapperPrefixScope(ModelStateMapper):
         source_prefix: str = "",
         target_prefix: str = "",
     ):
-        self._mapper = mapper
-        self._source_prefix = source_prefix
-        self._target_prefix = target_prefix
-        self._groups = frozenset(
-            StateGroup(
-                inputs=frozenset(f"{source_prefix}{k}" for k in g.inputs),
-                outputs=frozenset(f"{target_prefix}{k}" for k in g.outputs),
-            )
-            for g in mapper.state_dependency_groups()
-        )
+        self._child = mapper
+        self._src = source_prefix
+        self._dst = target_prefix
 
     def state_dependency_groups(self) -> frozenset[StateGroup]:
-        return self._groups
+        return frozenset(
+            StateGroup(
+                inputs=frozenset(self._src + k for k in g.inputs),
+                outputs=frozenset(self._dst + k for k in g.outputs),
+            )
+            for g in self._child.state_dependency_groups()
+        )
 
     def apply(self, group: StateDict) -> StateDict:
-        scoped = {
-            k.removeprefix(self._source_prefix): v for k, v in group.items()
-        }
-        result = self._mapper.apply(scoped)
-        return {f"{self._target_prefix}{k}": v for k, v in result.items()}
+        inner = self._child.apply(
+            {k.removeprefix(self._src): v for k, v in group.items()}
+        )
+        return {self._dst + k: v for k, v in inner.items()}
 
 
 class ModelStateMapperShard(ModelStateMapper):
-    """Restrict a mapper to every ``total_shards``-th dependency group —
-    splits checkpoint loading work across processes."""
+    """Round-robin a mapper's dependency groups across ``total_shards``
+    workers — splits checkpoint transformation work across processes."""
 
     def __init__(
         self,
@@ -247,19 +256,17 @@ class ModelStateMapperShard(ModelStateMapper):
         total_shards: int,
         current_shard: int,
     ):
-        groups_sorted = sorted(
+        ordered = sorted(
             sub_mapper.state_dependency_groups(),
             key=lambda g: sorted(g.inputs),
         )
-        self._groups = frozenset(
-            g
-            for i, g in enumerate(groups_sorted)
-            if i % total_shards == current_shard
+        self._mine = frozenset(
+            ordered[i] for i in range(current_shard, len(ordered), total_shards)
         )
-        self._sub_mapper = sub_mapper
+        self._child = sub_mapper
 
     def state_dependency_groups(self) -> frozenset[StateGroup]:
-        return self._groups
+        return self._mine
 
     def apply(self, group: StateDict) -> StateDict:
-        return self._sub_mapper.apply(group)
+        return self._child.apply(group)

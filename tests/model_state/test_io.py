@@ -58,7 +58,7 @@ def test_shard_spilling(tmp_path):
 def test_writer_rejects_oversized_tensor(tmp_path):
     state = {"huge": np.zeros((1024, 1024), dtype=np.float32)}
     mapper = identity_mapper_from_names(state.keys())
-    with pytest.raises(ValueError, match="larger than shard"):
+    with pytest.raises(ValueError, match="larger than the shard size cap"):
         write_model_state_local(
             tmp_path, mapper, iter(state.items()), shard_size_gb=1 / 1024
         )
@@ -66,7 +66,7 @@ def test_writer_rejects_oversized_tensor(tmp_path):
 
 def test_writer_detects_missing_inputs(tmp_path):
     mapper = identity_mapper_from_names(["present", "absent"])
-    with pytest.raises(ValueError, match="Missing inputs"):
+    with pytest.raises(ValueError, match="still waiting for inputs"):
         write_model_state_local(
             tmp_path, mapper, iter({"present": np.ones(1)}.items())
         )

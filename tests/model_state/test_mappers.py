@@ -75,11 +75,11 @@ def test_select_child_modules():
 
 
 def test_parallel_collision_detection():
-    with pytest.raises(ValueError, match="colliding"):
+    with pytest.raises(ValueError, match="more than one sub-mapper"):
         ModelStateMapperParallel(
             [ModelStateMapperIdentity("x"), ModelStateMapperRename("x", "y")]
         )
-    with pytest.raises(ValueError, match="colliding"):
+    with pytest.raises(ValueError, match="more than one sub-mapper"):
         ModelStateMapperParallel(
             [
                 ModelStateMapperRename("a", "out"),
