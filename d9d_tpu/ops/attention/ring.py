@@ -163,6 +163,37 @@ def make_ring_sdpa(
                 "use the flash/eager backends for packed batches"
             )
 
+        # validate divisibility up front: without this, a mis-sized input
+        # surfaces as an opaque shard_map in_specs error deep in the jit
+        # (and the batch stager silently falls back to batch-only sharding
+        # for indivisible sequences, guaranteeing the reshard fails here)
+        def _size(axes):
+            out = 1
+            for a in axes:
+                out *= mesh.shape[a]
+            return out
+
+        b, t, hq, _ = q.shape
+        hkv = k.shape[2]
+        cp = _size((seq_axis,))
+        tp_h = _size(head_axes)
+        dp = _size(batch_axes)
+        if t % cp != 0:
+            raise ValueError(
+                f"ring attention: seq_len {t} not divisible by the "
+                f"'{seq_axis}' axis size {cp}"
+            )
+        if hq % tp_h != 0 or hkv % tp_h != 0:
+            raise ValueError(
+                f"ring attention: heads (q={hq}, kv={hkv}) not divisible "
+                f"by the head axes {tuple(head_axes)} size {tp_h}"
+            )
+        if b % dp != 0:
+            raise ValueError(
+                f"ring attention: batch {b} not divisible by the batch "
+                f"axes {tuple(batch_axes)} size {dp}"
+            )
+
         # align activations to the ring layout explicitly — otherwise the
         # partitioner resharding into shard_map's fixed in_specs can fall
         # back to replicate-then-repartition around every attention layer

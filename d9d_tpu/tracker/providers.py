@@ -37,8 +37,9 @@ class NullTracker(Tracker):
 class MemoryTrackerRun(TrackerRun):
     """Keeps everything in lists — the test/debug tracker."""
 
-    def __init__(self, run_hash: str | None = None):
+    def __init__(self, run_hash: str | None = None, run_name: str | None = None):
         self.run_hash = run_hash or uuid.uuid4().hex
+        self.run_name = run_name
         self.scalars: list[dict[str, Any]] = []
         self.histograms: list[dict[str, Any]] = []
         self.hparams: dict[str, Any] = {}
@@ -78,7 +79,7 @@ class MemoryTracker(Tracker):
         self.runs: list[MemoryTrackerRun] = []
 
     def new_run(self, run_name=None):
-        run = MemoryTrackerRun()
+        run = MemoryTrackerRun(run_name=run_name)
         self.runs.append(run)
         return run
 
@@ -86,10 +87,21 @@ class MemoryTracker(Tracker):
 class JsonlTrackerRun(TrackerRun):
     """Appends one JSON object per tracked value to ``{dir}/{hash}.jsonl``."""
 
-    def __init__(self, directory: Path, run_hash: str | None = None):
+    def __init__(
+        self,
+        directory: Path,
+        run_hash: str | None = None,
+        run_name: str | None = None,
+    ):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
-        self.run_hash = run_hash or uuid.uuid4().hex
+        self.run_name = run_name
+        # the name prefixes the file for humans; the hash keeps it unique
+        # and is what resume re-points at (reference threads run_name the
+        # same way into the tracker dir)
+        self.run_hash = run_hash or (
+            f"{run_name}-{uuid.uuid4().hex[:8]}" if run_name else uuid.uuid4().hex
+        )
         self._fh = None
 
     def _file(self):
@@ -144,7 +156,7 @@ class JsonlTracker(Tracker):
         self.directory = Path(directory)
 
     def new_run(self, run_name=None):
-        return JsonlTrackerRun(self.directory)
+        return JsonlTrackerRun(self.directory, run_name=run_name)
 
 
 class AimTrackerRun(TrackerRun):  # pragma: no cover - needs aim installed

@@ -1,0 +1,38 @@
+"""cp staging guard: indivisible seq_len fails loudly; mis-sized leaves
+warn instead of silently bypassing sequence sharding (VERDICT r1 #10)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop.components.batch_staging import make_batch_stager
+
+
+@pytest.fixture()
+def ctx(devices):
+    return MeshParameters(dp_shard=4, cp_shard=2).build(devices)
+
+
+def test_indivisible_seq_len_raises(ctx):
+    with pytest.raises(ValueError, match="not divisible by the context-parallel"):
+        make_batch_stager(
+            ctx, num_microbatches=1, microbatch_size=8, seq_len=17
+        )
+
+
+def test_mis_sized_leaf_warns_once(ctx):
+    stage = make_batch_stager(
+        ctx, num_microbatches=1, microbatch_size=8, seq_len=16
+    )
+    batch = {
+        "tokens": np.zeros((8, 16), np.int32),
+        "raw_ids": np.zeros((8, 17), np.int32),  # dim-2 != seq_len
+    }
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        stage(batch)
+        stage(batch)  # second call must not warn again
+    msgs = [w for w in caught if "bypass context-parallel" in str(w.message)]
+    assert len(msgs) == 1
