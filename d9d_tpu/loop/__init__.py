@@ -15,7 +15,11 @@ from d9d_tpu.loop.control.providers import (
 from d9d_tpu.loop.control.task import TrainTask
 from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
-from d9d_tpu.loop.tasks import CausalLMTask
+from d9d_tpu.loop.tasks import (
+    CausalLMTask,
+    EmbeddingContrastiveTask,
+    SequenceClassificationTask,
+)
 from d9d_tpu.loop.train import Trainer
 from d9d_tpu.loop.train_step import build_train_step
 
@@ -39,6 +43,8 @@ __all__ = [
     "EventBus",
     "init_sharded_params",
     "CausalLMTask",
+    "EmbeddingContrastiveTask",
+    "SequenceClassificationTask",
     "Trainer",
     "build_train_step",
 ]

@@ -1,0 +1,82 @@
+"""Device-buffered metric collection for the training loop.
+
+Reference: d9d/internals/metric_collector/collector.py:10
+(AsyncMetricCollector runs metric sync on a side CUDA stream) and
+d9d/loop/component/job_logger.py:44 (flush cadence into the tracker).
+
+TPU redesign: there is no side stream to manage — XLA's async dispatch
+*is* the side stream. Raw task statistics accumulate as device arrays
+(`carry + step_stats`, enqueued without blocking); only ``flush`` on the
+log cadence materializes them to host, feeds the task's Metric objects,
+runs their cross-process ``sync()``, computes, and pushes results to the
+tracker. Between flushes the host never waits on a metric.
+"""
+
+import jax
+import numpy as np
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.loop.control.task import TrainTask
+
+TASK_STAT_PREFIX = "task/"
+
+
+def _flatten_result(name: str, value) -> dict[str, float]:
+    """Metric compute() results → flat scalar dict for the tracker."""
+    out: dict[str, float] = {}
+    if isinstance(value, dict):
+        for k, v in value.items():
+            out.update(_flatten_result(f"{name}/{k}", v))
+        return out
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        out[name] = float(arr)
+    else:
+        for i, v in enumerate(arr.reshape(-1)):
+            out[f"{name}/{i}"] = float(v)
+    return out
+
+
+class MetricCollector:
+    def __init__(self, task: TrainTask):
+        self.task = task
+        self.metrics = task.metrics()
+        self._carry: PyTree | None = None
+        self._add = jax.jit(
+            lambda a, b: jax.tree.map(lambda x, y: x + y, a, b)
+        )
+
+    def collect(self, step_metrics: dict) -> None:
+        """Accumulate this step's raw task statistics on device (async)."""
+        if not self.metrics:
+            return
+        stats = {
+            k[len(TASK_STAT_PREFIX):]: v
+            for k, v in step_metrics.items()
+            if k.startswith(TASK_STAT_PREFIX)
+        }
+        if not stats:
+            return
+        self._carry = (
+            stats if self._carry is None else self._add(self._carry, stats)
+        )
+
+    def flush(self, run, step: int) -> dict[str, float]:
+        """Materialize the window's statistics, update/sync/compute every
+        task metric, push to the tracker, reset for the next window."""
+        if not self.metrics or self._carry is None:
+            return {}
+        host_stats = jax.tree.map(np.asarray, jax.device_get(self._carry))
+        self._carry = None
+        self.task.update_metrics(self.metrics, host_stats)
+        results: dict[str, float] = {}
+        for name, metric in self.metrics.items():
+            metric.sync()
+            results.update(_flatten_result(name, metric.compute()))
+            metric.reset()
+        if run is not None:
+            for k, v in results.items():
+                run.track_scalar(
+                    f"metric/{k}", v, step=step, context={"subset": "train"}
+                )
+        return results

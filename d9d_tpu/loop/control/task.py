@@ -47,6 +47,23 @@ class TrainTask(abc.ABC):
         """Optional host-side metric transformation before logging."""
         return metrics
 
+    # -- structured metrics (reference loop/control/task.py metric surface,
+    #    collected through loop/components/metric_collector.py) -----------
+
+    def metrics(self) -> dict[str, Any]:
+        """Metric objects (d9d_tpu.metric.Metric) this task maintains.
+
+        Raw statistics returned by ``loss_fn``'s metric dict accumulate on
+        device between log steps; ``update_metrics`` receives their
+        window sums and feeds these objects.
+        """
+        return {}
+
+    def update_metrics(
+        self, metric_objs: dict[str, Any], stats: dict[str, Any]
+    ) -> None:
+        """Feed windowed host statistics into ``metrics()`` objects."""
+
 
 class PipelineTrainTask(TrainTask):
     """A TrainTask that can also drive a pipeline-parallel schedule.

@@ -1,11 +1,12 @@
 """Built-in task implementations."""
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from d9d_tpu.core.types import Array, PyTree
-from d9d_tpu.loop.control.task import PipelineTrainTask
+from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.ops import LM_IGNORE_INDEX
 
 
@@ -73,3 +74,142 @@ class CausalLMTask(PipelineTrainTask):
                 rng, carry, kwargs["positions"], state["labels"]
             )
         return module.init(rng, carry, kwargs["positions"])
+
+
+class SequenceClassificationTask(TrainTask):
+    """Fine-tune a classification-head model (reference task surface,
+    loop/control/task.py:180 + the ClassificationHead model family).
+
+    Batches: ``input_ids`` [B, T] (+ optional ``attention_mask`` [B, T])
+    and integer ``class_labels`` [B]. The model must map
+    (tokens, positions, pooling_mask) → logits [B, C]. Per-class confusion
+    counts are reduced on device inside the step; the ConfusionMatrixMetric
+    aggregates them across the log window and processes.
+    """
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        tokens = np.asarray(batch["input_ids"])
+        b, t = tokens.shape
+        out = {
+            "tokens": tokens,
+            "labels": np.asarray(batch["class_labels"]).astype(np.int32),
+            "positions": np.broadcast_to(
+                np.arange(t, dtype=np.int32), (b, t)
+            ).copy(),
+        }
+        if "attention_mask" in batch:
+            out["pooling_mask"] = np.asarray(batch["attention_mask"])
+        else:
+            out["pooling_mask"] = np.ones((b, t), np.int32)
+        return out
+
+    def loss_fn(self, module, params, mb, rng):
+        logits = module.apply(
+            params, mb["tokens"], mb["positions"], mb["pooling_mask"]
+        ).astype(jnp.float32)
+        labels = mb["labels"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss_sum = -jnp.take_along_axis(logp, labels[:, None], axis=-1).sum()
+        b = labels.shape[0]
+        pred = jnp.argmax(logits, axis=-1)
+        pred_1h = jax.nn.one_hot(pred, self.num_classes, dtype=jnp.float32)
+        true_1h = jax.nn.one_hot(labels, self.num_classes, dtype=jnp.float32)
+        tp = (pred_1h * true_1h).sum(0)
+        fp = (pred_1h * (1 - true_1h)).sum(0)
+        fn = ((1 - pred_1h) * true_1h).sum(0)
+        tn = ((1 - pred_1h) * (1 - true_1h)).sum(0)
+        metrics = {
+            "correct": (pred == labels).sum().astype(jnp.float32),
+            "examples": jnp.asarray(b, jnp.float32),
+            "confusion": jnp.stack([tp, fp, tn, fn]),  # [4, C]
+        }
+        return loss_sum, jnp.asarray(b, jnp.float32), metrics
+
+    def metrics_postprocess(self, metrics):
+        # per-step console view; the windowed truth rides the Metric objects
+        if "task/correct" in metrics and "task/examples" in metrics:
+            metrics["task/accuracy"] = metrics["task/correct"] / max(
+                metrics["task/examples"], 1.0
+            )
+            metrics.pop("task/confusion", None)
+        return metrics
+
+    def metrics(self):
+        from d9d_tpu.metric import ConfusionMatrixMetricBuilder
+
+        return {
+            "accuracy": (
+                ConfusionMatrixMetricBuilder()
+                .multiclass(self.num_classes)
+                .with_accuracy()
+                .micro()
+                .build()
+            ),
+        }
+
+    def update_metrics(self, metric_objs, stats):
+        tp, fp, tn, fn = np.asarray(stats["confusion"])
+        metric_objs["accuracy"].update_counts(tp=tp, fp=fp, tn=tn, fn=fn)
+
+
+class EmbeddingContrastiveTask(TrainTask):
+    """In-batch contrastive (InfoNCE) training for the embedding head
+    (reference embedding task, loop/control/task.py:262 family).
+
+    Batches: ``input_ids_a``/``input_ids_b`` [B, T] paired views. The
+    model must map (tokens, positions, pooling_mask) → L2-normalized
+    embeddings [B, D]. Loss is symmetric InfoNCE over the in-batch
+    similarity matrix; retrieval@1 counts ride the metric window.
+    """
+
+    def __init__(self, temperature: float = 0.05):
+        self.temperature = temperature
+
+    def prepare_batch(self, batch: PyTree) -> PyTree:
+        a = np.asarray(batch["input_ids_a"])
+        b_ids = np.asarray(batch["input_ids_b"])
+        bsz, t = a.shape
+        positions = np.broadcast_to(np.arange(t, dtype=np.int32), (bsz, t))
+        return {
+            "tokens_a": a,
+            "tokens_b": b_ids,
+            "positions": positions.copy(),
+            "pooling_mask": np.asarray(
+                batch.get("attention_mask", np.ones((bsz, t), np.int32))
+            ),
+        }
+
+    def loss_fn(self, module, params, mb, rng):
+        emb_a = module.apply(
+            params, mb["tokens_a"], mb["positions"], mb["pooling_mask"]
+        ).astype(jnp.float32)
+        emb_b = module.apply(
+            params, mb["tokens_b"], mb["positions"], mb["pooling_mask"]
+        ).astype(jnp.float32)
+        sim = emb_a @ emb_b.T / self.temperature  # [B, B]
+        bsz = sim.shape[0]
+        targets = jnp.arange(bsz)
+        logp_ab = jax.nn.log_softmax(sim, axis=-1)
+        logp_ba = jax.nn.log_softmax(sim.T, axis=-1)
+        diag = jnp.diag_indices(bsz)
+        loss_sum = -(logp_ab[diag].sum() + logp_ba[diag].sum()) / 2.0
+        hits = (jnp.argmax(sim, axis=-1) == targets).sum()
+        metrics = {
+            "retrieval_hits": hits.astype(jnp.float32),
+            "examples": jnp.asarray(bsz, jnp.float32),
+        }
+        return loss_sum, jnp.asarray(bsz, jnp.float32), metrics
+
+    def metrics(self):
+        from d9d_tpu.metric import WeightedMeanMetric
+
+        return {"retrieval_at_1": WeightedMeanMetric()}
+
+    def update_metrics(self, metric_objs, stats):
+        metric_objs["retrieval_at_1"].update(
+            values=np.asarray(stats["retrieval_hits"]),
+            weights=np.asarray(stats["examples"]),
+        )

@@ -26,6 +26,7 @@ from d9d_tpu.loop.components.batch_staging import make_batch_stager
 from d9d_tpu.loop.components.checkpointer import StateCheckpointer
 from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
 from d9d_tpu.loop.components.job_profiler import JobProfiler
+from d9d_tpu.loop.components.metric_collector import MetricCollector
 from d9d_tpu.loop.components.stepper import Stepper
 from d9d_tpu.loop.components.timeout_manager import TimeoutManager
 from d9d_tpu.loop.config import TrainerConfig
@@ -162,6 +163,7 @@ class Trainer:
             step_timeout_s=config.step_timeout_s,
         )
         self.gc = ManualGarbageCollector(config.gc_every_steps)
+        self.metric_collector = MetricCollector(self.task)
         self.run = None  # tracker run, opened in train()
         self._sleep_store: dict[SleepTag, tuple[PyTree, PyTree]] = {}
 
@@ -302,6 +304,7 @@ class Trainer:
                             ev.EVENT_FORWARD_BACKWARD, trainer=self, step=step
                         ):
                             metrics = self._optimizer_step(batch)
+                        self.metric_collector.collect(metrics)
                     step = self.stepper.advance()
                     self.profiler.step_end(step - 1)
                     self.gc.step(step)
@@ -312,10 +315,17 @@ class Trainer:
                         jax.block_until_ready(metrics)
                     self.timeout.set_periodic()
                     if step % self.config.log_every == 0 or self.stepper.finished:
+                        # scalars only: non-scalar stats (e.g. per-class
+                        # confusion counts) are metric-collector fodder
                         host_metrics = {
-                            k: float(np.asarray(v)) for k, v in metrics.items()
+                            k: float(arr)
+                            for k, v in metrics.items()
+                            if (arr := np.asarray(v)).ndim == 0
                         }
                         host_metrics = self.task.metrics_postprocess(host_metrics)
+                        host_metrics.update(
+                            self.metric_collector.flush(self.run, step)
+                        )
                         host_metrics["step"] = step
                         host_metrics["wall_s"] = time.perf_counter() - t0
                         history.append(host_metrics)

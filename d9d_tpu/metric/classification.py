@@ -138,10 +138,21 @@ class ConfusionMatrixAccumulator:
         targets = np.asarray(targets).reshape(-1, self._num_outputs)
         p = preds.astype(bool)
         t = targets.astype(bool)
-        self._tp.update((p & t).sum(axis=0))
-        self._fp.update((p & ~t).sum(axis=0))
-        self._tn.update((~p & ~t).sum(axis=0))
-        self._fn.update((~p & t).sum(axis=0))
+        self.update_counts(
+            tp=(p & t).sum(axis=0),
+            fp=(p & ~t).sum(axis=0),
+            tn=(~p & ~t).sum(axis=0),
+            fn=(~p & t).sum(axis=0),
+        )
+
+    def update_counts(self, *, tp, fp, tn, fn) -> None:
+        """Accumulate pre-reduced per-class counts — the entry point for
+        statistics that were already summed on device inside the jitted
+        step (the TPU-native replacement for row-level update)."""
+        self._tp.update(tp)
+        self._fp.update(fp)
+        self._tn.update(tn)
+        self._fn.update(fn)
 
     def sync(self) -> None:
         for acc in (self._tp, self._fp, self._tn, self._fn):
@@ -254,6 +265,11 @@ class ConfusionMatrixMetric(Metric[np.ndarray]):
     def update(self, preds, targets) -> None:
         p, t = self._processor(preds, targets)
         self._accumulator.update(p, t)
+
+    def update_counts(self, *, tp, fp, tn, fn) -> None:
+        """Feed device-pre-reduced per-class counts straight to the
+        accumulator (bypasses the prediction processor)."""
+        self._accumulator.update_counts(tp=tp, fp=fp, tn=tn, fn=fn)
 
     def sync(self) -> None:
         self._accumulator.sync()
