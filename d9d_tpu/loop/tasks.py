@@ -209,7 +209,11 @@ class EmbeddingContrastiveTask(TrainTask):
         return {"retrieval_at_1": WeightedMeanMetric()}
 
     def update_metrics(self, metric_objs, stats):
+        # WeightedMeanMetric computes Σ(value·weight)/Σweight, so feed the
+        # per-window hit *rate* with the example count as its weight
+        examples = np.asarray(stats["examples"], np.float32)
+        hits = np.asarray(stats["retrieval_hits"], np.float32)
         metric_objs["retrieval_at_1"].update(
-            values=np.asarray(stats["retrieval_hits"]),
-            weights=np.asarray(stats["examples"]),
+            values=hits / np.maximum(examples, 1.0),
+            weights=examples,
         )

@@ -39,7 +39,8 @@ CFG = Qwen3DenseConfig(
     remat=False,
 )
 N_CLASSES = 3
-STEPS = 12
+STEPS = 24
+EMB_STEPS = 36
 
 
 class ClsProvider(ModelProvider):
@@ -58,15 +59,21 @@ class ClsProvider(ModelProvider):
 
 
 class ClsData(DatasetProvider):
-    """Learnable rule: the class is the first token modulo N_CLASSES."""
+    """Learnable rule: the class is the token at the last *valid* position
+    (per attention_mask) modulo N_CLASSES — the exact token the model pools,
+    so the rule is learnable within the step budget while still exercising
+    the variable-length pooling path."""
 
     def build(self):
         rng = np.random.RandomState(0)
         for _ in range(STEPS):
             ids = rng.randint(0, VOCAB, size=(16, 16))
+            lens = rng.randint(4, 17, size=(16,))
+            mask = (np.arange(16)[None, :] < lens[:, None]).astype(np.int32)
             yield {
                 "input_ids": ids,
-                "class_labels": ids[:, 0] % N_CLASSES,
+                "attention_mask": mask,
+                "class_labels": ids[np.arange(16), lens - 1] % N_CLASSES,
             }
 
 
@@ -86,27 +93,31 @@ class EmbProvider(ModelProvider):
 
 
 class EmbData(DatasetProvider):
-    """Pairs sharing a distinctive leading token are positives."""
+    """Pairs sharing a distinctive leading token are positives; leads are
+    distinct within a batch so retrieval@1 is well-defined. Sharing only
+    the lead (not a long prefix) keeps the task non-trivial at init, so
+    the loss has headroom to decrease."""
 
     def build(self):
         rng = np.random.RandomState(1)
-        for _ in range(STEPS):
-            base = rng.randint(0, VOCAB, size=(8, 16))
-            a = base.copy()
-            b = base.copy()
-            b[:, 8:] = rng.randint(0, VOCAB, size=(8, 8))
+        for _ in range(EMB_STEPS):
+            lead = rng.permutation(VOCAB)[:16]
+            a = rng.randint(0, VOCAB, size=(16, 16))
+            b = rng.randint(0, VOCAB, size=(16, 16))
+            a[:, 0] = lead
+            b[:, 0] = lead
             yield {"input_ids_a": a, "input_ids_b": b}
 
 
-def _train(task, provider, data, devices, tracker):
+def _train(task, provider, data, devices, tracker, steps):
     ctx = MeshParameters(dp_shard=4).build(devices[:4])
     trainer = Trainer(
         ctx=ctx,
         config=TrainerConfig(
-            global_batch_size=16 if isinstance(task, SequenceClassificationTask) else 8,
-            microbatch_size=16 if isinstance(task, SequenceClassificationTask) else 8,
+            global_batch_size=16,
+            microbatch_size=16,
             seq_len=16,
-            total_steps=STEPS,
+            total_steps=steps,
             log_every=4,
             learning_rate=2e-3,
         ),
@@ -119,14 +130,23 @@ def _train(task, provider, data, devices, tracker):
     return trainer.train()
 
 
+def _window_mean(hist, key, sl):
+    vals = [h[key] for h in hist]
+    return float(np.mean(vals[sl]))
+
+
 def test_classification_finetune_reports_accuracy(devices):
     tracker = MemoryTracker()
     hist = _train(
         SequenceClassificationTask(N_CLASSES), ClsProvider(), ClsData(),
-        devices, tracker,
+        devices, tracker, STEPS,
     )
-    # loss down on the learnable rule
-    assert hist[-1]["loss"] < hist[0]["loss"]
+    # loss down on the learnable rule: history carries one entry per log
+    # window (STEPS/4 = 6 here); compare disjoint early vs late windows
+    assert len(hist) == STEPS // 4
+    assert _window_mean(hist, "loss", slice(-2, None)) < _window_mean(
+        hist, "loss", slice(0, 2)
+    )
     # windowed accuracy from the ConfusionMatrixMetric rode into history...
     assert "accuracy" in hist[-1]
     # ...and through the tracker
@@ -135,17 +155,24 @@ def test_classification_finetune_reports_accuracy(devices):
     assert len(acc_points) == STEPS // 4
     assert all(0.0 <= p["value"] <= 1.0 for p in acc_points)
     # by the last window the rule should be mostly learned
-    assert acc_points[-1]["value"] > acc_points[0]["value"] - 0.05
+    assert acc_points[-1]["value"] > acc_points[0]["value"] + 0.1
 
 
 def test_embedding_contrastive_reports_retrieval(devices):
     tracker = MemoryTracker()
     hist = _train(
-        EmbeddingContrastiveTask(), EmbProvider(), EmbData(), devices, tracker
+        EmbeddingContrastiveTask(temperature=0.2), EmbProvider(), EmbData(),
+        devices, tracker, EMB_STEPS,
     )
-    assert hist[-1]["loss"] < hist[0]["loss"]
+    # one history entry per log window (EMB_STEPS/4 = 9): disjoint thirds
+    assert len(hist) == EMB_STEPS // 4
+    assert _window_mean(hist, "loss", slice(-3, None)) < _window_mean(
+        hist, "loss", slice(0, 3)
+    )
     run = tracker.runs[-1]
     points = [s for s in run.scalars if s["name"] == "metric/retrieval_at_1"]
-    assert len(points) == STEPS // 4
-    assert points[-1]["value"] >= points[0]["value"] - 0.1
-    assert 0.0 <= points[-1]["value"] <= 1.0
+    assert len(points) == EMB_STEPS // 4
+    vals = [p["value"] for p in points]
+    assert all(0.0 <= v <= 1.0 for v in vals)
+    # retrieval improves across the run (windowed means over metric points)
+    assert np.mean(vals[-3:]) > np.mean(vals[:3]) + 0.05
