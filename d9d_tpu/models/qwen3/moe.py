@@ -11,6 +11,8 @@ from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
 
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn.attention import GroupedQueryAttention
@@ -59,6 +61,10 @@ class Qwen3MoeConfig:
     remat: bool = True
     # mesh axes carrying expert parallelism; None = local experts
     ep_axes: Optional[tuple[str, ...]] = None
+    # (batch_axes, seq_axes) of the residual activation layout; when set,
+    # the EP flow shard_maps over this layout directly (no boundary
+    # reshard) — see MoELayer.token_axes
+    moe_token_axes: Optional[tuple[tuple[str, ...], tuple[str, ...]]] = None
     # EP dispatch buffer sizing (see MoELayer.ep_capacity_factor): a factor
     # like 2.0 gives N·k/ep per-shard compute with deterministic drops;
     # None = dropless worst-case buffer
@@ -150,6 +156,7 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 router_renormalize_probabilities=cfg.norm_topk_prob,
                 shared_expert=cfg.shared_expert,
                 ep_axes=cfg.ep_axes,
+                token_axes=cfg.moe_token_axes,
                 ep_capacity_factor=cfg.ep_capacity_factor,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
@@ -162,8 +169,15 @@ class Qwen3MoeBackbone(nn.Module):
     config: Qwen3MoeConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
+    # residual-stream [B, T, E] sharding pin — see Qwen3DenseBackbone
+    act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+
+    def _pin(self, x: Array) -> Array:
+        if self.act_sharding is not None:
+            return lax.with_sharding_constraint(x, self.act_sharding)
+        return x
 
     @nn.compact
     def __call__(
@@ -183,6 +197,7 @@ class Qwen3MoeBackbone(nn.Module):
             )(x)
         else:
             x = x.astype(self.dtype)
+        x = self._pin(x)
 
         inv_freq, att_scale = compute_rope_frequencies(
             cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
@@ -202,6 +217,7 @@ class Qwen3MoeBackbone(nn.Module):
                 param_dtype=self.param_dtype,
                 name=f"layers_{gid}",
             )(x, cos, sin, mask)
+            x = self._pin(x)
 
         if self.stage.is_last:
             x = RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="norm")(x)
@@ -215,6 +231,7 @@ class Qwen3MoeCausalLM(nn.Module):
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
     ce_chunk_size: int = 2048
+    act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -223,6 +240,7 @@ class Qwen3MoeCausalLM(nn.Module):
             config=self.config,
             sdpa=self.sdpa,
             stage=self.stage,
+            act_sharding=self.act_sharding,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -263,6 +281,7 @@ class Qwen3MoeForClassification(nn.Module):
     sdpa: SdpaBackend
     num_classes: int = 2
     stage: PipelineStageInfo = PipelineStageInfo()
+    act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -278,6 +297,7 @@ class Qwen3MoeForClassification(nn.Module):
             config=self.config,
             sdpa=self.sdpa,
             stage=self.stage,
+            act_sharding=self.act_sharding,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="model",
@@ -303,6 +323,7 @@ class Qwen3MoeForEmbedding(nn.Module):
     config: Qwen3MoeConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
+    act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -318,6 +339,7 @@ class Qwen3MoeForEmbedding(nn.Module):
             config=self.config,
             sdpa=self.sdpa,
             stage=self.stage,
+            act_sharding=self.act_sharding,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="model",

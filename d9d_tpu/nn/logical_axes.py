@@ -13,6 +13,12 @@ instead of eager collectives.
 EMBED = "embed"
 # Vocabulary dimension.
 VOCAB = "vocab"
+# Feature dim of vocab-range tables (embedding + LM head). Distinct from
+# EMBED so ZeRO-3 plans can shard these tables on their (large) vocab dim
+# instead: sharding the feature dim puts the table's layout at war with
+# sequence-parallel activations (t@cp vs e@cp) and forces the partitioner
+# into replicate-reshard at every lookup.
+VOCAB_FEATURES = "vocab_features"
 # FFN intermediate width.
 MLP = "mlp"
 # Attention query heads (x head_dim fused projections are split on heads).

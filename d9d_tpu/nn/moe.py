@@ -68,7 +68,7 @@ class TopKRouter(nn.Module):
 
     @nn.compact
     def __call__(self, hidden: Array) -> tuple[Array, Array]:
-        """hidden [N, D] → (indices [N, K] int32, probs [N, K] fp32)."""
+        """hidden [..., D] → (indices [..., K] int32, probs [..., K] fp32)."""
         scores = nn.Dense(
             self.num_experts,
             use_bias=False,
@@ -218,9 +218,24 @@ class MoELayer(nn.Module):
     ``ep_axes`` selects the communication handler, mirroring the
     reference's enable_distributed_communicator (layer.py:67):
     - None → local permute only (NoCommunicationHandler).
-    - mesh axis tuple → shard_map EP flow over those axes. Tokens must be
-      sharded over ``ep_axes`` on the batch dim and expert weights on the
-      expert dim (the EP plan arranges both).
+    - mesh axis tuple → shard_map EP flow over those axes. Expert weights
+      must be sharded over ``ep_axes`` on the expert dim (the EP plan
+      arranges this).
+
+    Token layout for the EP flow:
+    - ``token_axes=None`` (legacy) → tokens are flattened [B·T, D] and
+      resharded over ``ep_axes`` at the layer boundary. Correct, but the
+      boundary reshard is a real all-to-all the partitioner may implement
+      as replicate+slice, and any ep axis that carries no tokens upstream
+      (e.g. tp) replicates the dense compute.
+    - ``token_axes=(batch_axes, seq_axes)`` → the shard_map rides the
+      residual activation layout [B@batch_axes, T@seq_axes, D] directly
+      (zero boundary reshard). ep axes that don't shard tokens upstream
+      (tp / cp_replicate) subdivide each device's local tokens by their
+      axis index — Megatron-sequence-parallel style — and an all-gather
+      over those axes after combine restores the local block, so every
+      device in the ep fiber owns a disjoint token set and no compute is
+      duplicated.
     """
 
     hidden_dim: int
@@ -231,6 +246,9 @@ class MoELayer(nn.Module):
     router_enable_expert_bias: bool = False
     shared_expert: Optional[SharedExpertParameters] = None
     ep_axes: Optional[tuple[str, ...]] = None
+    # (batch_axes, seq_axes) of the residual activation layout — see class
+    # docstring; None keeps the legacy flatten+reshard EP flow
+    token_axes: Optional[tuple[tuple[str, ...], tuple[str, ...]]] = None
     # receive-buffer rows per shard = capacity_factor × n_loc·k (rounded) —
     # this is also the per-shard grouped-GEMM row count, so a factor like
     # 2.0 gives the N·k/ep compute scaling; overflow drops assignment tails
@@ -270,13 +288,15 @@ class MoELayer(nn.Module):
     def __call__(self, hidden: Array) -> Array:
         """[B, T, D] → [B, T, D]."""
         orig_shape = hidden.shape
-        x = hidden.reshape(-1, orig_shape[-1])
 
+        # router + shared expert run on the 3D layout: flattening first
+        # would detour [B@dp, T@cp] activations through a fused-token
+        # sharding and back (replicate-reshard at scale)
         shared = None
         if self.shared_expert is not None:
-            shared = self.shared_expert_module(x)
+            shared = self.shared_expert_module(hidden)
 
-        topk_ids, topk_probs = self.router(x)
+        topk_ids, topk_probs = self.router(hidden)  # [B, T, K]
 
         # load-balancing stats (reference tokens_per_expert buffer):
         # collected when callers apply with mutable=["moe_stats"]
@@ -293,13 +313,18 @@ class MoELayer(nn.Module):
         )
 
         if self.ep_axes is None:
-            out = self._forward_local(x, topk_ids, topk_probs)
+            k = topk_ids.shape[-1]
+            out = self._forward_local(
+                hidden.reshape(-1, orig_shape[-1]),
+                topk_ids.reshape(-1, k),
+                topk_probs.reshape(-1, k),
+            ).reshape(orig_shape)
         else:
-            out = self._forward_ep(x, topk_ids, topk_probs)
+            out = self._forward_ep(hidden, topk_ids, topk_probs)
 
         if shared is not None:
             out = out + shared
-        return out.reshape(orig_shape)
+        return out
 
     # --- local permute path (reference communications/naive.py) ----------
 
@@ -314,8 +339,9 @@ class MoELayer(nn.Module):
     # --- EP path (reference communications/deepep.py, re-designed) -------
 
     def _forward_ep(
-        self, x: Array, topk_ids: Array, topk_probs: Array
+        self, hidden: Array, topk_ids: Array, topk_probs: Array
     ) -> Array:
+        """hidden [B, T, D], ids/probs [B, T, K] → [B, T, D]."""
         ep_axes = tuple(self.ep_axes)
         mesh = jax.sharding.get_abstract_mesh()
         if not mesh.shape:
@@ -342,9 +368,14 @@ class MoELayer(nn.Module):
         dtype = self.dtype
         capacity = self.ep_capacity_factor
 
-        def ep_body(x_loc, ids_loc, probs_loc, gate_w, up_w, down_w):
-            # x_loc: [n_loc, D] — this shard's tokens
-            # gate_w/up_w/down_w: [e_loc, ...] — this shard's experts
+        def expert_weights():
+            return (
+                self.grouped_experts.gate_weight,
+                self.grouped_experts.up_weight,
+                self.grouped_experts.down_weight,
+            )
+
+        def dispatch_local(x_loc, ids_loc, probs_loc, gate_w, up_w, down_w):
             def expert_fn(rows, group_sizes):
                 return grouped_swiglu_apply(
                     rows,
@@ -367,25 +398,74 @@ class MoELayer(nn.Module):
                 capacity_factor=capacity,
             )
 
+        if self.token_axes is None:
+            # legacy flow: flatten tokens globally, reshard over ep_axes
+            d = hidden.shape[-1]
+            k = topk_ids.shape[-1]
+            out = jax.shard_map(
+                dispatch_local,
+                mesh=mesh,
+                in_specs=(P(ep_axes, None),) * 3
+                + (P(ep_axes, None, None),) * 3,
+                out_specs=P(ep_axes, None),
+                axis_names=set(ep_axes),
+            )(
+                hidden.reshape(-1, d),
+                topk_ids.reshape(-1, k),
+                topk_probs.reshape(-1, k),
+                *expert_weights(),
+            )
+            return out.reshape(hidden.shape).astype(hidden.dtype)
+
+        # token-layout flow: ride the residual sharding, no boundary reshard
+        batch_axes, seq_axes = (tuple(a) for a in self.token_axes)
+        token_carrying = set(batch_axes) | set(seq_axes)
+        dup_axes = tuple(a for a in ep_axes if a not in token_carrying)
+        dup = 1
+        for a in dup_axes:
+            dup *= mesh.shape[a]
+        tok_spec = P(batch_axes, seq_axes, None)
+
+        def ep_body(x_loc, ids_loc, probs_loc, gate_w, up_w, down_w):
+            b_loc, t_loc, d = x_loc.shape
+            n_full = b_loc * t_loc
+            x_flat = x_loc.reshape(n_full, d)
+            ids_flat = ids_loc.reshape(n_full, -1)
+            probs_flat = probs_loc.reshape(n_full, -1)
+
+            if dup > 1:
+                # ep axes that shard no tokens upstream see a replicated
+                # local block: subdivide ownership by axis index so the ep
+                # fiber's token sets stay disjoint (Megatron-SP style)
+                if n_full % dup != 0:
+                    raise ValueError(
+                        f"local token count {n_full} not divisible by the "
+                        f"non-token ep axes {dup_axes} (size {dup})"
+                    )
+                n_own = n_full // dup
+                idx = lax.axis_index(dup_axes)
+                start = idx * n_own
+                x_flat = lax.dynamic_slice_in_dim(x_flat, start, n_own)
+                ids_flat = lax.dynamic_slice_in_dim(ids_flat, start, n_own)
+                probs_flat = lax.dynamic_slice_in_dim(probs_flat, start, n_own)
+
+            out = dispatch_local(
+                x_flat, ids_flat, probs_flat, gate_w, up_w, down_w
+            )
+
+            if dup > 1:
+                # restore the full local block (and with it, replication
+                # over the non-token ep axes the out_spec declares)
+                out = lax.all_gather(out, dup_axes, axis=0, tiled=True)
+            return out.reshape(b_loc, t_loc, d)
+
         out = jax.shard_map(
             ep_body,
             mesh=mesh,
-            in_specs=(
-                P(ep_axes, None),
-                P(ep_axes, None),
-                P(ep_axes, None),
-                P(ep_axes, None, None),
-                P(ep_axes, None, None),
-                P(ep_axes, None, None),
-            ),
-            out_specs=P(ep_axes, None),
-            axis_names=set(ep_axes),
-        )(
-            x,
-            topk_ids,
-            topk_probs,
-            self.grouped_experts.gate_weight,
-            self.grouped_experts.up_weight,
-            self.grouped_experts.down_weight,
-        )
-        return out.astype(x.dtype)
+            in_specs=(tok_spec,) * 3 + (P(ep_axes, None, None),) * 3,
+            out_specs=tok_spec,
+            # the tiled all_gather over dup_axes makes the output invariant
+            # there, which vma inference cannot see statically
+            check_vma=False,
+        )(hidden, topk_ids, topk_probs, *expert_weights())
+        return out.astype(hidden.dtype)

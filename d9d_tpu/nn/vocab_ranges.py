@@ -27,11 +27,14 @@ def make_vocab_range_params(
     param_dtype: jnp.dtype,
     initializer: nn.initializers.Initializer,
 ) -> list[Array]:
-    """Create one [size, hidden] param per named range, logical (vocab, embed)."""
+    """Create one [size, hidden] param per named range, logical
+    (vocab, vocab_features)."""
     return [
         param_fn(
             f"{prefix}_{name}",
-            nn.with_logical_partitioning(initializer, (la.VOCAB, la.EMBED)),
+            nn.with_logical_partitioning(
+                initializer, (la.VOCAB, la.VOCAB_FEATURES)
+            ),
             (size, hidden_size),
             param_dtype,
         )

@@ -77,7 +77,19 @@ def fsdp_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
     ]
     if with_tp:
         rules += _tp_rules()
+    rules += _vocab_table_rules(ctx, with_tp=with_tp)
     return ParallelPlan(name="fsdp", rules=tuple(rules))
+
+
+def _vocab_table_rules(
+    ctx: MeshContext, *, with_tp: bool
+) -> list[tuple[str, str | tuple[str, ...] | None]]:
+    """Vocab-range tables (embedding + LM head) ZeRO-3-shard on their vocab
+    dim (fused with tp when active); the feature dim stays unsharded so the
+    lookup output lands directly in the sequence-parallel activation layout
+    instead of fighting it (e@cp vs t@cp forced replicate-reshards)."""
+    vocab_axes = (AXIS_TP,) + ctx.fsdp_axes if with_tp else ctx.fsdp_axes
+    return [(la.VOCAB, vocab_axes), (la.VOCAB_FEATURES, None)]
 
 
 def hsdp_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
@@ -114,4 +126,5 @@ def fsdp_ep_plan(ctx: MeshContext, *, with_tp: bool = False) -> ParallelPlan:
     ]
     if with_tp:
         rules += _tp_rules()
+    rules += _vocab_table_rules(ctx, with_tp=with_tp)
     return ParallelPlan(name="fsdp_ep", rules=tuple(rules))
