@@ -1,3 +1,9 @@
+from d9d_tpu.core.distributed import (
+    DistributedConfig,
+    init_distributed,
+    resolve_distributed_config,
+    shutdown_distributed,
+)
 from d9d_tpu.core.mesh import (
     AXIS_CP_REPLICATE,
     AXIS_CP_SHARD,
@@ -19,6 +25,10 @@ from d9d_tpu.core.tree_sharding import (
 from d9d_tpu.core.types import Array, ArrayTree, CollateFn, PyTree, ScalarTree
 
 __all__ = [
+    "DistributedConfig",
+    "init_distributed",
+    "resolve_distributed_config",
+    "shutdown_distributed",
     "AXIS_CP_REPLICATE",
     "AXIS_CP_SHARD",
     "AXIS_DP_REPLICATE",

@@ -1,0 +1,201 @@
+"""Multi-host process bootstrap.
+
+TPU-native equivalent of the reference's torchrun-env bootstrap
+(reference: d9d/core/dist_context/configured.py:18,67-75 — RANK /
+MASTER_ADDR / WORLD_SIZE → ``init_process_group``). Here the controller is
+``jax.distributed.initialize``: every host in a pod slice starts the same
+script, connects to the coordinator, and from then on ``jax.devices()``
+spans the whole slice, so ``MeshParameters.build()`` produces a
+process-spanning mesh with zero further changes.
+
+Configuration resolution order (first hit wins):
+
+1. explicit keyword arguments;
+2. ``D9D_COORDINATOR`` / ``D9D_NUM_PROCESSES`` / ``D9D_PROCESS_ID`` env
+   vars (this framework's own channel);
+3. torchrun-style ``MASTER_ADDR`` / ``MASTER_PORT`` / ``WORLD_SIZE`` /
+   ``RANK`` env vars (drop-in parity with the reference's launch story);
+4. nothing → on Cloud TPU pod slices ``jax.distributed.initialize()``'s
+   own auto-detection (TPU metadata); elsewhere a single-process no-op.
+
+The call is idempotent and a no-op for single-process runs, so library
+code and examples can call it unconditionally.
+
+Pod launch story (documented for parity with the reference's torchrun
+docs): start the identical script on every host of the slice —
+
+    # Cloud TPU (GKE / queued resources): auto-detected, no env needed
+    python pretrain.py --config config.json
+
+    # explicit coordinator (e.g. on-prem, DCN-connected slices):
+    D9D_COORDINATOR=host0:8476 D9D_NUM_PROCESSES=16 D9D_PROCESS_ID=$i \
+        python pretrain.py --config config.json
+
+after which ``init_distributed()`` + ``MeshParameters(...).build()`` give
+every process the same global mesh and each host feeds its local shard of
+the batch (the data loader shards by ``jax.process_index()``).
+"""
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("d9d_tpu.distributed")
+
+_initialized = False
+_owns_runtime = False
+
+
+def _runtime_already_up() -> bool:
+    """True when a distributed client already exists (launcher/test harness
+    called ``jax.distributed.initialize`` before us).
+
+    Deliberately avoids ``jax.process_count()``/``jax.devices()``: those
+    initialize the XLA backend, after which ``jax.distributed.initialize``
+    refuses to run — the exact multi-host path this module exists for.
+    """
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:  # pragma: no cover - private-API drift fallback
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Resolved bootstrap parameters (None = leave to jax auto-detection)."""
+
+    coordinator_address: Optional[str]
+    num_processes: Optional[int]
+    process_id: Optional[int]
+
+    @property
+    def is_explicit(self) -> bool:
+        return self.coordinator_address is not None
+
+    @property
+    def is_single_process(self) -> bool:
+        return self.num_processes == 1
+
+
+def resolve_distributed_config(
+    env: Optional[dict] = None,
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> DistributedConfig:
+    """Pure resolution of the bootstrap parameters (unit-testable)."""
+    env = os.environ if env is None else env
+
+    if coordinator_address is None:
+        coordinator_address = env.get("D9D_COORDINATOR")
+    if num_processes is None and "D9D_NUM_PROCESSES" in env:
+        num_processes = int(env["D9D_NUM_PROCESSES"])
+    if process_id is None and "D9D_PROCESS_ID" in env:
+        process_id = int(env["D9D_PROCESS_ID"])
+
+    # torchrun-style channel (reference configured.py:18: MASTER_ADDR/RANK)
+    if coordinator_address is None and "MASTER_ADDR" in env:
+        port = env.get("MASTER_PORT", "8476")
+        coordinator_address = f"{env['MASTER_ADDR']}:{port}"
+        if num_processes is None and "WORLD_SIZE" in env:
+            num_processes = int(env["WORLD_SIZE"])
+        if process_id is None and "RANK" in env:
+            process_id = int(env["RANK"])
+
+    return DistributedConfig(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def init_distributed(
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    initialization_timeout_s: int = 300,
+) -> bool:
+    """Initialize the multi-host runtime if the environment calls for it.
+
+    Returns True when ``jax.distributed.initialize`` was invoked, False on
+    the single-process / already-initialized no-op paths. Idempotent.
+
+    Matches the reference's two-phase timeout intent
+    (configured.py:126-144): the generous ``initialization_timeout_s``
+    gates the coordinator handshake; per-step hang detection is the
+    TimeoutManager's job (loop/components/timeout_manager.py).
+    """
+    global _initialized, _owns_runtime
+    if _initialized:
+        return False
+    if _runtime_already_up():
+        # someone else (launcher, test harness) already initialized
+        _initialized = True
+        return False
+
+    cfg = resolve_distributed_config(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    if cfg.is_single_process:
+        logger.info("init_distributed: single process, no-op")
+        _initialized = True
+        return False
+
+    if not cfg.is_explicit:
+        # No explicit coordinator. On Cloud TPU pods jax auto-detects from
+        # the TPU metadata; elsewhere there is nothing to do.
+        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+            "MEGASCALE_COORDINATOR_ADDRESS"
+        ):
+            jax.distributed.initialize(
+                initialization_timeout=initialization_timeout_s
+            )
+            _initialized = True
+            _owns_runtime = True
+            logger.info(
+                "init_distributed: TPU auto-detect, process %d/%d",
+                jax.process_index(),
+                jax.process_count(),
+            )
+            return True
+        logger.info(
+            "init_distributed: no coordinator configured, single-process"
+        )
+        _initialized = True
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+        initialization_timeout=initialization_timeout_s,
+    )
+    _initialized = True
+    _owns_runtime = True
+    logger.info(
+        "init_distributed: coordinator %s, process %d/%d",
+        cfg.coordinator_address,
+        jax.process_index(),
+        jax.process_count(),
+    )
+    return True
+
+
+def shutdown_distributed() -> None:
+    """Tear down the runtime — only if this module started it (an
+    externally-initialized runtime belongs to the launcher)."""
+    global _initialized, _owns_runtime
+    if _owns_runtime:
+        jax.distributed.shutdown()
+    _initialized = False
+    _owns_runtime = False

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import pydantic
 
-from d9d_tpu.core import MeshParameters
+from d9d_tpu.core import MeshParameters, init_distributed
 from d9d_tpu.dataset import BufferSortedDataset, pad_stack_1d
 from d9d_tpu.loop import (
     CausalLMTask,
@@ -235,11 +235,17 @@ def main(config_path: str) -> None:
     raw = json.loads(Path(config_path).read_text())
     cfg = ProjectConfig.model_validate(raw)
 
+    # Multi-host pod bootstrap: no-op on a single host; on a pod slice
+    # every host runs this same script (see d9d_tpu/core/distributed.py
+    # for the launch story) and jax.devices() then spans the slice.
+    init_distributed()
+
     mesh_params = MeshParameters(**cfg.mesh.model_dump())
     ctx = mesh_params.build()
     print(
         f"mesh: {dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))} "
-        f"on {jax.device_count()} devices"
+        f"on {jax.device_count()} devices "
+        f"(process {jax.process_index()}/{jax.process_count()})"
     )
 
     lr = build_lr_schedule(cfg.lr_scheduler, total_steps=cfg.trainer.total_steps)
