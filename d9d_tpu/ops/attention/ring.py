@@ -49,12 +49,18 @@ def ring_attention(
     softmax_scale: float | None = None,
     window_size: int | None = None,
     sinks: Array | None = None,
+    q_segments: Array | None = None,
+    kv_segments: Array | None = None,
 ) -> Array:
     """Per-shard attention: ``q/k/v [B, T_loc, H(q|kv), D]`` → ``[B, T_loc, Hq, D]``.
 
     Call inside ``shard_map`` with the sequence dim sharded over
     ``axis_name``. Semantics match :func:`eager_sdpa` on the gathered
-    sequence (GQA broadcast, causal, sliding window, learnable sinks).
+    sequence (GQA broadcast, causal, sliding window, learnable sinks,
+    packed segments). ``q_segments``/``kv_segments`` are this shard's
+    ``[B, T_loc]`` slices of the global packed-sequence ids; the kv slice
+    rotates around the ring alongside its K/V block and cross-segment
+    pairs are masked out of the online softmax.
     """
     b, t_loc, hq, d = q.shape
     _, s_loc, hkv, dv = v.shape
@@ -76,7 +82,7 @@ def ring_attention(
     perm = [(r, (r + 1) % cp) for r in range(cp)]
 
     def step(carry, s):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, kseg_blk = carry
         src = (my_idx - s) % cp
         k_pos = src * t_loc + jnp.arange(t_loc)
 
@@ -88,6 +94,12 @@ def ring_attention(
             logits = jnp.where(kp <= qp, logits, neg)
         if window_size is not None:
             logits = jnp.where(kp > qp - window_size, logits, neg)
+        if kseg_blk is not None:
+            same = (
+                q_segments[:, None, None, :, None]
+                == kseg_blk[:, None, None, None, :]
+            )
+            logits = jnp.where(same, logits, neg)
 
         blk_max = jnp.max(logits, axis=-1)  # [B,Hkv,G,T]
         new_m = jnp.maximum(m, blk_max)
@@ -100,12 +112,19 @@ def ring_attention(
         l = l * alpha + jnp.sum(p, axis=-1)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (o, new_m, l, k_blk, v_blk), None
+        if kseg_blk is not None:
+            kseg_blk = lax.ppermute(kseg_blk, axis_name, perm)
+        return (o, new_m, l, k_blk, v_blk, kseg_blk), None
+
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments must be provided together")
 
     o0 = jnp.zeros((b, t_loc, hkv, g, dv), jnp.float32)
     m0 = jnp.full((b, hkv, g, t_loc), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hkv, g, t_loc), jnp.float32)
-    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(cp))
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v, kv_segments), jnp.arange(cp)
+    )
 
     if sinks is not None:
         # sink logit joins the global softmax denominator (reference
@@ -138,6 +157,7 @@ def make_ring_sdpa(
     """
     qkv_spec = P(tuple(batch_axes), seq_axis, tuple(head_axes), None)
     sink_spec = P(tuple(head_axes))
+    seg_spec = P(tuple(batch_axes), seq_axis)
 
     def ring_sdpa(
         q: Array,
@@ -157,10 +177,9 @@ def make_ring_sdpa(
                 "ring attention does not support arbitrary masks; use the "
                 "eager/flash backends or express the mask as causal+window"
             )
-        if q_segments is not None or kv_segments is not None:
-            raise NotImplementedError(
-                "ring attention does not support packed segment ids yet; "
-                "use the flash/eager backends for packed batches"
+        if (q_segments is None) != (kv_segments is None):
+            raise ValueError(
+                "q_segments and kv_segments must be provided together"
             )
 
         # validate divisibility up front: without this, a mis-sized input
@@ -200,8 +219,15 @@ def make_ring_sdpa(
         q, k, v = (lax.with_sharding_constraint(x, qkv_spec) for x in (q, k, v))
 
         has_sinks = sinks is not None
-        in_specs = (qkv_spec,) * 3 + ((sink_spec,) if has_sinks else ())
-        args = (q, k, v) + ((sinks,) if has_sinks else ())
+        has_segs = q_segments is not None
+        in_specs = (qkv_spec,) * 3
+        args = (q, k, v)
+        if has_sinks:
+            in_specs += (sink_spec,)
+            args += (sinks,)
+        if has_segs:
+            in_specs += (seg_spec, seg_spec)
+            args += (q_segments, kv_segments)
 
         @functools.partial(
             jax.shard_map,
@@ -211,10 +237,14 @@ def make_ring_sdpa(
             check_vma=False,
         )
         def run(q, k, v, *rest):
+            rest = list(rest)
+            s = rest.pop(0) if has_sinks else None
+            qseg = rest.pop(0) if has_segs else None
+            kseg = rest.pop(0) if has_segs else None
             return ring_attention(
                 q, k, v, axis_name=seq_axis, causal=causal,
                 softmax_scale=softmax_scale, window_size=window_size,
-                sinks=rest[0] if rest else None,
+                sinks=s, q_segments=qseg, kv_segments=kseg,
             )
 
         return run(*args)
