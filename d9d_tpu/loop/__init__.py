@@ -12,8 +12,13 @@ from d9d_tpu.loop.control.providers import (
     ModelProvider,
     OptimizerProvider,
 )
-from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.loop.event import EventBus
+from d9d_tpu.loop.inference import (
+    Inference,
+    InferenceTask,
+    PipelineInferenceTask,
+)
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.loop.tasks import (
     CausalLMTask,
@@ -25,6 +30,10 @@ from d9d_tpu.loop.train_step import build_train_step
 
 __all__ = [
     "BatchMaths",
+    "Inference",
+    "InferenceTask",
+    "PipelineInferenceTask",
+    "PipelineTrainTask",
     "StateCheckpointer",
     "StatefulDataLoader",
     "default_collate",

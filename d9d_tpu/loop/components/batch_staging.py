@@ -19,6 +19,26 @@ from d9d_tpu.core.mesh import MeshContext
 from d9d_tpu.core.types import PyTree
 
 
+def split_microbatches(
+    prepared: PyTree, *, num_microbatches: int, microbatch_size: int
+) -> list[PyTree]:
+    """Host-side: cut a prepared global batch into a microbatch list (the
+    pipeline executor places each carry/kwargs/state on its stage's
+    submesh, so no device_put happens here)."""
+    n, m = num_microbatches, microbatch_size
+
+    def cut(x):
+        x = np.asarray(x)
+        if x.shape[0] != n * m:
+            raise ValueError(
+                f"batch leading dim {x.shape[0]} != global batch {n * m}"
+            )
+        return x.reshape(n, m, *x.shape[1:])
+
+    stacked = jax.tree.map(cut, prepared)
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
 def make_batch_stager(
     ctx: MeshContext,
     *,

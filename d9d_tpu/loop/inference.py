@@ -21,7 +21,10 @@ from jax import lax
 from d9d_tpu.core.mesh import MeshContext
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop import event as ev
-from d9d_tpu.loop.components.batch_staging import make_batch_stager
+from d9d_tpu.loop.components.batch_staging import (
+    make_batch_stager,
+    split_microbatches,
+)
 from d9d_tpu.loop.config import InferenceConfig
 from d9d_tpu.loop.control.providers import DatasetProvider, ModelProvider
 from d9d_tpu.loop.event import EventBus
@@ -47,6 +50,57 @@ class InferenceTask(abc.ABC):
     @abc.abstractmethod
     def process_outputs(self, outputs: PyTree) -> Any:
         """Host-side, per batch: consume forward outputs (already on host)."""
+
+
+class PipelineInferenceTask(InferenceTask):
+    """An InferenceTask that can also drive a forward-only pipeline
+    program (reference loop/run/inference.py:55,176 wiring the inference
+    schedule from pipelining/factory/config.py:6-78).
+
+    Mirrors PipelineTrainTask's stage decomposition, with
+    ``last_stage_outputs`` in place of the loss: the executor returns its
+    value per microbatch and the loop hands the host copy to
+    ``process_outputs``.
+    """
+
+    @abc.abstractmethod
+    def sample_microbatch(self, microbatch_size: int, seq_len: int) -> PyTree:
+        """Zero-filled microbatch matching ``prepare_batch``'s output."""
+
+    @abc.abstractmethod
+    def split_microbatch(
+        self, microbatch: PyTree
+    ) -> tuple[PyTree, PyTree, PyTree]:
+        """→ (first_stage_carry, per_stage_kwargs, last_stage_state)."""
+
+    @abc.abstractmethod
+    def stage_forward(
+        self, module: nn.Module, params: PyTree, carry: PyTree, kwargs: PyTree
+    ) -> PyTree:
+        """Non-last stage: carry in → carry out."""
+
+    @abc.abstractmethod
+    def last_stage_outputs(
+        self,
+        module: nn.Module,
+        params: PyTree,
+        carry: PyTree,
+        kwargs: PyTree,
+        state: PyTree,
+    ) -> PyTree:
+        """Last stage: → output pytree for this microbatch."""
+
+    @abc.abstractmethod
+    def stage_init(
+        self,
+        module: nn.Module,
+        rng: Array,
+        carry: PyTree,
+        kwargs: PyTree,
+        state: PyTree,
+        is_last: bool,
+    ) -> PyTree:
+        """Initialize one stage's variables."""
 
 
 class Inference:
@@ -82,47 +136,94 @@ class Inference:
             )
         self.num_microbatches = config.batch_size // self.microbatch_size
 
-        self.module = model_provider.build_module(PipelineStageInfo())
-        plan = model_provider.build_plan(ctx)
         rng = jax.random.PRNGKey(config.seed)
         self.init_rng, self.step_rng = jax.random.split(rng)
-        if params is not None:
-            self.params = params
+        self.pp_engine = None
+        self.module = None
+        self._forward = None
+        self._stage = None
+
+        if ctx.pp_size > 1:
+            if not isinstance(task, PipelineInferenceTask):
+                raise TypeError(
+                    "pipeline-parallel inference needs a "
+                    "PipelineInferenceTask (the task defines the stage "
+                    f"carry decomposition); got {type(task).__name__}"
+                )
+            from d9d_tpu.loop.pipeline_driver import PipelineInferenceEngine
+
+            self.pp_engine = PipelineInferenceEngine(
+                ctx=ctx,
+                model_provider=model_provider,
+                task=task,
+                num_microbatches=self.num_microbatches,
+                microbatch_size=self.microbatch_size,
+                seq_len=config.seq_len,
+                init_rng=self.init_rng,
+                stage_params=params,
+            )
         else:
-            sample = model_provider.sample_inputs(
-                self.microbatch_size, config.seq_len
+            self.module = model_provider.build_module(PipelineStageInfo())
+            plan = model_provider.build_plan(ctx)
+            if params is not None:
+                self.params = params
+            else:
+                sample = model_provider.sample_inputs(
+                    self.microbatch_size, config.seq_len
+                )
+                self.params, _ = init_sharded_params(
+                    self.module, sample, self.init_rng, ctx, plan
+                )
+
+            n_mb = self.num_microbatches
+            task_fwd = task.forward_fn
+            module = self.module
+
+            def forward(params, batch, rng):
+                def body(_, mb_and_idx):
+                    mb, idx = mb_and_idx
+                    out = task_fwd(
+                        module, params, mb, jax.random.fold_in(rng, idx)
+                    )
+                    return None, out
+
+                _, outs = lax.scan(
+                    body, None, (batch, jax.numpy.arange(n_mb))
+                )
+                return outs  # leading dims [n_mb, mb, ...]
+
+            self._forward = jax.jit(forward)
+            self._stage = make_batch_stager(
+                ctx,
+                num_microbatches=self.num_microbatches,
+                microbatch_size=self.microbatch_size,
+                seq_len=config.seq_len,
             )
-            self.params, _ = init_sharded_params(
-                self.module, sample, self.init_rng, ctx, plan
-            )
-
-        n_mb = self.num_microbatches
-        task_fwd = task.forward_fn
-        module = self.module
-
-        def forward(params, batch, rng):
-            def body(_, mb_and_idx):
-                mb, idx = mb_and_idx
-                out = task_fwd(module, params, mb, jax.random.fold_in(rng, idx))
-                return None, out
-
-            _, outs = lax.scan(
-                body, None, (batch, jax.numpy.arange(n_mb))
-            )
-            return outs  # leading dims [n_mb, mb, ...]
-
-        self._forward = jax.jit(forward)
-        self._stage = make_batch_stager(
-            ctx,
-            num_microbatches=self.num_microbatches,
-            microbatch_size=self.microbatch_size,
-            seq_len=config.seq_len,
-        )
         self.dataset_provider = dataset_provider
         self.events.emit(ev.EVENT_INFER_READY, inference=self)
 
     def _stage_batch(self, raw: PyTree) -> PyTree:
-        return self._stage(self.task.prepare_batch(raw))
+        prepared = self.task.prepare_batch(raw)
+        if self.pp_engine is None:
+            return self._stage(prepared)
+        return split_microbatches(
+            prepared,
+            num_microbatches=self.num_microbatches,
+            microbatch_size=self.microbatch_size,
+        )
+
+    def _forward_batch(self, batch: PyTree, rng) -> PyTree:
+        """→ host outputs with leading dim = batch size."""
+        if self.pp_engine is not None:
+            outs = self.pp_engine.forward(batch)  # list per microbatch
+            host = [jax.tree.map(np.asarray, o) for o in outs]
+            return jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *host
+            )
+        outs = self._forward(self.params, batch, rng)
+        return jax.tree.map(
+            lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), outs
+        )
 
     def infer(self) -> list[Any]:
         """Run the whole dataset; returns task.process_outputs results."""
@@ -132,11 +233,7 @@ class Inference:
             with self.events.bounded(ev.EVENT_INFER_BATCH, inference=self, index=i):
                 batch = self._stage_batch(raw)
                 rng = jax.random.fold_in(self.step_rng, i)
-                outs = self._forward(self.params, batch, rng)
-                # merge microbatch dim back and bring to host for the task
-                host = jax.tree.map(
-                    lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), outs
-                )
+                host = self._forward_batch(batch, rng)
                 results.append(self.task.process_outputs(host))
             if (i + 1) % self.config.log_every == 0:
                 logger.info(

@@ -64,6 +64,86 @@ def _deep_merge(trees: list[PyTree]) -> PyTree:
     return out
 
 
+def build_pipeline_stages(
+    *,
+    ctx: MeshContext,
+    builder,
+    model_provider: ModelProvider,
+    task,
+    microbatch_size: int,
+    seq_len: int,
+    init_rng: jax.Array,
+    grad_dtype=jnp.float32,
+    residual_policy: str = "remat",
+    stage_params: dict[int, PyTree] | None = None,
+) -> dict[int, PipelineStageRuntime]:
+    """Per-stage modules/params/runtimes over the pp submeshes (shared by
+    the train and inference engines).
+
+    ``stage_params`` supplies pre-built parameter trees (checkpoint
+    scoring, trainer hand-off) — those stages skip the sharded random
+    init entirely."""
+    num_stages = builder.num_stages
+    stage_owner = builder.stage_owner
+    plan = model_provider.build_plan(ctx)
+    sample_mb = task.sample_microbatch(microbatch_size, seq_len)
+    carry, kwargs_s, state_s = task.split_microbatch(sample_mb)
+    carry_sdt = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        carry,
+    )
+
+    stages: dict[int, PipelineStageRuntime] = {}
+    for s in range(num_stages):
+        info = PipelineStageInfo(stage_index=s, num_stages=num_stages)
+        module = model_provider.build_module(info)
+        submesh = ctx.stage_mesh(stage_owner[s])
+        # commit the stage's init key to its submesh: keys minted under
+        # the ambient full mesh carry that mesh in their sharding type
+        # and would poison the submesh-scoped init jit
+        rng_s = jax.device_put(
+            jax.random.fold_in(init_rng, s), NamedSharding(submesh, P())
+        )
+        carry_zero = _zeros_like_sdt(carry_sdt)
+
+        def raw_init(
+            module=module, rng=rng_s, carry=carry_zero, last=info.is_last
+        ):
+            return task.stage_init(module, rng, carry, kwargs_s, state_s, last)
+
+        if stage_params is not None and s in stage_params:
+            params = stage_params[s]
+        else:
+            with jax.set_mesh(submesh):
+                params, _ = init_sharded_from_fn(raw_init, submesh, plan)
+
+        data_spec = P(ctx.batch_axes, ctx.sequence_axes)
+        stages[s] = PipelineStageRuntime(
+            info=info,
+            module=module,
+            params=params,
+            task=task,
+            carry_sharding=NamedSharding(submesh, data_spec),
+            kwargs_sharding=NamedSharding(submesh, data_spec),
+            state_sharding=NamedSharding(submesh, data_spec),
+            grad_dtype=grad_dtype,
+            mesh=submesh,
+            residual_policy=residual_policy,
+        )
+
+        if not info.is_last:
+            # chain shapes: this stage's output is the next stage's carry
+            carry_sdt = jax.eval_shape(
+                lambda p, c, kw, module=module: task.stage_forward(
+                    module, p, c, kw
+                ),
+                params,
+                carry_sdt,
+                kwargs_s,
+            )
+    return stages
+
+
 class PipelineTrainEngine:
     """Owns stages, program, executor, and per-stage optimizer state."""
 
@@ -80,6 +160,7 @@ class PipelineTrainEngine:
         init_rng: jax.Array,
         max_grad_norm: float | None = 1.0,
         grad_dtype=jnp.float32,
+        peft_method=None,
     ):
         if not isinstance(task, PipelineTrainTask):
             raise TypeError(
@@ -89,6 +170,7 @@ class PipelineTrainEngine:
             )
         self.ctx = ctx
         self.task = task
+        self.peft_method = peft_method
         self.num_microbatches = batch_maths.num_microbatches
 
         builder = build_program_builder(
@@ -97,63 +179,40 @@ class PipelineTrainEngine:
         )
         self.num_stages = builder.num_stages
         self.stage_owner = builder.stage_owner
+        self._style = builder.style
 
-        plan = model_provider.build_plan(ctx)
-        sample_mb = task.sample_microbatch(
-            batch_maths.microbatch_size, seq_len
-        )
-        carry, kwargs_s, state_s = task.split_microbatch(sample_mb)
-        carry_sdt = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
-            carry,
-        )
-
-        self.stages: dict[int, PipelineStageRuntime] = {}
-        for s in range(self.num_stages):
-            info = PipelineStageInfo(stage_index=s, num_stages=self.num_stages)
-            module = model_provider.build_module(info)
-            submesh = ctx.stage_mesh(self.stage_owner[s])
-            # commit the stage's init key to its submesh: keys minted under
-            # the ambient full mesh carry that mesh in their sharding type
-            # and would poison the submesh-scoped init jit
-            rng_s = jax.device_put(
-                jax.random.fold_in(init_rng, s), NamedSharding(submesh, P())
+        self.stages = build_pipeline_stages(
+            ctx=ctx,
+            builder=builder,
+            model_provider=model_provider,
+            task=task,
+            microbatch_size=batch_maths.microbatch_size,
+            seq_len=seq_len,
+            init_rng=init_rng,
+            grad_dtype=grad_dtype,
+            residual_policy=getattr(
+                schedule, "residual_policy", "remat"
             )
-            carry_zero = _zeros_like_sdt(carry_sdt)
+            if schedule is not None
+            else "remat",
+        )
 
-            def raw_init(
-                module=module, rng=rng_s, carry=carry_zero, last=info.is_last
-            ):
-                return task.stage_init(
-                    module, rng, carry, kwargs_s, state_s, last
+        if peft_method is not None:
+            # per-stage reparameterization: rt.params becomes the stage's
+            # adapter tree, the frozen base closes over the stage task
+            # (reference trainable-predicate PEFT, model_stage_factory.py:25)
+            from d9d_tpu.peft import PeftStageTask
+
+            for s, rt in self.stages.items():
+                submesh = ctx.stage_mesh(self.stage_owner[s])
+                rng_s = jax.device_put(
+                    jax.random.fold_in(init_rng, 10_000 + s),
+                    NamedSharding(submesh, P()),
                 )
-
-            with jax.set_mesh(submesh):
-                params, _ = init_sharded_from_fn(raw_init, submesh, plan)
-
-            data_spec = P(ctx.batch_axes, ctx.sequence_axes)
-            self.stages[s] = PipelineStageRuntime(
-                info=info,
-                module=module,
-                params=params,
-                task=task,
-                carry_sharding=NamedSharding(submesh, data_spec),
-                kwargs_sharding=NamedSharding(submesh, data_spec),
-                state_sharding=NamedSharding(submesh, data_spec),
-                grad_dtype=grad_dtype,
-                mesh=submesh,
-            )
-
-            if not info.is_last:
-                # chain shapes: this stage's output is the next stage's carry
-                carry_sdt = jax.eval_shape(
-                    lambda p, c, kw, module=module: task.stage_forward(
-                        module, p, c, kw
-                    ),
-                    params,
-                    carry_sdt,
-                    kwargs_s,
-                )
+                with jax.set_mesh(submesh):
+                    base, adapters = peft_method.inject(rt.params, rng_s)
+                rt.params = adapters
+                rt.task = PeftStageTask(task, peft_method, base)
 
         program = add_communication_ops(
             builder.compose(self.num_microbatches),
@@ -167,6 +226,7 @@ class PipelineTrainEngine:
             num_microbatches=self.num_microbatches,
             train=True,
         )
+        self._eval_executor = None
         self.optimizer = PipelinedOptimizer(
             optimizer=optimizer,
             scalar_shardings={
@@ -187,6 +247,39 @@ class PipelineTrainEngine:
         )
 
     # ------------------------------------------------------------------
+
+    def eval_loss(self, microbatches: list[PyTree]):
+        """Forward-only pass through an inference program → mean loss.
+
+        Reference parity: loop/run/inference.py:55,176 drives the
+        forward-only schedule from the loop; here the same stages are
+        reused under a lazily-built ``InferenceProgramBuilder`` executor.
+        """
+        if self._eval_executor is None:
+            from d9d_tpu.pipelining.program import InferenceProgramBuilder
+
+            builder = InferenceProgramBuilder(
+                self.ctx.pp_size,
+                stages_per_rank=self.num_stages // self.ctx.pp_size,
+            )
+            # keep the training topology (loop vs V zig-zag) so stage→rank
+            # ownership matches the already-built stages
+            builder.style = self._style
+            program = add_communication_ops(
+                builder.compose(self.num_microbatches),
+                num_stages=self.num_stages,
+                stage_owner=self.stage_owner,
+            )
+            self._eval_executor = PipelineScheduleExecutor(
+                stages=self.stages,
+                program=program,
+                stage_owner=self.stage_owner,
+                num_microbatches=self.num_microbatches,
+                train=False,
+            )
+        result = self._eval_executor.step(microbatches)
+        with jax.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
+            return result.loss_sum / jnp.maximum(result.weight_sum, 1e-8)
 
     def step(self, microbatches: list[PyTree]) -> dict:
         """One optimizer step over the microbatch list → device metrics."""
@@ -224,5 +317,70 @@ class PipelineTrainEngine:
 
     def merged_params(self) -> PyTree:
         """Full model parameter tree (stage trees are key-disjoint by
-        design: layers are named by global id)."""
-        return _deep_merge([rt.params for rt in self.stages.values()])
+        design: layers are named by global id). Under PEFT, adapters are
+        folded into each stage's frozen base first."""
+        if self.peft_method is None:
+            return _deep_merge([rt.params for rt in self.stages.values()])
+        merged = []
+        for rt in self.stages.values():
+            with jax.set_mesh(rt.mesh):
+                merged.append(self.peft_method.merge(rt.task.base, rt.params))
+        return _deep_merge(merged)
+
+
+class PipelineInferenceEngine:
+    """Forward-only pipeline runner for the Inference loop.
+
+    Reference: d9d/loop/run/inference.py:55,176 +
+    pipelining/factory/config.py:6-78's inference schedule — per-stage
+    modules over the pp submeshes, an ``InferenceProgramBuilder`` program,
+    and the executor's eval path returning per-microbatch last-stage
+    outputs.
+    """
+
+    def __init__(
+        self,
+        *,
+        ctx: MeshContext,
+        model_provider: ModelProvider,
+        task,
+        num_microbatches: int,
+        microbatch_size: int,
+        seq_len: int,
+        init_rng: jax.Array,
+        stages_per_rank: int = 1,
+        stage_params: dict[int, PyTree] | None = None,
+    ):
+        from d9d_tpu.pipelining.program import InferenceProgramBuilder
+
+        self.ctx = ctx
+        self.num_microbatches = num_microbatches
+        builder = InferenceProgramBuilder(ctx.pp_size, stages_per_rank)
+        self.num_stages = builder.num_stages
+        self.stage_owner = builder.stage_owner
+        self.stages = build_pipeline_stages(
+            ctx=ctx,
+            builder=builder,
+            model_provider=model_provider,
+            task=task,
+            microbatch_size=microbatch_size,
+            seq_len=seq_len,
+            init_rng=init_rng,
+            stage_params=stage_params,
+        )
+        program = add_communication_ops(
+            builder.compose(num_microbatches),
+            num_stages=self.num_stages,
+            stage_owner=self.stage_owner,
+        )
+        self.executor = PipelineScheduleExecutor(
+            stages=self.stages,
+            program=program,
+            stage_owner=self.stage_owner,
+            num_microbatches=num_microbatches,
+            train=False,
+        )
+
+    def forward(self, microbatches: list[PyTree]) -> list[PyTree]:
+        """→ per-microbatch last-stage outputs (device arrays)."""
+        return self.executor.step(microbatches).outputs

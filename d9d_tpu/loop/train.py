@@ -22,7 +22,10 @@ from d9d_tpu.core.offload import SleepTag, offload_tree, onload_tree
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.loop import event as ev
 from d9d_tpu.loop.components.batch_maths import BatchMaths
-from d9d_tpu.loop.components.batch_staging import make_batch_stager
+from d9d_tpu.loop.components.batch_staging import (
+    make_batch_stager,
+    split_microbatches,
+)
 from d9d_tpu.loop.components.checkpointer import StateCheckpointer
 from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
 from d9d_tpu.loop.components.job_profiler import JobProfiler
@@ -87,11 +90,6 @@ class Trainer:
         )
 
         if ctx.pp_size > 1:
-            if peft_method is not None:
-                raise NotImplementedError(
-                    "PEFT is not yet supported together with pipeline "
-                    "parallelism"
-                )
             from d9d_tpu.loop.pipeline_driver import PipelineTrainEngine
 
             self.pp_engine = PipelineTrainEngine(
@@ -104,6 +102,7 @@ class Trainer:
                 seq_len=config.seq_len,
                 init_rng=self.init_rng,
                 max_grad_norm=config.max_grad_norm,
+                peft_method=peft_method,
             )
             self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
@@ -191,19 +190,11 @@ class Trainer:
         return self._stage(prepared)
 
     def _split_microbatches(self, prepared: PyTree) -> list[PyTree]:
-        n = self.batch_maths.num_microbatches
-        m = self.batch_maths.microbatch_size
-
-        def cut(x):
-            x = np.asarray(x)
-            if x.shape[0] != n * m:
-                raise ValueError(
-                    f"batch leading dim {x.shape[0]} != global batch {n * m}"
-                )
-            return x.reshape(n, m, *x.shape[1:])
-
-        stacked = jax.tree.map(cut, prepared)
-        return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+        return split_microbatches(
+            prepared,
+            num_microbatches=self.batch_maths.num_microbatches,
+            microbatch_size=self.batch_maths.microbatch_size,
+        )
 
     def run_step(self, raw_batch: PyTree) -> dict:
         """Public single-step API: stage ``raw_batch``, run one optimizer
@@ -431,10 +422,8 @@ class Trainer:
 
     def loss_on_batch(self, raw_batch: PyTree) -> float:
         if self.pp_engine is not None:
-            raise NotImplementedError(
-                "loss_on_batch under pipeline parallelism: use the "
-                "InferenceLoop with an inference schedule instead"
-            )
+            # forward-only pipeline program over the same stages
+            return float(self.pp_engine.eval_loss(self._stage_batch(raw_batch)))
         if self._eval_fn is None:
             self._eval_fn = build_eval_step(
                 module=self.module,

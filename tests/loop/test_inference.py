@@ -14,7 +14,11 @@ from d9d_tpu.loop import (
     Trainer,
     TrainerConfig,
 )
-from d9d_tpu.loop.inference import Inference, InferenceTask
+from d9d_tpu.loop.inference import (
+    Inference,
+    InferenceTask,
+    PipelineInferenceTask,
+)
 from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
 from d9d_tpu.nn.sdpa import build_sdpa_backend
 from d9d_tpu.ops import LM_IGNORE_INDEX
@@ -128,3 +132,105 @@ def test_inference_with_trainer_params_consistent(devices, tmp_path):
     eval_loss = trainer.loss_on_batch(raw)
     # trainer loss is token-weighted; all sequences have equal token counts
     np.testing.assert_allclose(np.mean(scores), eval_loss, rtol=1e-5)
+
+
+class _StagedProvider(ModelProvider):
+    """Stage-aware variant of _Provider (same 2-layer dense config)."""
+
+    def build_module(self, stage):
+        return Qwen3DenseCausalLM(
+            config=Qwen3DenseConfig(
+                vocab_ranges=(("default", VOCAB),),
+                hidden_size=32,
+                num_layers=2,
+                num_heads=2,
+                num_kv_heads=2,
+                head_dim=16,
+                intermediate_size=64,
+                remat=False,
+            ),
+            sdpa=build_sdpa_backend(),
+            stage=stage,
+            dtype=jnp.float32,
+        )
+
+    def build_plan(self, c):
+        return fsdp_ep_plan(c)
+
+    def sample_inputs(self, b, t):
+        z = jnp.zeros((b, t), jnp.int32)
+        return (z, z, z)
+
+
+class _PipelineScoreTask(CausalLMTask, PipelineInferenceTask):
+    """CausalLM stage decomposition + per-sequence NLL outputs."""
+
+    def forward_fn(self, module, params, mb, rng):
+        per_token = module.apply(
+            params, mb["tokens"], mb["positions"], mb["labels"]
+        )
+        valid = (mb["labels"] != LM_IGNORE_INDEX).astype(jnp.float32)
+        return {"nll": per_token.sum(-1) / jnp.maximum(valid.sum(-1), 1.0)}
+
+    def last_stage_outputs(self, module, params, carry, kwargs, state):
+        per_token = module.apply(
+            params, carry, kwargs["positions"], state["labels"]
+        )
+        valid = (state["labels"] != LM_IGNORE_INDEX).astype(jnp.float32)
+        return {"nll": per_token.sum(-1) / jnp.maximum(valid.sum(-1), 1.0)}
+
+    def process_outputs(self, outputs):
+        return outputs["nll"].tolist()
+
+
+def test_pipeline_inference_matches_single_program(devices):
+    """pp=2 forward-only program == single-program scores on the same
+    weights (VERDICT r2 item 6), and Trainer.loss_on_batch works under PP
+    via the same inference program."""
+    ctx_pp = MeshParameters(pp=2, dp_shard=4).build(devices)
+    trainer = Trainer(
+        ctx=ctx_pp,
+        config=TrainerConfig(
+            global_batch_size=8, microbatch_size=4, seq_len=16,
+            total_steps=1, log_every=1, gc_every_steps=None,
+        ),
+        model_provider=_StagedProvider(),
+        dataset_provider=_Data(n_batches=1),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    trainer.train()
+
+    data = _Data(n_batches=2)
+    inf_pp = Inference(
+        ctx=ctx_pp,
+        config=InferenceConfig(batch_size=8, seq_len=16),
+        model_provider=_StagedProvider(),
+        dataset_provider=data,
+        task=_PipelineScoreTask(),
+        params={s: rt.params for s, rt in trainer.pp_engine.stages.items()},
+        microbatch_size=4,
+    )
+    scores_pp = inf_pp.infer()
+
+    # single-program on the merged weights, dp-only mesh
+    ctx_single = MeshParameters(dp_shard=4).build(devices[:4])
+    inf_single = Inference(
+        ctx=ctx_single,
+        config=InferenceConfig(batch_size=8, seq_len=16),
+        model_provider=_Provider(),
+        dataset_provider=data,
+        task=_ScoreTask(),
+        params=jax.tree.map(np.asarray, trainer.merged_params()),
+        microbatch_size=4,
+    )
+    scores_single = inf_single.infer()
+
+    assert len(scores_pp) == len(scores_single) == 2
+    for sp, ss in zip(scores_pp, scores_single):
+        np.testing.assert_allclose(sp, ss, rtol=2e-5, atol=2e-5)
+
+    # loss_on_batch under PP: weighted mean of the same per-token losses
+    raw = next(iter(data.build()))
+    pp_loss = trainer.loss_on_batch(raw)
+    np.testing.assert_allclose(np.mean(scores_pp[0]), pp_loss, rtol=1e-5)
