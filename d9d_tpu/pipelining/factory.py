@@ -35,6 +35,7 @@ __all__ = [
 
 class GPipeScheduleConfig(pydantic.BaseModel):
     kind: Literal["gpipe"] = "gpipe"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
 
 
 class InferenceScheduleConfig(pydantic.BaseModel):
@@ -44,25 +45,30 @@ class InferenceScheduleConfig(pydantic.BaseModel):
 
 class LoopedBFSScheduleConfig(pydantic.BaseModel):
     kind: Literal["looped_bfs"] = "looped_bfs"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
     stages_per_rank: int = 1
 
 
 class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
     kind: Literal["interleaved_1f1b"] = "interleaved_1f1b"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
     stages_per_rank: int = 1
 
 
 class ZeroBubble1PScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_1p"] = "zero_bubble_1p"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
     stages_per_rank: int = 1
 
 
 class ZeroBubbleVScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_v"] = "zero_bubble_v"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
 
 
 class DualPipeVScheduleConfig(pydantic.BaseModel):
     kind: Literal["dual_pipe_v"] = "dual_pipe_v"
+    residual_policy: Literal["remat", "cache_full"] = "remat"
 
 
 PipelineScheduleConfig = Annotated[

@@ -123,6 +123,8 @@ class PipelineScheduleExecutor:
         loss_sum = weight_sum = None
         metrics_sum: dict[str, Any] = {}
         outputs: list[PyTree | None] = [None] * self.num_microbatches
+        # (stage, mb) whose weight grads were already produced at the I slot
+        weight_done: set[tuple[int, int]] = set()
 
         def stage_kwargs(s: int, mb: int) -> PyTree:
             if (s, mb) not in kwargs_d:
@@ -179,9 +181,14 @@ class PipelineScheduleExecutor:
                 kw = stage_kwargs(s, mb)
                 if stage.info.is_last:
                     if not self.train:
-                        aux = stage.forward_loss(carry, kw, states[mb])
-                        add_loss(aux)
-                        outputs[mb] = aux
+                        if stage.has_output_fn:
+                            outputs[mb] = stage.forward_outputs(
+                                carry, kw, states[mb]
+                            )
+                        else:
+                            aux = stage.forward_loss(carry, kw, states[mb])
+                            add_loss(aux)
+                            outputs[mb] = aux
                         inputs.pop((s, mb), None)
                     # train: forward is folded into the backward's
                     # value_and_grad (remat), nothing to run here
@@ -207,6 +214,21 @@ class PipelineScheduleExecutor:
                 add_grads(s, gp)
                 route_input_grad(s, mb, gc)
             elif isinstance(action, BackwardInput):
+                if stage.residual_policy == "cache_full":
+                    # fused backward at the I slot: weight grads accumulate
+                    # now, the deferred BackwardWeight becomes a no-op
+                    cot = None if stage.info.is_last else cots.pop((s, mb), None)
+                    state = states.get(mb) if stage.info.is_last else None
+                    gp, gc, aux = stage.backward_full(
+                        inputs.pop((s, mb)), stage_kwargs(s, mb), cot, state
+                    )
+                    kwargs_d.pop((s, mb), None)
+                    if aux is not None:
+                        add_loss(aux)
+                    add_grads(s, gp)
+                    route_input_grad(s, mb, gc)
+                    weight_done.add((s, mb))
+                    return
                 cot = None if stage.info.is_last else cots.get((s, mb))
                 state = states.get(mb) if stage.info.is_last else None
                 gc, aux = stage.backward_input(
@@ -218,6 +240,9 @@ class PipelineScheduleExecutor:
                     route_input_grad(s, mb, gc)
                 # inputs/cot stay alive for the deferred weight backward
             elif isinstance(action, BackwardWeight):
+                if (s, mb) in weight_done:
+                    weight_done.discard((s, mb))
+                    return
                 kw = stage_kwargs(s, mb)
                 cot = None if stage.info.is_last else cots.pop((s, mb), None)
                 state = states.get(mb) if stage.info.is_last else None

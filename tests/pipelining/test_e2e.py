@@ -59,7 +59,7 @@ class TinyTask:
         return loss_sum, weight, {"examples": weight}
 
 
-def make_stages(num_stages, key):
+def make_stages(num_stages, key, residual_policy="remat"):
     """Build per-stage modules+params and the composed baseline function."""
     task = TinyTask()
     stages = {}
@@ -70,7 +70,8 @@ def make_stages(num_stages, key):
         key, sub = jax.random.split(key)
         params = module.init(sub, jnp.zeros((1, HID)))
         stages[s] = PipelineStageRuntime(
-            info=info, module=module, params=params, task=task
+            info=info, module=module, params=params, task=task,
+            residual_policy=residual_policy,
         )
         all_params.append(params)
     return stages, all_params, task
@@ -110,8 +111,11 @@ def make_microbatches(m, key, mb_size=4):
     return out
 
 
-def run_schedule(builder, m, seed=0):
-    stages, all_params, _ = make_stages(builder.num_stages, jax.random.PRNGKey(seed))
+def run_schedule(builder, m, seed=0, residual_policy="remat"):
+    stages, all_params, _ = make_stages(
+        builder.num_stages, jax.random.PRNGKey(seed),
+        residual_policy=residual_policy,
+    )
     program = add_communication_ops(
         builder.compose(m),
         num_stages=builder.num_stages,
@@ -168,9 +172,12 @@ def test_interleaved_1f1b(pp, v, m):
 
 @pytest.mark.parametrize("m", [4, 8])
 @pytest.mark.parametrize("pp", [2, 4])
-def test_zb1p(pp, m):
+@pytest.mark.parametrize("residual_policy", ["remat", "cache_full"])
+def test_zb1p(pp, m, residual_policy):
     b = Interleaved1F1BProgramBuilder(pp, zero_bubble=True)
-    assert_close(*run_schedule(b, m), b.num_stages)
+    assert_close(
+        *run_schedule(b, m, residual_policy=residual_policy), b.num_stages
+    )
 
 
 @pytest.mark.parametrize("m", [1, 4, 6])
@@ -182,9 +189,12 @@ def test_looped_bfs(pp, v, m):
 
 @pytest.mark.parametrize("m", [2, 4, 7])
 @pytest.mark.parametrize("pp", [2, 4])
-def test_zero_bubble_v(pp, m):
+@pytest.mark.parametrize("residual_policy", ["remat", "cache_full"])
+def test_zero_bubble_v(pp, m, residual_policy):
     b = ZeroBubbleVProgramBuilder(pp)
-    assert_close(*run_schedule(b, m), b.num_stages)
+    assert_close(
+        *run_schedule(b, m, residual_policy=residual_policy), b.num_stages
+    )
 
 
 @pytest.mark.parametrize("m", [2, 4, 7])

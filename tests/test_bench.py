@@ -28,3 +28,24 @@ def test_bench_tiny_runs(devices):
     assert result["unit"] == "tokens/s"
     assert "vs_baseline" in result
     assert result["detail"]["mfu"] >= 0
+
+
+def test_bench_pp_tiny_runs(devices):
+    """tools/bench_pp.py (schedule × residual-policy microbench) must keep
+    working against the PipelineTrainEngine API."""
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_pp.py"), "--tiny"],
+        capture_output=True, text=True, timeout=560,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(root)},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    import json as _json
+
+    rows = [_json.loads(l) for l in lines]
+    assert any("winner" in r for r in rows)
+    assert sum("schedule" in r for r in rows) == 3

@@ -1,0 +1,170 @@
+"""Pipeline-schedule microbenchmark: 1F1B vs ZB1P × residual policies.
+
+VERDICT r2 Weak #4/#5: zero-bubble schedules pay forward recomputes for the
+dI/dW split ("remat" policy) or give up the deferred-W bubble filler
+("cache_full"); whether either beats plain 1F1B is an empirical question,
+and the single-controller executor's per-action dispatch cost needs a
+number. This harness runs 2 virtual stages on ONE chip (pp=1,
+stages_per_rank=2 — every schedule's action stream, no cross-chip
+transfers) and measures steady-state optimizer-step time for each
+(schedule, policy) combination.
+
+Run on the TPU chip:  python tools/bench_pp.py
+Smoke on CPU mesh:    JAX_PLATFORMS=cpu python tools/bench_pp.py --tiny
+
+Prints one JSON line per combination plus a "winner" line; BASELINE.md
+records the measured numbers.
+"""
+
+import argparse
+import json
+import time
+
+
+def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.core import MeshParameters
+    from d9d_tpu.loop import CausalLMTask, ModelProvider
+    from d9d_tpu.loop.components.batch_maths import BatchMaths
+    from d9d_tpu.loop.pipeline_driver import PipelineTrainEngine
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM
+    from d9d_tpu.nn.sdpa import build_sdpa_backend
+    from d9d_tpu.parallel import replicate_plan
+
+    class Provider(ModelProvider):
+        def build_module(self, stage):
+            return Qwen3DenseCausalLM(
+                config=cfg, sdpa=build_sdpa_backend(), stage=stage, dtype=dtype
+            )
+
+        def build_plan(self, c):
+            return replicate_plan(c)
+
+        def sample_inputs(self, b, t):
+            z = jnp.zeros((b, t), jnp.int32)
+            return (z, z, z)
+
+    ctx = MeshParameters().build(jax.devices()[:1])
+    import optax
+
+    engine = PipelineTrainEngine(
+        ctx=ctx,
+        schedule=schedule_cfg,
+        model_provider=Provider(),
+        task=CausalLMTask(),
+        optimizer=optax.adamw(1e-4, b1=0.9, b2=0.95),
+        batch_maths=BatchMaths(
+            global_batch_size=batch,
+            microbatch_size=microbatch,
+            dp_size=1,
+        ),
+        seq_len=seq_len,
+        init_rng=jax.random.PRNGKey(0),
+    )
+    return engine
+
+
+def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps):
+    import jax
+    import numpy as np
+
+    from d9d_tpu.loop import CausalLMTask
+    from d9d_tpu.loop.components.batch_staging import split_microbatches
+
+    task = CausalLMTask()
+    rng = np.random.RandomState(0)
+
+    def make_microbatches():
+        prepared = task.prepare_batch(
+            {"input_ids": rng.randint(0, vocab, size=(batch, seq_len + 1))}
+        )
+        return split_microbatches(
+            prepared,
+            num_microbatches=batch // microbatch,
+            microbatch_size=microbatch,
+        )
+
+    for _ in range(warmup):
+        m = engine.step(make_microbatches())
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.step(make_microbatches())
+    jax.block_until_ready(m["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CPU smoke config")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from d9d_tpu.models.qwen3 import Qwen3DenseConfig
+    from d9d_tpu.pipelining.factory import (
+        Interleaved1F1BScheduleConfig,
+        ZeroBubble1PScheduleConfig,
+    )
+
+    if args.tiny:
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 256),), hidden_size=64, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+            remat=False,
+        )
+        seq_len, batch, microbatch = 64, 8, 2
+        warmup, steps = 1, 2
+        dtype = jnp.float32
+    else:
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 32_768),), hidden_size=1024,
+            num_layers=12, num_heads=16, num_kv_heads=8, head_dim=64,
+            intermediate_size=4096, remat=True,
+        )
+        seq_len, batch, microbatch = 2048, 8, 1
+        warmup, steps = 3, 8
+        dtype = jnp.bfloat16
+    if args.steps:
+        steps = args.steps
+
+    combos = [
+        ("1f1b", "remat",
+         Interleaved1F1BScheduleConfig(stages_per_rank=2)),
+        ("zb1p", "remat",
+         ZeroBubble1PScheduleConfig(stages_per_rank=2)),
+        ("zb1p", "cache_full",
+         ZeroBubble1PScheduleConfig(
+             stages_per_rank=2, residual_policy="cache_full")),
+    ]
+    results = []
+    for name, policy, sched in combos:
+        engine = build_engine(
+            sched, cfg=cfg, seq_len=seq_len, batch=batch,
+            microbatch=microbatch, dtype=dtype,
+        )
+        dt = measure(
+            engine, batch=batch, microbatch=microbatch, seq_len=seq_len,
+            vocab=cfg.vocab_size, warmup=warmup, steps=steps,
+        )
+        tok_s = batch * seq_len / dt
+        row = {
+            "schedule": name,
+            "residual_policy": policy,
+            "step_time_s": round(dt, 4),
+            "tokens_per_sec": round(tok_s, 1),
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    best = min(results, key=lambda r: r["step_time_s"])
+    print(json.dumps({"winner": f"{best['schedule']}/{best['residual_policy']}",
+                      "step_time_s": best["step_time_s"]}))
+
+
+if __name__ == "__main__":
+    main()
