@@ -332,6 +332,92 @@ class _UnstackExpertsTransposed(ModelStateMapper):
         }
 
 
+class _FusedExpertsFromHF(ModelStateMapper):
+    """HF v5 fused ``gate_up_proj`` [E, 2i, h] → grouped gate/up [E, h, i].
+
+    Reference huggingface.py FUSED branch (:60-81): transpose the last two
+    dims, then chunk the last dim into (gate, up)."""
+
+    def __init__(self, source: str, gate_target: str, up_target: str):
+        self._source = source
+        self._gate = gate_target
+        self._up = up_target
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source]),
+                    outputs=frozenset([self._gate, self._up]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        t = np.swapaxes(np.asarray(group[self._source]), -1, -2)
+        if t.shape[-1] % 2 != 0:
+            raise ValueError(
+                f"{self._source}: fused gate_up dim {t.shape[-1]} is odd"
+            )
+        half = t.shape[-1] // 2
+        return {
+            self._gate: np.ascontiguousarray(t[..., :half]),
+            self._up: np.ascontiguousarray(t[..., half:]),
+        }
+
+
+class _FusedExpertsToHF(ModelStateMapper):
+    """Inverse of _FusedExpertsFromHF: concat (gate, up) on the last dim,
+    then transpose the last two dims back to the HF fused layout."""
+
+    def __init__(self, gate_source: str, up_source: str, target: str):
+        self._gate = gate_source
+        self._up = up_source
+        self._target = target
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._gate, self._up]),
+                    outputs=frozenset([self._target]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        fused = np.concatenate(
+            [np.asarray(group[self._gate]), np.asarray(group[self._up])],
+            axis=-1,
+        )
+        return {self._target: np.ascontiguousarray(np.swapaxes(fused, -1, -2))}
+
+
+class _TransposedRenameLast2(ModelStateMapper):
+    """Rename + swap the LAST two dims (3D grouped expert tensors)."""
+
+    def __init__(self, name_from: str, name_to: str):
+        self._name_from = name_from
+        self._name_to = name_to
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._name_from]),
+                    outputs=frozenset([self._name_to]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {
+            self._name_to: np.ascontiguousarray(
+                np.swapaxes(np.asarray(group[self._name_from]), -1, -2)
+            )
+        }
+
+
 def _moe_attention_pairs(config, i: int) -> list[tuple[str, str, bool]]:
     hf = f"model.layers.{i}"
     us = f"{_P}model.layers_{i}"
@@ -357,8 +443,17 @@ def qwen3_moe_from_hf_mapper(
     layers: list[int] | None = None,
     include_embed: bool = True,
     include_head: bool = True,
+    experts_format: str = "module_list",
 ) -> ModelStateMapper:
-    """HF Qwen3MoE checkpoint names → d9d_tpu Qwen3MoeCausalLM params."""
+    """HF Qwen3MoE checkpoint names → d9d_tpu Qwen3MoeCausalLM params.
+
+    ``experts_format`` selects the HF expert-weight layout (reference
+    huggingface.py:29-83): "module_list" = transformers v4.x per-expert
+    Linear weights; "fused" = v5.x 3D ``experts.gate_up_proj`` /
+    ``experts.down_proj`` tensors.
+    """
+    if experts_format not in ("module_list", "fused"):
+        raise ValueError(f"unknown experts_format {experts_format!r}")
     mappers = _embed_head_from_hf_mappers(
         config,
         tie_word_embeddings=tie_word_embeddings,
@@ -387,16 +482,31 @@ def qwen3_moe_from_hf_mapper(
                     f"{hf}.mlp.gate.weight", f"{us}.mlp.router.gate.kernel"
                 )
             )
-            for proj in ("gate_proj", "up_proj", "down_proj"):
+            if experts_format == "fused":
                 mappers.append(
-                    _StackExpertsTransposed(
-                        [
-                            f"{hf}.mlp.experts.{e}.{proj}.weight"
-                            for e in range(config.num_experts)
-                        ],
-                        f"{us}.mlp.grouped_experts.{proj}",
+                    _FusedExpertsFromHF(
+                        f"{hf}.mlp.experts.gate_up_proj",
+                        f"{us}.mlp.grouped_experts.gate_proj",
+                        f"{us}.mlp.grouped_experts.up_proj",
                     )
                 )
+                mappers.append(
+                    _TransposedRenameLast2(
+                        f"{hf}.mlp.experts.down_proj",
+                        f"{us}.mlp.grouped_experts.down_proj",
+                    )
+                )
+            else:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    mappers.append(
+                        _StackExpertsTransposed(
+                            [
+                                f"{hf}.mlp.experts.{e}.{proj}.weight"
+                                for e in range(config.num_experts)
+                            ],
+                            f"{us}.mlp.grouped_experts.{proj}",
+                        )
+                    )
     return ModelStateMapperParallel(mappers)
 
 
@@ -407,8 +517,14 @@ def qwen3_moe_to_hf_mapper(
     layers: list[int] | None = None,
     include_embed: bool = True,
     include_head: bool = True,
+    experts_format: str = "module_list",
 ) -> ModelStateMapper:
-    """d9d_tpu Qwen3MoeCausalLM params → HF Qwen3MoE checkpoint names."""
+    """d9d_tpu Qwen3MoeCausalLM params → HF Qwen3MoE checkpoint names.
+
+    ``experts_format``: see :func:`qwen3_moe_from_hf_mapper`.
+    """
+    if experts_format not in ("module_list", "fused"):
+        raise ValueError(f"unknown experts_format {experts_format!r}")
     mappers: list[ModelStateMapper] = []
     if include_embed:
         mappers.append(
@@ -442,16 +558,31 @@ def qwen3_moe_to_hf_mapper(
                     f"{us}.mlp.router.gate.kernel", f"{hf}.mlp.gate.weight"
                 )
             )
-            for proj in ("gate_proj", "up_proj", "down_proj"):
+            if experts_format == "fused":
                 mappers.append(
-                    _UnstackExpertsTransposed(
-                        f"{us}.mlp.grouped_experts.{proj}",
-                        [
-                            f"{hf}.mlp.experts.{e}.{proj}.weight"
-                            for e in range(config.num_experts)
-                        ],
+                    _FusedExpertsToHF(
+                        f"{us}.mlp.grouped_experts.gate_proj",
+                        f"{us}.mlp.grouped_experts.up_proj",
+                        f"{hf}.mlp.experts.gate_up_proj",
                     )
                 )
+                mappers.append(
+                    _TransposedRenameLast2(
+                        f"{us}.mlp.grouped_experts.down_proj",
+                        f"{hf}.mlp.experts.down_proj",
+                    )
+                )
+            else:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    mappers.append(
+                        _UnstackExpertsTransposed(
+                            f"{us}.mlp.grouped_experts.{proj}",
+                            [
+                                f"{hf}.mlp.experts.{e}.{proj}.weight"
+                                for e in range(config.num_experts)
+                            ],
+                        )
+                    )
     if include_head:
         mappers.append(
             ModelStateMapperRename(f"{_P}model.norm.weight", "model.norm.weight")

@@ -129,3 +129,57 @@ def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
         np.testing.assert_allclose(
             exported[k], hf_state[k], rtol=1e-6, atol=1e-6, err_msg=k
         )
+
+
+def test_fused_v5_format_roundtrips_against_v4(hf_and_ours, tmp_path):
+    """The v5 fused-experts mapper (reference huggingface.py:60-81,240-263)
+    exports ``experts.gate_up_proj``/``experts.down_proj`` tensors whose
+    re-import equals the v4 ModuleList import bit-for-bit."""
+    hf, model, params, cfg = hf_and_ours
+
+    # export in the fused layout
+    fused_dir = tmp_path / "fused"
+    save_params(
+        fused_dir, params, mapper=qwen3_moe_to_hf_mapper(cfg, experts_format="fused")
+    )
+    fused_names = [
+        f"model.layers.{i}.mlp.experts.{n}"
+        for i in range(cfg.num_layers)
+        for n in ("gate_up_proj", "down_proj")
+    ]
+    fused_state = {
+        k: v
+        for k, v in read_model_state(
+            fused_dir, identity_mapper_from_names(fused_names)
+        )
+        if k in fused_names
+    }
+    # fused shapes: gate_up [E, 2i, h], down [E, h, i]
+    e, i_dim, h = cfg.num_experts, cfg.moe_intermediate_size, cfg.hidden_size
+    assert fused_state[fused_names[0]].shape == (e, 2 * i_dim, h)
+    assert fused_state[fused_names[1]].shape == (e, h, i_dim)
+
+    # re-import through the fused mapper == original grouped params
+    import flax.linen as nn
+
+    b, t = 2, 16
+    tokens = jnp.zeros((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    template = nn.unbox(
+        jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+        )
+    )
+    template = {"params": template["params"]}
+    params_back = load_params(
+        fused_dir,
+        template,
+        mapper=qwen3_moe_from_hf_mapper(cfg, experts_format="fused"),
+    )
+    jax.tree.map(
+        lambda a, b_: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=0, atol=0
+        ),
+        params["params"],
+        params_back["params"],
+    )
