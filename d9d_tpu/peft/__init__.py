@@ -5,6 +5,7 @@ from d9d_tpu.peft.full_tune import FullTune
 from d9d_tpu.peft.lora import LoRA
 from d9d_tpu.peft.stack import PeftStack
 from d9d_tpu.peft.task import (
+    PeftStageTask,
     PeftTask,
     adapter_from_state_dict,
     adapter_state_dict,
@@ -15,6 +16,7 @@ __all__ = [
     "FullTune",
     "LoRA",
     "PeftStack",
+    "PeftStageTask",
     "PeftTask",
     "adapter_state_dict",
     "adapter_from_state_dict",

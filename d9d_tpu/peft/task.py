@@ -40,6 +40,63 @@ class PeftTask(TrainTask):
     def metrics_postprocess(self, metrics: dict[str, Any]) -> dict[str, Any]:
         return self.inner.metrics_postprocess(metrics)
 
+    def metrics(self) -> dict[str, Any]:
+        return self.inner.metrics()
+
+    def update_metrics(self, metric_objs, stats) -> None:
+        self.inner.update_metrics(metric_objs, stats)
+
+
+class PeftStageTask:
+    """StageTask wrapper for PEFT under pipeline parallelism: one per
+    stage, closing over that stage's frozen base so the executor's
+    "params" are the stage's adapter tree (reference trainable-predicate
+    PEFT per stage, model_stage_factory.py:25,264).
+
+    Grads/optimizer state exist only for adapters; the base rides each
+    stage jit as a closed-over constant.
+    """
+
+    def __init__(self, inner, method: PeftMethod, base: PyTree):
+        self.inner = inner
+        self.method = method
+        self.base = base
+
+    def _params(self, adapters: PyTree) -> PyTree:
+        return self.method.materialize(
+            jax.lax.stop_gradient(self.base), adapters
+        )
+
+    # -- StageTask surface ---------------------------------------------
+    def split_microbatch(self, microbatch):
+        return self.inner.split_microbatch(microbatch)
+
+    def sample_microbatch(self, microbatch_size, seq_len):
+        return self.inner.sample_microbatch(microbatch_size, seq_len)
+
+    def stage_forward(self, module, adapters, carry, kwargs):
+        return self.inner.stage_forward(
+            module, self._params(adapters), carry, kwargs
+        )
+
+    def last_stage_loss(self, module, adapters, carry, kwargs, state):
+        return self.inner.last_stage_loss(
+            module, self._params(adapters), carry, kwargs, state
+        )
+
+    # host-side task surface used by the Trainer loop --------------------
+    def prepare_batch(self, batch):
+        return self.inner.prepare_batch(batch)
+
+    def metrics_postprocess(self, metrics):
+        return self.inner.metrics_postprocess(metrics)
+
+    def metrics(self):
+        return self.inner.metrics()
+
+    def update_metrics(self, metric_objs, stats):
+        self.inner.update_metrics(metric_objs, stats)
+
 
 def adapter_state_dict(adapters: PyTree) -> dict[str, jax.Array]:
     """Flatten adapters to the repo's canonical dotted-name dict
