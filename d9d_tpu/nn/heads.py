@@ -47,8 +47,10 @@ class LanguageModellingHead(nn.Module):
         """hidden [B,T,D], labels [B,T] → per-token loss [B,T] (fp32)."""
         w = self._weight()
         b, t, d = hidden.shape
+        # CE matmul policy follows the activation dtype (linear_ce default):
+        # bf16 models take the full-rate MXU path, fp32 models stay exact
         loss = linear_cross_entropy(
-            hidden.reshape(b * t, d),
+            hidden.reshape(b * t, d).astype(self.dtype),
             w,
             labels.reshape(b * t),
             chunk_size=self.ce_chunk_size,

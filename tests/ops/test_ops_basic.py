@@ -191,8 +191,26 @@ class TestLinearCrossEntropy:
     def test_matches_oracle(self):
         h, w = rng(10, 8), rng(32, 8, seed=1)
         labels = jnp.array([0, 5, 31, LM_IGNORE_INDEX, 2, 7, 1, 0, 30, LM_IGNORE_INDEX])
-        out = linear_cross_entropy(h, w, labels)
+        out = linear_cross_entropy(h, w, labels)  # fp32 inputs → exact path
         np.testing.assert_allclose(out, self._oracle(h, w, np.asarray(labels)), rtol=1e-5)
+
+    def test_bf16_matmul_policy_close_to_fp32(self):
+        """bf16 inputs select the bf16-in/fp32-accum MXU policy by default
+        and stay within bf16 rounding of the fp32 path (the softmax math is
+        fp32 in both)."""
+        h, w = rng(64, 32), rng(128, 32, seed=1)
+        labels = jnp.arange(64) % 128
+        ref = linear_cross_entropy(h, w, labels)  # fp32 path
+        out = linear_cross_entropy(
+            h.astype(jnp.bfloat16), w.astype(jnp.bfloat16), labels
+        )
+        np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
+        # and the dtype-inferred default equals the explicit policy
+        explicit = linear_cross_entropy(
+            h.astype(jnp.bfloat16), w.astype(jnp.bfloat16), labels,
+            matmul_dtype="bf16",
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(explicit))
 
     def test_chunked_equals_unchunked(self):
         h, w = rng(100, 8), rng(64, 8, seed=1)
