@@ -49,3 +49,30 @@ def test_bench_pp_tiny_runs(devices):
     rows = [_json.loads(l) for l in lines]
     assert any("winner" in r for r in rows)
     assert sum("schedule" in r for r in rows) == 3
+
+
+def test_bench_moe_tiny_runs(devices):
+    bench = _load_bench()
+    result = bench.run_bench_moe(tiny=True)
+    assert result["metric"] == "qwen3_moe_tokens_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["detail"]["active_params"] < result["detail"]["total_params"]
+    assert 0 <= result["detail"]["mfu"] <= result["detail"]["hfu"] + 1e-9
+
+
+def test_bench_kernels_tiny_runs(devices):
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_kernels.py"), "--tiny"],
+        capture_output=True, text=True, timeout=560,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(root)},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json as _json
+
+    rows = [_json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    benches = {r["bench"] for r in rows if "bench" in r}
+    assert {"sdpa_fwd", "linear_ce_fwd", "rms_norm", "stochastic_round"} <= benches

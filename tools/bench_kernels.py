@@ -1,0 +1,185 @@
+"""Per-kernel latency harness: time each op's providers against each other.
+
+TPU analogue of the reference's triton-bench helper
+(test/d9d_test/kernel/helper/benchmark.py:15-29: latency curves for d9d vs
+torch-eager vs torch.compile vs liger). Here the providers are the repo's
+kernel variants:
+
+- sdpa:        pallas flash kernel vs the eager jnp oracle (fwd, fwd+bwd)
+- linear_ce:   chunked CCE, fp32 vs bf16-in/fp32-accum einsum x chunk sizes,
+               vs the naive full-logits path
+- rms_norm:    jnp/XLA-fused implementation
+- silu_mul:    jnp/XLA-fused implementation
+- stochastic:  bf16 stochastic-rounding copy, jnp bit-twiddle vs pallas prng
+
+Run on the TPU chip:   python tools/bench_kernels.py
+CPU smoke:             JAX_PLATFORMS=cpu python tools/bench_kernels.py --tiny
+Prints one JSON line per (bench, provider, config): median ms over reps.
+BASELINE.md records the measured winners; ops defaults follow them.
+"""
+
+import argparse
+import json
+import time
+
+
+def timeit(fn, *args, reps=20, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e3  # median ms
+
+
+def emit(bench, provider, config, ms):
+    print(
+        json.dumps(
+            {"bench": bench, "provider": provider, "config": config,
+             "ms": round(ms, 4)}
+        ),
+        flush=True,
+    )
+
+
+def bench_sdpa(tiny):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+
+    shapes = (
+        [(1, 128, 4, 2, 64)]
+        if tiny
+        else [(4, 2048, 16, 8, 64), (2, 8192, 16, 8, 64), (1, 4096, 32, 8, 128)]
+    )
+    providers = {"eager": eager_sdpa}
+    if jax.default_backend() == "tpu":
+        from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
+
+        providers["pallas_flash"] = make_pallas_flash_sdpa()
+
+    for b, t, hq, hkv, d in shapes:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (b, t, hq, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, t, hkv, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, t, hkv, d), jnp.bfloat16)
+        cfg = f"b{b}_t{t}_h{hq}:{hkv}_d{d}"
+        for name, sdpa in providers.items():
+            fwd = jax.jit(lambda q, k, v, f=sdpa: f(q, k, v, causal=True))
+            emit("sdpa_fwd", name, cfg, timeit(fwd, q, k, v))
+
+            def loss(q, k, v, f=sdpa):
+                return jnp.sum(f(q, k, v, causal=True).astype(jnp.float32))
+
+            bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            emit("sdpa_fwd_bwd", name, cfg, timeit(bwd, q, k, v))
+
+
+def bench_linear_ce(tiny):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.ops.linear_ce import linear_cross_entropy
+
+    if tiny:
+        n, d, v = 256, 64, 512
+        chunks = [128]
+    else:
+        n, d, v = 16384, 1024, 32768
+        chunks = [512, 2048, 8192]
+    h = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (v, d), jnp.bfloat16)
+    labels = jnp.arange(n) % v
+
+    def naive(h, w, labels):
+        logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        corr = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        return lse - corr
+
+    variants = {"naive_full_logits": jax.jit(naive)}
+    for chunk in chunks:
+        for dtype in ("fp32", "bf16"):
+            variants[f"cce_{dtype}_c{chunk}"] = jax.jit(
+                lambda h, w, l, c=chunk, dt=dtype: linear_cross_entropy(
+                    h, w, l, chunk_size=c, matmul_dtype=dt
+                )
+            )
+    cfg = f"n{n}_d{d}_v{v}"
+    for name, fn in variants.items():
+        emit("linear_ce_fwd", name, cfg, timeit(fn, h, w, labels))
+        grad = jax.jit(
+            jax.grad(lambda h, w, l, f=fn: jnp.sum(f(h, w, l)), argnums=(0, 1))
+        )
+        emit("linear_ce_fwd_bwd", name, cfg, timeit(grad, h, w, labels))
+
+
+def bench_elementwise(tiny):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.ops import rms_norm, silu_mul
+
+    n, d = (256, 64) if tiny else (16384, 4096)
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.bfloat16)
+    y = jax.random.normal(jax.random.PRNGKey(1), (n, d), jnp.bfloat16)
+    w = jnp.ones((d,), jnp.float32)
+    emit("rms_norm", "jnp_fused", f"n{n}_d{d}",
+         timeit(jax.jit(lambda x, w: rms_norm(x, w)), x, w))
+    emit("silu_mul", "jnp_fused", f"n{n}_d{d}",
+         timeit(jax.jit(silu_mul), x, y))
+
+
+def bench_stochastic(tiny):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.ops.stochastic import (
+        stochastic_round_to_bf16,
+        stochastic_round_to_bf16_pallas,
+    )
+
+    n = 4096 if tiny else 1 << 24
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+    key = jax.random.PRNGKey(1)
+    emit("stochastic_round", "jnp_bit_twiddle", f"n{n}",
+         timeit(jax.jit(stochastic_round_to_bf16), x, key))
+    if jax.default_backend() == "tpu":
+        seed = jnp.uint32(7)
+        emit("stochastic_round", "pallas_prng", f"n{n}",
+             timeit(jax.jit(stochastic_round_to_bf16_pallas), x, seed))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument(
+        "--only", choices=["sdpa", "linear_ce", "elementwise", "stochastic"],
+        default=None,
+    )
+    args = ap.parse_args()
+    import jax
+
+    print(json.dumps({"device": jax.devices()[0].device_kind,
+                      "backend": jax.default_backend()}), flush=True)
+    benches = {
+        "sdpa": bench_sdpa,
+        "linear_ce": bench_linear_ce,
+        "elementwise": bench_elementwise,
+        "stochastic": bench_stochastic,
+    }
+    for name, fn in benches.items():
+        if args.only is None or args.only == name:
+            fn(args.tiny)
+
+
+if __name__ == "__main__":
+    main()
