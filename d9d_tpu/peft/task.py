@@ -61,10 +61,19 @@ class PeftStageTask:
         self.inner = inner
         self.method = method
         self.base = base
+        # forward the optional forward-only hook only when the wrapped task
+        # has it — the executor probes getattr(task, "last_stage_outputs")
+        if getattr(inner, "last_stage_outputs", None) is not None:
+            self.last_stage_outputs = self._last_stage_outputs
 
     def _params(self, adapters: PyTree) -> PyTree:
         return self.method.materialize(
             jax.lax.stop_gradient(self.base), adapters
+        )
+
+    def _last_stage_outputs(self, module, adapters, carry, kwargs, state):
+        return self.inner.last_stage_outputs(
+            module, self._params(adapters), carry, kwargs, state
         )
 
     # -- StageTask surface ---------------------------------------------
