@@ -185,9 +185,9 @@ class CorpusProvider(DatasetProvider):
 
 
 class MoEProvider(ModelProvider):
-    def __init__(self, cfg: ModelConfig, ep_axes):
+    def __init__(self, cfg: ModelConfig, ctx):
         self.cfg = cfg
-        self.ep_axes = ep_axes
+        self.ctx = ctx
 
     def build_module(self, stage):
         c = self.cfg
@@ -203,10 +203,16 @@ class MoEProvider(ModelProvider):
                 num_experts=c.num_experts,
                 num_experts_per_tok=c.num_experts_per_tok,
                 remat=c.remat,
-                ep_axes=self.ep_axes,
+                ep_axes=self.ctx.ep_shard_axes,
+                # ride the residual layout through the EP dispatch (no
+                # boundary reshard; see MoELayer.token_axes)
+                moe_token_axes=(self.ctx.batch_axes, self.ctx.sequence_axes),
             ),
             sdpa=build_sdpa_backend(),
             stage=stage,
+            # pin the residual stream so SPMD never drifts into fused-batch
+            # layouts that replicate-reshard at attention / the LM head
+            act_sharding=self.ctx.batch_sharding(),
             dtype=jnp.dtype(c.dtype),
         )
 
@@ -252,7 +258,7 @@ def main(config_path: str) -> None:
     trainer = Trainer(
         ctx=ctx,
         config=cfg.trainer,
-        model_provider=MoEProvider(cfg.model, ctx.ep_shard_axes),
+        model_provider=MoEProvider(cfg.model, ctx),
         dataset_provider=CorpusProvider(cfg.data, cfg.model.vocab_size, cfg.trainer),
         task=CausalLMTask(),
         optimizer_provider=ConfiguredOptimizerProvider(cfg.optimizer),

@@ -111,3 +111,46 @@ def test_moe_layer_tokens_per_expert_stats(ctx):
     tpe = stats["moe_stats"]["tokens_per_expert"]
     tpe = tpe[0] if isinstance(tpe, tuple) else tpe
     assert int(np.asarray(tpe).sum()) == 2 * 8 * 2
+
+
+def test_ep_token_layout_matches_local(ctx):
+    """The token-layout EP flow (shard_map riding the residual
+    [B@dp, T@cp, D] sharding, non-token ep axes subdividing ownership)
+    computes the same loss/grads as the local path."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens, positions = _inputs()
+    local = _model()
+    variables = local.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+    params = {"params": variables["params"]}
+    loss_local = local.apply(params, tokens, positions, tokens)
+
+    import dataclasses
+
+    # thread the residual layout: batch over dp, no cp in this mesh
+    cfg = dataclasses.replace(
+        Qwen3MoeConfig.tiny(ep_axes=ctx.ep_shard_axes),
+        moe_token_axes=(ctx.batch_axes, ctx.sequence_axes),
+    )
+    ep = Qwen3MoeCausalLM(config=cfg, sdpa=eager_sdpa, dtype=jnp.float32)
+    sharded_tokens = jax.device_put(
+        tokens, NamedSharding(ctx.mesh, P(ctx.batch_axes, ctx.sequence_axes))
+    )
+    loss_ep = jax.jit(ep.apply)(params, sharded_tokens, positions, tokens)
+    np.testing.assert_allclose(
+        np.asarray(loss_ep), np.asarray(loss_local), rtol=2e-4, atol=2e-5
+    )
+
+    g_local = jax.grad(
+        lambda p: local.apply(p, tokens, positions, tokens).sum()
+    )(params)
+    g_ep = jax.jit(
+        jax.grad(lambda p: ep.apply(p, sharded_tokens, positions, tokens).sum())
+    )(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4
+        ),
+        g_local,
+        g_ep,
+    )
