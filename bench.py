@@ -97,6 +97,8 @@ def run_bench(*, tiny: bool = False) -> dict:
         steps_warmup, steps_measure = 1, 2
         dtype = jnp.float32
     else:
+        import os
+
         cfg = Qwen3DenseConfig(
             vocab_ranges=(("default", 32_768),),
             hidden_size=1024,
@@ -106,6 +108,8 @@ def run_bench(*, tiny: bool = False) -> dict:
             head_dim=64,
             intermediate_size=4096,
             remat=True,
+            # tuning knob for on-chip sweeps (BASELINE.md methodology)
+            remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
         )
         seq_len, batch = 2048, 8
         steps_warmup, steps_measure = 3, 10

@@ -26,6 +26,11 @@ class Qwen3DenseConfig:
     use_sinks: bool = False
     use_output_gate: bool = False
     remat: bool = True
+    # "full" recomputes everything in backward (minimum memory, ~8N HFU);
+    # "dots_no_batch" saves matmul outputs with no batch dims (XLA's
+    # checkpoint_dots_with_no_batch_dims policy) — fewer recomputed FLOPs
+    # for more activation memory. Measured via bench.py on chip.
+    remat_policy: str = "full"
 
     @property
     def vocab_size(self) -> int:

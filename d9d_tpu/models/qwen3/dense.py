@@ -11,6 +11,7 @@ repartitioning the pipeline never remaps weights.
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding
@@ -27,6 +28,15 @@ from d9d_tpu.pipelining import (
     PipelineStageInfo,
     distribute_layers_for_pipeline_stage,
 )
+
+
+def _remat_policy(name: str):
+    """Map a config string to a jax.checkpoint policy (None = save nothing)."""
+    if name == "full":
+        return None
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    raise ValueError(f"unknown remat_policy {name!r}")
 
 
 class Qwen3DenseBackbone(nn.Module):
@@ -73,7 +83,11 @@ class Qwen3DenseBackbone(nn.Module):
 
         layer_cls = DecoderLayer
         if cfg.remat:
-            layer_cls = nn.remat(DecoderLayer, prevent_cse=False)
+            layer_cls = nn.remat(
+                DecoderLayer,
+                prevent_cse=False,
+                policy=_remat_policy(cfg.remat_policy),
+            )
 
         for gid in distribute_layers_for_pipeline_stage(cfg.num_layers, self.stage):
             x = layer_cls(

@@ -1,0 +1,422 @@
+"""Bidirectional HuggingFace ⇄ d9d_tpu mappers for the Qwen3-Next hybrid
+family (GDN linear-attention + gated attention + MoE with shared expert).
+
+Beyond-reference capability: the reference ships no hybrid model family at
+all (SURVEY §2.4 — Qwen3 dense + MoE only); transformers ≥4.57 ships
+Qwen3Next, so the interop target is HF's layout directly:
+
+- attention ``q_proj`` fuses query and output gate per head
+  ([h, 2·dk] chunks) — split into our separate q/gate kernels;
+- linear-attention ``in_proj_qkvz`` packs [q|k|v|z] per *key-head group*
+  ([ng, dk|dk|r·dv|r·dv]) and ``in_proj_ba`` packs [b|a] per group —
+  de-interleaved into our flat q|k|v packing, ``g_proj``, ``b_proj`` and
+  the Mamba decay gate's projection;
+- conv1d weights drop torch's depthwise middle axis;
+- every norm except the GDN gated output norm is zero-centered on both
+  sides, so weights transfer unchanged.
+"""
+
+import numpy as np
+
+from d9d_tpu.model_state.mapper import (
+    ModelStateMapper,
+    ModelStateMapperParallel,
+    ModelStateMapperRename,
+    StateDict,
+    StateGroup,
+)
+from d9d_tpu.models.qwen3.huggingface import (
+    _ConcatRanges,
+    _embed_head_from_hf_mappers,
+    _StackExpertsTransposed,
+    _TransposedRename,
+    _UnstackExpertsTransposed,
+)
+
+_P = "params."
+
+
+class _OneToOne(ModelStateMapper):
+    """Base for single-input single-output array transforms."""
+
+    def __init__(self, name_from: str, name_to: str):
+        self._from = name_from
+        self._to = name_to
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._from]),
+                    outputs=frozenset([self._to]),
+                )
+            ]
+        )
+
+
+class _ConvSqueezeFromHF(_OneToOne):
+    """torch depthwise Conv1d weight [C, 1, K] → ours [C, K]."""
+
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._to: np.asarray(group[self._from])[:, 0, :]}
+
+
+class _ConvUnsqueezeToHF(_OneToOne):
+    def apply(self, group: StateDict) -> StateDict:
+        return {self._to: np.asarray(group[self._from])[:, None, :]}
+
+
+class _SplitColumns(ModelStateMapper):
+    """Transpose a torch [out, in] weight to [in, out], then split the out
+    dim into named column groups given by ``plan: [(target, idx_array)]``.
+    The index arrays must partition range(out)."""
+
+    def __init__(self, source: str, plan: list[tuple[str, np.ndarray]]):
+        self._source = source
+        self._plan = plan
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset([self._source]),
+                    outputs=frozenset(t for t, _ in self._plan),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        w = np.swapaxes(np.asarray(group[self._source]), 0, 1)  # [in, out]
+        return {
+            t: np.ascontiguousarray(w[:, idx]) for t, idx in self._plan
+        }
+
+
+class _MergeColumns(ModelStateMapper):
+    """Inverse of _SplitColumns: scatter named column groups back into a
+    single [out, in] torch weight."""
+
+    def __init__(
+        self, target: str, plan: list[tuple[str, np.ndarray]], out_dim: int
+    ):
+        self._target = target
+        self._plan = plan
+        self._out_dim = out_dim
+
+    def state_dependency_groups(self) -> frozenset[StateGroup]:
+        return frozenset(
+            [
+                StateGroup(
+                    inputs=frozenset(s for s, _ in self._plan),
+                    outputs=frozenset([self._target]),
+                )
+            ]
+        )
+
+    def apply(self, group: StateDict) -> StateDict:
+        first = np.asarray(group[self._plan[0][0]])
+        in_dim = first.shape[0]
+        w = np.zeros((in_dim, self._out_dim), first.dtype)
+        for src, idx in self._plan:
+            w[:, idx] = np.asarray(group[src])
+        return {self._target: np.ascontiguousarray(np.swapaxes(w, 0, 1))}
+
+
+def _qkvz_plan(cfg) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Column indices of (q, k, v, z) inside HF's in_proj_qkvz out dim."""
+    ng = cfg.gdn_qk_heads or cfg.num_kv_heads
+    hv = cfg.gdn_v_heads or cfg.num_heads
+    dk = cfg.gdn_head_qk_dim or cfg.head_dim
+    dv = cfg.gdn_head_v_dim or cfg.head_dim
+    r = hv // ng
+    s = 2 * dk + 2 * r * dv
+    q, k, v, z = [], [], [], []
+    for i in range(ng):
+        base = i * s
+        q.extend(range(base, base + dk))
+        k.extend(range(base + dk, base + 2 * dk))
+        v.extend(range(base + 2 * dk, base + 2 * dk + r * dv))
+        z.extend(range(base + 2 * dk + r * dv, base + s))
+    return (np.array(q), np.array(k), np.array(v), np.array(z))
+
+
+def _ba_plan(cfg) -> tuple[np.ndarray, np.ndarray]:
+    ng = cfg.gdn_qk_heads or cfg.num_kv_heads
+    hv = cfg.gdn_v_heads or cfg.num_heads
+    r = hv // ng
+    b, a = [], []
+    for i in range(ng):
+        base = i * 2 * r
+        b.extend(range(base, base + r))
+        a.extend(range(base + r, base + 2 * r))
+    return np.array(b), np.array(a)
+
+
+def _qgate_plan(cfg) -> tuple[np.ndarray, np.ndarray]:
+    """(q, gate) column indices inside HF's fused attention q_proj."""
+    h, d = cfg.num_heads, cfg.head_dim
+    q, g = [], []
+    for i in range(h):
+        base = i * 2 * d
+        q.extend(range(base, base + d))
+        g.extend(range(base + d, base + 2 * d))
+    return np.array(q), np.array(g)
+
+
+def _linear_layer_from_hf(cfg, i: int) -> list[ModelStateMapper]:
+    hf = f"model.layers.{i}.linear_attn"
+    us = f"{_P}model.layers_{i}.linear_attn"
+    qi, ki, vi, zi = _qkvz_plan(cfg)
+    bi, ai = _ba_plan(cfg)
+    qkv = np.concatenate([qi, ki, vi])
+    return [
+        _SplitColumns(
+            f"{hf}.in_proj_qkvz.weight",
+            [(f"{us}.qkv_proj.kernel", qkv), (f"{us}.g_proj.kernel", zi)],
+        ),
+        _SplitColumns(
+            f"{hf}.in_proj_ba.weight",
+            [
+                (f"{us}.b_proj.kernel", bi),
+                (f"{us}.decay_gate.proj.kernel", ai),
+            ],
+        ),
+        _ConvSqueezeFromHF(
+            f"{hf}.conv1d.weight", f"{us}.qkv_conv1d.weight"
+        ),
+        ModelStateMapperRename(f"{hf}.dt_bias", f"{us}.decay_gate.dt_bias"),
+        ModelStateMapperRename(f"{hf}.A_log", f"{us}.decay_gate.A_log"),
+        ModelStateMapperRename(f"{hf}.norm.weight", f"{us}.out_norm.weight"),
+        _TransposedRename(f"{hf}.out_proj.weight", f"{us}.o_proj.kernel"),
+    ]
+
+
+def _linear_layer_to_hf(cfg, i: int) -> list[ModelStateMapper]:
+    hf = f"model.layers.{i}.linear_attn"
+    us = f"{_P}model.layers_{i}.linear_attn"
+    qi, ki, vi, zi = _qkvz_plan(cfg)
+    bi, ai = _ba_plan(cfg)
+    qkv = np.concatenate([qi, ki, vi])
+    return [
+        _MergeColumns(
+            f"{hf}.in_proj_qkvz.weight",
+            [(f"{us}.qkv_proj.kernel", qkv), (f"{us}.g_proj.kernel", zi)],
+            out_dim=len(qkv) + len(zi),
+        ),
+        _MergeColumns(
+            f"{hf}.in_proj_ba.weight",
+            [
+                (f"{us}.b_proj.kernel", bi),
+                (f"{us}.decay_gate.proj.kernel", ai),
+            ],
+            out_dim=len(bi) + len(ai),
+        ),
+        _ConvUnsqueezeToHF(
+            f"{us}.qkv_conv1d.weight", f"{hf}.conv1d.weight"
+        ),
+        ModelStateMapperRename(f"{us}.decay_gate.dt_bias", f"{hf}.dt_bias"),
+        ModelStateMapperRename(f"{us}.decay_gate.A_log", f"{hf}.A_log"),
+        ModelStateMapperRename(f"{us}.out_norm.weight", f"{hf}.norm.weight"),
+        _TransposedRename(f"{us}.o_proj.kernel", f"{hf}.out_proj.weight"),
+    ]
+
+
+def _attn_layer_pairs(cfg, i: int) -> list[tuple[str, str, bool]]:
+    hf = f"model.layers.{i}.self_attn"
+    us = f"{_P}model.layers_{i}.self_attn"
+    return [
+        (f"{hf}.k_proj.weight", f"{us}.k_proj.kernel", True),
+        (f"{hf}.v_proj.weight", f"{us}.v_proj.kernel", True),
+        (f"{hf}.o_proj.weight", f"{us}.o_proj.kernel", True),
+        (f"{hf}.q_norm.weight", f"{us}.q_norm.weight", False),
+        (f"{hf}.k_norm.weight", f"{us}.k_norm.weight", False),
+    ]
+
+
+def _moe_mlp_from_hf(cfg, i: int) -> list[ModelStateMapper]:
+    hf = f"model.layers.{i}.mlp"
+    us = f"{_P}model.layers_{i}.mlp"
+    mappers: list[ModelStateMapper] = [
+        _TransposedRename(f"{hf}.gate.weight", f"{us}.router.gate.kernel"),
+    ]
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        mappers.append(
+            _StackExpertsTransposed(
+                [
+                    f"{hf}.experts.{e}.{proj}.weight"
+                    for e in range(cfg.num_experts)
+                ],
+                f"{us}.grouped_experts.{proj}",
+            )
+        )
+    if cfg.shared_expert is not None:
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            mappers.append(
+                _TransposedRename(
+                    f"{hf}.shared_expert.{proj}.weight",
+                    f"{us}.shared_expert_module.expert.{proj}.kernel",
+                )
+            )
+        mappers.append(
+            _TransposedRename(
+                f"{hf}.shared_expert_gate.weight",
+                f"{us}.shared_expert_module.gate.kernel",
+            )
+        )
+    return mappers
+
+
+def _moe_mlp_to_hf(cfg, i: int) -> list[ModelStateMapper]:
+    hf = f"model.layers.{i}.mlp"
+    us = f"{_P}model.layers_{i}.mlp"
+    mappers: list[ModelStateMapper] = [
+        _TransposedRename(f"{us}.router.gate.kernel", f"{hf}.gate.weight"),
+    ]
+    for proj in ("gate_proj", "up_proj", "down_proj"):
+        mappers.append(
+            _UnstackExpertsTransposed(
+                f"{us}.grouped_experts.{proj}",
+                [
+                    f"{hf}.experts.{e}.{proj}.weight"
+                    for e in range(cfg.num_experts)
+                ],
+            )
+        )
+    if cfg.shared_expert is not None:
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            mappers.append(
+                _TransposedRename(
+                    f"{us}.shared_expert_module.expert.{proj}.kernel",
+                    f"{hf}.shared_expert.{proj}.weight",
+                )
+            )
+        mappers.append(
+            _TransposedRename(
+                f"{us}.shared_expert_module.gate.kernel",
+                f"{hf}.shared_expert_gate.weight",
+            )
+        )
+    return mappers
+
+
+def qwen3_next_from_hf_mapper(
+    config,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """HF Qwen3Next checkpoint names → d9d_tpu hybrid Qwen3MoeCausalLM."""
+    mappers = _embed_head_from_hf_mappers(
+        config,
+        tie_word_embeddings=tie_word_embeddings,
+        include_embed=include_embed,
+        include_head=include_head,
+    )
+    for i in layers if layers is not None else range(config.num_layers):
+        us = f"{_P}model.layers_{i}"
+        hf = f"model.layers.{i}"
+        mappers.append(
+            ModelStateMapperRename(
+                f"{hf}.input_layernorm.weight", f"{us}.input_layernorm.weight"
+            )
+        )
+        mappers.append(
+            ModelStateMapperRename(
+                f"{hf}.post_attention_layernorm.weight",
+                f"{us}.post_attention_layernorm.weight",
+            )
+        )
+        if i in config.linear_attention_layers:
+            mappers += _linear_layer_from_hf(config, i)
+        else:
+            qi, gi = _qgate_plan(config)
+            mappers.append(
+                _SplitColumns(
+                    f"{hf}.self_attn.q_proj.weight",
+                    [
+                        (f"{us}.self_attn.q_proj.kernel", qi),
+                        (f"{us}.self_attn.gate_proj.kernel", gi),
+                    ],
+                )
+            )
+            for hf_name, our_name, transposed in _attn_layer_pairs(config, i):
+                mappers.append(
+                    _TransposedRename(hf_name, our_name)
+                    if transposed
+                    else ModelStateMapperRename(hf_name, our_name)
+                )
+        mappers += _moe_mlp_from_hf(config, i)
+    return ModelStateMapperParallel(mappers)
+
+
+def qwen3_next_to_hf_mapper(
+    config,
+    *,
+    tie_word_embeddings: bool = False,
+    layers: list[int] | None = None,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> ModelStateMapper:
+    """d9d_tpu hybrid Qwen3MoeCausalLM params → HF Qwen3Next names."""
+    mappers: list[ModelStateMapper] = []
+    if include_embed:
+        mappers.append(
+            _ConcatRanges(
+                [
+                    f"{_P}model.embed_tokens.embedding_{n}"
+                    for n, _ in config.vocab_ranges
+                ],
+                "model.embed_tokens.weight",
+            )
+        )
+    for i in layers if layers is not None else range(config.num_layers):
+        us = f"{_P}model.layers_{i}"
+        hf = f"model.layers.{i}"
+        mappers.append(
+            ModelStateMapperRename(
+                f"{us}.input_layernorm.weight", f"{hf}.input_layernorm.weight"
+            )
+        )
+        mappers.append(
+            ModelStateMapperRename(
+                f"{us}.post_attention_layernorm.weight",
+                f"{hf}.post_attention_layernorm.weight",
+            )
+        )
+        if i in config.linear_attention_layers:
+            mappers += _linear_layer_to_hf(config, i)
+        else:
+            qi, gi = _qgate_plan(config)
+            mappers.append(
+                _MergeColumns(
+                    f"{hf}.self_attn.q_proj.weight",
+                    [
+                        (f"{us}.self_attn.q_proj.kernel", qi),
+                        (f"{us}.self_attn.gate_proj.kernel", gi),
+                    ],
+                    out_dim=len(qi) + len(gi),
+                )
+            )
+            for hf_name, our_name, transposed in _attn_layer_pairs(config, i):
+                mappers.append(
+                    _TransposedRename(our_name, hf_name)
+                    if transposed
+                    else ModelStateMapperRename(our_name, hf_name)
+                )
+        mappers += _moe_mlp_to_hf(config, i)
+    if include_head:
+        mappers.append(
+            ModelStateMapperRename(
+                f"{_P}model.norm.weight", "model.norm.weight"
+            )
+        )
+        if not tie_word_embeddings:
+            mappers.append(
+                _ConcatRanges(
+                    [f"{_P}lm_head.head_{n}" for n, _ in config.vocab_ranges],
+                    "lm_head.weight",
+                )
+            )
+    return ModelStateMapperParallel(mappers)

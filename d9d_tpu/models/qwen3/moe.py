@@ -59,12 +59,31 @@ class Qwen3MoeConfig:
     qk_norm: bool = True
     norm_eps: float = 1e-6
     remat: bool = True
+    # see Qwen3DenseConfig.remat_policy
+    remat_policy: str = "full"
+    # Qwen3-Next attention features: sigmoid output gate on attention
+    # layers, partial rotary (frequencies computed over the rotary dim),
+    # zero-centered RMSNorm weights (scale = 1 + w) on every norm except
+    # the GDN gated output norm
+    use_output_gate: bool = False
+    rope_fraction: float = 1.0
+    zero_centered_norms: bool = False
     # mesh axes carrying expert parallelism; None = local experts
     ep_axes: Optional[tuple[str, ...]] = None
     # (batch_axes, seq_axes) of the residual activation layout; when set,
     # the EP flow shard_maps over this layout directly (no boundary
     # reshard) — see MoELayer.token_axes
     moe_token_axes: Optional[tuple[tuple[str, ...], tuple[str, ...]]] = None
+    # Hybrid linear-attention layers (beyond-reference; Qwen3-Next-style
+    # 3:1 GDN:attention stacks): listed layer indices swap GQA for a
+    # GatedDeltaNet block. Geometry defaults derive from the attention
+    # dims when the gdn_* fields are 0.
+    linear_attention_layers: tuple[int, ...] = ()
+    gdn_qk_heads: int = 0
+    gdn_v_heads: int = 0
+    gdn_head_qk_dim: int = 0
+    gdn_head_v_dim: int = 0
+    gdn_conv_size: int = 4
     # EP dispatch buffer sizing (see MoELayer.ep_capacity_factor): a factor
     # like 2.0 gives N·k/ep per-shard compute with deterministic drops;
     # None = dropless worst-case buffer
@@ -88,6 +107,58 @@ class Qwen3MoeConfig:
             num_experts_per_tok=2,
             remat=False,
             ep_axes=ep_axes,
+        )
+
+    @staticmethod
+    def hybrid_tiny(vocab_size: int = 256, ep_axes=None) -> "Qwen3MoeConfig":
+        """CPU-runnable hybrid: GDN on 3 of 4 layers (Qwen3-Next 3:1 ratio)."""
+        return Qwen3MoeConfig(
+            vocab_ranges=(("default", vocab_size),),
+            hidden_size=64,
+            num_layers=4,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            moe_intermediate_size=64,
+            num_experts=8,
+            num_experts_per_tok=2,
+            remat=False,
+            ep_axes=ep_axes,
+            linear_attention_layers=(0, 1, 2),
+        )
+
+    @staticmethod
+    def qwen3_next_80b_a3b(vocab_size: int = 151_936, ep_axes=None) -> "Qwen3MoeConfig":
+        """Qwen3-Next-80B-A3B geometry: 3:1 GDN:attention hybrid + MoE
+        (beyond-reference flagship for the linear-attention family;
+        BASELINE config 5). Matches HF transformers' Qwen3Next semantics:
+        gated attention output, partial rotary (0.25, frequencies over the
+        rotary dim), zero-centered norms, gated shared expert."""
+        return Qwen3MoeConfig(
+            vocab_ranges=(("default", vocab_size),),
+            hidden_size=2048,
+            num_layers=48,
+            num_heads=16,
+            num_kv_heads=2,
+            head_dim=256,
+            moe_intermediate_size=512,
+            num_experts=512,
+            num_experts_per_tok=10,
+            shared_expert=SharedExpertParameters(
+                intermediate_size=512, enable_gate=True
+            ),
+            ep_axes=ep_axes,
+            linear_attention_layers=tuple(
+                i for i in range(48) if i % 4 != 3
+            ),
+            gdn_qk_heads=16,
+            gdn_v_heads=32,
+            gdn_head_qk_dim=128,
+            gdn_head_v_dim=128,
+            use_output_gate=True,
+            rope_fraction=0.25,
+            zero_centered_norms=True,
+            rope_theta=10_000_000.0,
         )
 
     @staticmethod
@@ -116,28 +187,57 @@ class Qwen3MoeDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: Array, cos: Array, sin: Array, mask: Optional[Array] = None
+        self,
+        x: Array,
+        cos: Array,
+        sin: Array,
+        mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
         cfg = self.config
-        attn_out = GroupedQueryAttention(
-            hidden_size=cfg.hidden_size,
-            num_heads=cfg.num_heads,
-            num_kv_heads=cfg.num_kv_heads,
-            head_dim=cfg.head_dim,
-            sdpa=self.sdpa,
-            qk_norm=cfg.qk_norm,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="self_attn",
-        )(
-            RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="input_layernorm")(x),
-            cos,
-            sin,
-            mask,
-        )
+        zc = cfg.zero_centered_norms
+        normed = RMSNorm(
+            cfg.hidden_size, eps=cfg.norm_eps, zero_centered=zc,
+            name="input_layernorm",
+        )(x)
+        if self.layer_idx in cfg.linear_attention_layers:
+            from d9d_tpu.nn.linear_attention import GatedDeltaNet
+
+            # GDN zeroes padded positions before the conv/recurrence (HF
+            # Qwen3Next's apply_mask_to_padding_states); the sdpa-style
+            # ``mask`` cannot express this, so padded batches must pass the
+            # [B, T] ``padding_mask`` alongside it
+            attn_out = GatedDeltaNet(
+                hidden_size=cfg.hidden_size,
+                num_qk_heads=cfg.gdn_qk_heads or cfg.num_kv_heads,
+                num_v_heads=cfg.gdn_v_heads or cfg.num_heads,
+                head_qk_dim=cfg.gdn_head_qk_dim or cfg.head_dim,
+                head_v_dim=cfg.gdn_head_v_dim or cfg.head_dim,
+                conv_size=cfg.gdn_conv_size,
+                norm_eps=cfg.norm_eps,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="linear_attn",
+            )(normed, padding_mask)
+        else:
+            attn_out = GroupedQueryAttention(
+                hidden_size=cfg.hidden_size,
+                num_heads=cfg.num_heads,
+                num_kv_heads=cfg.num_kv_heads,
+                head_dim=cfg.head_dim,
+                sdpa=self.sdpa,
+                qk_norm=cfg.qk_norm,
+                qk_norm_zero_centered=zc,
+                use_output_gate=cfg.use_output_gate,
+                rope_fraction=cfg.rope_fraction,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="self_attn",
+            )(normed, cos, sin, mask)
         x = x + attn_out
         h = RMSNorm(
-            cfg.hidden_size, eps=cfg.norm_eps, name="post_attention_layernorm"
+            cfg.hidden_size, eps=cfg.norm_eps, zero_centered=zc,
+            name="post_attention_layernorm",
         )(x)
         if self.layer_idx in cfg.mlp_only_layers:
             mlp_out = SwiGLU(
@@ -185,6 +285,7 @@ class Qwen3MoeBackbone(nn.Module):
         x: Array,
         positions: Array,
         mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
         cfg = self.config
         if self.stage.is_first:
@@ -199,14 +300,23 @@ class Qwen3MoeBackbone(nn.Module):
             x = x.astype(self.dtype)
         x = self._pin(x)
 
+        # partial rotary (rope_fraction < 1): frequencies are computed over
+        # the rotary dim, not head_dim (NeoX/Qwen3-Next semantics)
+        rotary_dim = int(cfg.head_dim * cfg.rope_fraction)
         inv_freq, att_scale = compute_rope_frequencies(
-            cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+            rotary_dim, cfg.rope_theta, cfg.rope_scaling
         )
         cos, sin = make_rope_cos_sin(positions, inv_freq, att_scale)
 
         layer_cls = Qwen3MoeDecoderLayer
         if cfg.remat:
-            layer_cls = nn.remat(Qwen3MoeDecoderLayer, prevent_cse=False)
+            from d9d_tpu.models.qwen3.dense import _remat_policy
+
+            layer_cls = nn.remat(
+                Qwen3MoeDecoderLayer,
+                prevent_cse=False,
+                policy=_remat_policy(cfg.remat_policy),
+            )
 
         for gid in distribute_layers_for_pipeline_stage(cfg.num_layers, self.stage):
             x = layer_cls(
@@ -216,11 +326,14 @@ class Qwen3MoeBackbone(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"layers_{gid}",
-            )(x, cos, sin, mask)
+            )(x, cos, sin, mask, padding_mask)
             x = self._pin(x)
 
         if self.stage.is_last:
-            x = RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="norm")(x)
+            x = RMSNorm(
+                cfg.hidden_size, eps=cfg.norm_eps,
+                zero_centered=cfg.zero_centered_norms, name="norm",
+            )(x)
         return x
 
 
@@ -259,16 +372,21 @@ class Qwen3MoeCausalLM(nn.Module):
         positions: Array,
         labels: Optional[Array] = None,
         mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
-        h = self.model(x, positions, mask)
+        h = self.model(x, positions, mask, padding_mask)
         if self.stage.is_last and labels is not None:
             return self.lm_head(h, labels)
         return h
 
     def logits(
-        self, x: Array, positions: Array, mask: Optional[Array] = None
+        self,
+        x: Array,
+        positions: Array,
+        mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
-        h = self.model(x, positions, mask)
+        h = self.model(x, positions, mask, padding_mask)
         if not self.stage.is_last:
             return h
         return self.lm_head.logits(h)

@@ -26,6 +26,8 @@ class GroupedQueryAttention(nn.Module):
     sdpa: SdpaBackend
     qk_norm: bool = False
     qk_norm_eps: float = 1e-6
+    # zero-centered qk-norm weights (Qwen3-Next style: scale = 1 + w)
+    qk_norm_zero_centered: bool = False
     rope_style: RopeStyle = RopeStyle.HALF
     rope_fraction: float = 1.0
     use_sinks: bool = False
@@ -65,8 +67,12 @@ class GroupedQueryAttention(nn.Module):
         v = proj(hkv * d, "v_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
 
         if self.qk_norm:
-            q = RMSNorm(d, eps=self.qk_norm_eps, name="q_norm", param_dtype=self.param_dtype)(q)
-            k = RMSNorm(d, eps=self.qk_norm_eps, name="k_norm", param_dtype=self.param_dtype)(k)
+            q = RMSNorm(d, eps=self.qk_norm_eps, name="q_norm",
+                        zero_centered=self.qk_norm_zero_centered,
+                        param_dtype=self.param_dtype)(q)
+            k = RMSNorm(d, eps=self.qk_norm_eps, name="k_norm",
+                        zero_centered=self.qk_norm_zero_centered,
+                        param_dtype=self.param_dtype)(k)
 
         # Partial RoPE: rotate the first `rot` dims, pass the rest through.
         # cos/sin must cover >= rot//2 frequencies; for NeoX-style partial

@@ -1,0 +1,164 @@
+"""HF parity for the Qwen3-Next hybrid family (GDN + gated attention + MoE
+with gated shared expert): load a transformers checkpoint through the
+mapper, compare logits; roundtrip back. Beyond-reference capability — the
+reference ships no hybrid family (SURVEY §2.4); the interop target is
+transformers' Qwen3Next directly."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.model_state import (
+    identity_mapper_from_names,
+    load_params,
+    read_model_state,
+    save_params,
+    write_model_state_local,
+)
+from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+from d9d_tpu.models.qwen3.huggingface_next import (
+    qwen3_next_from_hf_mapper,
+    qwen3_next_to_hf_mapper,
+)
+from d9d_tpu.nn.moe import SharedExpertParameters
+from d9d_tpu.ops.attention.eager import eager_sdpa
+
+transformers = pytest.importorskip("transformers")
+pytest.importorskip("torch")
+
+VOCAB = 128
+
+
+def _hf_model():
+    import torch
+
+    cfg = transformers.Qwen3NextConfig(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        partial_rotary_factor=0.25,
+        rope_theta=1_000_000.0,
+        linear_num_value_heads=4,
+        linear_num_key_heads=2,
+        linear_key_head_dim=16,
+        linear_value_head_dim=16,
+        linear_conv_kernel_dim=4,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=48,
+        shared_expert_intermediate_size=32,
+        decoder_sparse_step=1,
+        norm_topk_prob=True,
+        layer_types=[
+            "linear_attention",
+            "full_attention",
+            "linear_attention",
+            "full_attention",
+        ],
+        max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        router_aux_loss_coef=0.0,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3NextForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _our_config():
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", VOCAB),),
+        hidden_size=64,
+        num_layers=4,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        moe_intermediate_size=48,
+        num_experts=8,
+        num_experts_per_tok=2,
+        shared_expert=SharedExpertParameters(
+            intermediate_size=32, enable_gate=True
+        ),
+        norm_topk_prob=True,
+        rope_theta=1_000_000.0,
+        remat=False,
+        linear_attention_layers=(0, 2),
+        gdn_qk_heads=2,
+        gdn_v_heads=4,
+        gdn_head_qk_dim=16,
+        gdn_head_v_dim=16,
+        use_output_gate=True,
+        rope_fraction=0.25,
+        zero_centered_norms=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours(tmp_path_factory):
+    import flax.linen as nn
+
+    tmp_path = tmp_path_factory.mktemp("hf_next_ckpt")
+    hf = _hf_model()
+    state = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    write_model_state_local(
+        tmp_path, identity_mapper_from_names(state.keys()), iter(state.items())
+    )
+
+    cfg = _our_config()
+    model = Qwen3MoeCausalLM(config=cfg, sdpa=eager_sdpa, dtype=jnp.float32)
+    b, t = 2, 16
+    tokens = jnp.zeros((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    template = nn.unbox(
+        jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+        )
+    )
+    template = {"params": template["params"]}
+    params = load_params(
+        tmp_path, template, mapper=qwen3_next_from_hf_mapper(cfg)
+    )
+    return hf, model, params, cfg
+
+
+def test_logits_match_hf(hf_and_ours):
+    import torch
+
+    hf, model, params, cfg = hf_and_ours
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(0, VOCAB, size=(2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens_np)).logits.numpy()
+    positions = np.broadcast_to(np.arange(16), (2, 16)).astype(np.int32)
+    ours = model.apply(
+        params,
+        jnp.asarray(tokens_np, jnp.int32),
+        jnp.asarray(positions),
+        method=model.logits,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=5e-4, atol=5e-4
+    )
+
+
+def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
+    hf, model, params, cfg = hf_and_ours
+    save_params(tmp_path, params, mapper=qwen3_next_to_hf_mapper(cfg))
+    hf_state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    exported = dict(
+        read_model_state(tmp_path, identity_mapper_from_names(hf_state.keys()))
+    )
+    assert set(exported) == set(hf_state)
+    for k in hf_state:
+        np.testing.assert_allclose(
+            exported[k], hf_state[k], rtol=1e-6, atol=1e-6, err_msg=k
+        )

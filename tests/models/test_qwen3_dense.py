@@ -142,3 +142,38 @@ def test_hf_parity(tiny_cfg):
     with torch.no_grad():
         theirs = hf(torch.tensor(tokens_np)).logits.numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_remat_policy_variants_match(devices):
+    """remat off / full / dots_no_batch compute identical losses."""
+    import dataclasses
+
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+
+    base = dataclasses.replace(Qwen3DenseConfig.tiny(), remat=False)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 16)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+
+    def loss_and_grad(cfg):
+        model = Qwen3DenseCausalLM(config=cfg, sdpa=eager_sdpa, dtype=jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+        params = {"params": variables["params"]}
+        return jax.value_and_grad(
+            lambda p: model.apply(p, tokens, positions, tokens).mean()
+        )(params)
+
+    l0, g0 = loss_and_grad(base)
+    for policy in ("full", "dots_no_batch"):
+        cfg = dataclasses.replace(base, remat=True, remat_policy=policy)
+        l, g = loss_and_grad(cfg)
+        np.testing.assert_allclose(float(l), float(l0), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            ),
+            g,
+            g0,
+        )
