@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# One-shot on-chip bench capture: runs the three harnesses sequentially
+# (never concurrently — the TPU tunnel claims one process at a time) and
+# tees results into bench_results/. Fill BASELINE.md from these.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+echo "== bench.py (dense + MoE rows)"
+python bench.py | tee bench_results/bench.json
+echo "== kernel latency harness"
+python tools/bench_kernels.py | tee bench_results/kernels.jsonl
+echo "== pipeline schedule microbench"
+python tools/bench_pp.py | tee bench_results/pp.jsonl
+echo "done — see bench_results/"
