@@ -286,3 +286,50 @@ def test_pp_lora_trains_adapters_only(devices):
     assert any("lm_head" in n for n in names)
     for layer in range(CFG.num_layers):
         assert any(f"layers_{layer}" in n for n in names)
+
+
+def test_pp_hybrid_linear_attention_trains(devices):
+    """Hybrid GDN:attention stacks compose with pipeline parallelism: the
+    stage splitter assigns whole layers, so GDN layers pipeline like any
+    other (beyond-reference family; BASELINE config 5)."""
+    from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+
+    ctx = MeshParameters(pp=2, dp_shard=2).build(devices[:4])
+
+    class HybridProvider(Provider):
+        def build_module(self, stage):
+            return Qwen3MoeCausalLM(
+                config=Qwen3MoeConfig.hybrid_tiny(vocab_size=VOCAB),
+                sdpa=build_sdpa_backend(),
+                stage=stage,
+                dtype=jnp.float32,
+            )
+
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=16,
+            microbatch_size=4,
+            seq_len=16,
+            total_steps=3,
+            log_every=1,
+            learning_rate=5e-3,
+        ),
+        model_provider=HybridProvider(fsdp=True),
+        dataset_provider=Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    hist = trainer.train()
+    assert len(hist) == 3
+    assert all(np.isfinite(h["loss"]) for h in hist)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    # both param families present across the merged stages
+    names = {
+        "/".join(str(k) for k in path)
+        for path, _ in jax.tree_util.tree_leaves_with_path(
+            trainer.merged_params()
+        )
+    }
+    assert any("linear_attn" in n for n in names)
+    assert any("self_attn" in n for n in names)
