@@ -120,3 +120,56 @@ class TestGatedDeltaNet:
         dt_bias = params["params"]["decay_gate"]["dt_bias"]
         dt = np.asarray(jax.nn.softplus(dt_bias))
         assert (dt >= 1e-4 - 1e-9).all() and (dt <= 0.2).all()
+
+
+def test_mla_with_ring_attention_matches_eager(devices):
+    """MLA composes with context-parallel ring attention (long-context
+    path for the latent-attention family): same outputs and grads as the
+    eager backend on the gathered sequence."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from d9d_tpu.core import MeshParameters
+    from d9d_tpu.ops.attention.ring import make_ring_sdpa
+
+    ctx = MeshParameters(cp_shard=4).build(devices[:4])
+    ring = make_ring_sdpa(
+        ctx.mesh, seq_axis="cp_s", batch_axes=(), head_axes=()
+    )
+
+    def block(sdpa):
+        return MultiHeadLatentAttention(
+            hidden_size=64,
+            num_heads=4,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=12,
+            kv_lora_rank=32,
+            sdpa=sdpa,
+            dtype=jnp.float32,
+        )
+
+    b, t = 2, 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, t, 64))
+    cos, sin = _rope(b, t, 8)
+    params = block(eager_sdpa).init(jax.random.PRNGKey(1), x, cos, sin)
+
+    def loss_eager(p, x):
+        return jnp.sum(jnp.sin(block(eager_sdpa).apply(p, x, cos, sin)))
+
+    x_sharded = jax.device_put(
+        x, NamedSharding(ctx.mesh, P(None, "cp_s", None))
+    )
+
+    def loss_ring(p, x):
+        return jnp.sum(jnp.sin(block(ring).apply(p, x, cos, sin)))
+
+    l_e, g_e = jax.value_and_grad(loss_eager)(params, x)
+    l_r, g_r = jax.jit(jax.value_and_grad(loss_ring))(params, x_sharded)
+    np.testing.assert_allclose(float(l_r), float(l_e), rtol=1e-4, atol=1e-4)
+    jax.tree.map(
+        lambda a, b_: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5
+        ),
+        g_r,
+        g_e,
+    )
