@@ -65,6 +65,11 @@ def bench_sdpa(tiny):
         from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
 
         providers["pallas_flash"] = make_pallas_flash_sdpa()
+        # block-size sweep: the default 512x512 is a guess, not a tune
+        for bq, bkv in ((256, 512), (512, 256), (1024, 512), (256, 256)):
+            providers[f"pallas_flash_q{bq}_kv{bkv}"] = make_pallas_flash_sdpa(
+                block_q=bq, block_kv=bkv
+            )
 
     for b, t, hq, hkv, d in shapes:
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)

@@ -66,7 +66,10 @@ def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
     return engine
 
 
-def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps):
+def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
+            trace_dir=None):
+    import contextlib
+
     import jax
     import numpy as np
 
@@ -86,21 +89,33 @@ def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps):
             microbatch_size=microbatch,
         )
 
+    # warmup (incl. compilation) stays OUTSIDE the trace so the capture
+    # holds only steady-state steps — the dispatch gaps worth inspecting
     for _ in range(warmup):
         m = engine.step(make_microbatches())
     jax.block_until_ready(m["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.step(make_microbatches())
-    jax.block_until_ready(m["loss"])
-    return (time.perf_counter() - t0) / steps
+    trace_cm = (
+        jax.profiler.trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    )
+    with trace_cm:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = engine.step(make_microbatches())
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+    return dt / steps
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true", help="CPU smoke config")
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler trace per combination into DIR/<name> "
+        "(inspect executor dispatch gaps / overlap in xprof)",
+    )
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -150,6 +165,7 @@ def main():
         dt = measure(
             engine, batch=batch, microbatch=microbatch, seq_len=seq_len,
             vocab=cfg.vocab_size, warmup=warmup, steps=steps,
+            trace_dir=f"{args.profile}/{name}_{policy}" if args.profile else None,
         )
         tok_s = batch * seq_len / dt
         row = {
