@@ -410,6 +410,7 @@ class Qwen3MoeForClassification(nn.Module):
         positions: Array,
         pooling_mask: Optional[Array] = None,
         mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
         h = Qwen3MoeBackbone(
             config=self.config,
@@ -419,7 +420,7 @@ class Qwen3MoeForClassification(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="model",
-        )(x, positions, mask)
+        )(x, positions, mask, padding_mask)
         if not self.stage.is_last:
             return h
         if pooling_mask is None:
@@ -452,6 +453,7 @@ class Qwen3MoeForEmbedding(nn.Module):
         positions: Array,
         pooling_mask: Optional[Array] = None,
         mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
     ) -> Array:
         h = Qwen3MoeBackbone(
             config=self.config,
@@ -461,7 +463,7 @@ class Qwen3MoeForEmbedding(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="model",
-        )(x, positions, mask)
+        )(x, positions, mask, padding_mask)
         if not self.stage.is_last:
             return h
         return EmbeddingHead()(h, pooling_mask)

@@ -81,6 +81,9 @@ class ModelConfig(pydantic.BaseModel):
     num_experts_per_tok: int
     remat: bool = True
     dtype: str = "bfloat16"
+    # hybrid GDN:attention stacks (Qwen3-Next style) — e.g. [0, 1, 2] puts
+    # linear attention on those layers; [] keeps pure attention
+    linear_attention_layers: list[int] = []
 
 
 class DataConfig(pydantic.BaseModel):
@@ -203,6 +206,7 @@ class MoEProvider(ModelProvider):
                 num_experts=c.num_experts,
                 num_experts_per_tok=c.num_experts_per_tok,
                 remat=c.remat,
+                linear_attention_layers=tuple(c.linear_attention_layers),
                 ep_axes=self.ctx.ep_shard_axes,
                 # ride the residual layout through the EP dispatch (no
                 # boundary reshard; see MoELayer.token_axes)
