@@ -10,6 +10,30 @@ from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.ops import LM_IGNORE_INDEX
 
 
+def _moe_load_metrics(updates: PyTree) -> dict[str, Array]:
+    """Expert load-balance statistics from sown ``moe_stats``
+    (reference tokens_per_expert buffer, module/block/moe/layer.py:16).
+
+    Emits the raw per-expert assignment-count vector (summed over layers)
+    so the engine's microbatch scan sums it exactly; the max/total ratio
+    is taken host-side in ``metrics_postprocess`` — taking max per
+    microbatch first would bias the share upward with small microbatches.
+    Covers the logged step (not the whole log window). Single-program path
+    only: under pipeline parallelism the executor's metric channel carries
+    last-stage loss statistics and this metric is absent. Empty dict for
+    dense models."""
+    stats = updates.get("moe_stats") if updates else None
+    if not stats:
+        return {}
+    counts = [
+        (leaf[0] if isinstance(leaf, tuple) else leaf).astype(jnp.float32)
+        for leaf in jax.tree.leaves(
+            stats, is_leaf=lambda x: isinstance(x, tuple)
+        )
+    ]
+    return {"moe_tokens_per_expert": sum(counts)}
+
+
 class CausalLMTask(PipelineTrainTask):
     """Next-token prediction with token-count loss weighting.
 
@@ -35,11 +59,27 @@ class CausalLMTask(PipelineTrainTask):
     def loss_fn(
         self, module: nn.Module, params: PyTree, mb: PyTree, rng: Array
     ) -> tuple[Array, Array, dict[str, Array]]:
-        per_token = module.apply(params, mb["tokens"], mb["positions"], mb["labels"])
+        per_token, updates = module.apply(
+            params, mb["tokens"], mb["positions"], mb["labels"],
+            mutable=["moe_stats"],
+        )
         valid = (mb["labels"] != LM_IGNORE_INDEX).astype(jnp.float32)
         loss_sum = per_token.sum()
         weight = valid.sum()
-        return loss_sum, weight, {"tokens": weight}
+        metrics = {"tokens": weight}
+        metrics.update(_moe_load_metrics(updates))
+        return loss_sum, weight, metrics
+
+    def metrics_postprocess(self, metrics):
+        counts = metrics.pop("task/moe_tokens_per_expert", None)
+        if counts is not None:
+            counts = np.asarray(counts, np.float64)
+            # heaviest expert's share of routed assignments (layer-summed);
+            # 1/num_experts = perfectly balanced routing
+            metrics["task/moe_load_max_frac"] = float(
+                counts.max() / max(counts.sum(), 1.0)
+            )
+        return metrics
 
     # -- pipeline surface (PipelineTrainTask) --------------------------
     # carry = token ids on stage 0, hidden states after; positions ride

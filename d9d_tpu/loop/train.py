@@ -306,14 +306,22 @@ class Trainer:
                         jax.block_until_ready(metrics)
                     self.timeout.set_periodic()
                     if step % self.config.log_every == 0 or self.stepper.finished:
-                        # scalars only: non-scalar stats (e.g. per-class
-                        # confusion counts) are metric-collector fodder
+                        # postprocess sees everything (it may derive scalars
+                        # from vector stats, e.g. expert-load counts); only
+                        # scalars survive into history/tracker — remaining
+                        # vectors (e.g. per-class confusion counts) are
+                        # metric-collector fodder
                         host_metrics = {
-                            k: float(arr)
+                            k: float(arr) if (arr := np.asarray(v)).ndim == 0
+                            else arr
                             for k, v in metrics.items()
-                            if (arr := np.asarray(v)).ndim == 0
                         }
                         host_metrics = self.task.metrics_postprocess(host_metrics)
+                        host_metrics = {
+                            k: float(v)
+                            for k, v in host_metrics.items()
+                            if np.ndim(v) == 0
+                        }
                         host_metrics.update(
                             self.metric_collector.flush(self.run, step)
                         )

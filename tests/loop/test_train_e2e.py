@@ -129,3 +129,74 @@ def test_weighted_loss_ignores_masked_tokens():
     history = trainer.train()
     # 8 rows x 3 valid label positions (mask shifts by 1) = 24
     assert history[-1]["loss_weight"] == 24.0
+
+
+def test_moe_training_reports_expert_load_balance(devices):
+    """MoE runs surface the tokens_per_expert load statistic (reference
+    buffer, module/block/moe/layer.py:16) as task/moe_load_max_frac —
+    the heaviest expert's share of routed assignments."""
+    import jax.numpy as jnp
+
+    from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+
+    class MoEProvider(ModelProvider):
+        def build_module(self, stage):
+            return Qwen3MoeCausalLM(
+                config=Qwen3MoeConfig.tiny(vocab_size=VOCAB),
+                sdpa=eager_sdpa,
+                stage=stage,
+                dtype=jnp.float32,
+            )
+
+        def build_plan(self, ctx):
+            return replicate_plan(ctx)
+
+        def sample_inputs(self, batch_size, seq_len):
+            z = np.zeros((batch_size, seq_len), np.int32)
+            return (z, z, z)
+
+    class Data(DatasetProvider):
+        def build(self):
+            rng = np.random.RandomState(0)
+            for _ in range(2):
+                yield {"input_ids": rng.randint(0, VOCAB, size=(8, 17))}
+
+    ctx = MeshParameters(dp_shard=4).build(devices[:4])
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=8, microbatch_size=4, seq_len=16,
+            total_steps=2, log_every=1,
+        ),
+        model_provider=MoEProvider(),
+        dataset_provider=Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    hist = trainer.train()
+    frac = hist[-1]["task/moe_load_max_frac"]
+    # 8 experts, top-2 routing: heaviest share ∈ [1/8, 1]
+    assert 1.0 / 8 - 1e-6 <= frac <= 1.0
+    # dense runs must NOT carry the metric
+    assert "task/moe_load_max_frac" not in _dense_history(devices)[-1]
+
+
+def _dense_history(devices):
+    ctx = MeshParameters(dp_shard=4).build(devices[:4])
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=8, microbatch_size=8, seq_len=8,
+            total_steps=1, log_every=1,
+        ),
+        model_provider=TinyModelProvider(),
+        dataset_provider=_OneBatch(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    return trainer.train()
+
+
+class _OneBatch(DatasetProvider):
+    def build(self):
+        yield {"input_ids": np.arange(8 * 9).reshape(8, 9) % VOCAB}

@@ -113,12 +113,22 @@ def test_moe_layer_tokens_per_expert_stats(ctx):
     assert int(np.asarray(tpe).sum()) == 2 * 8 * 2
 
 
-def test_ep_token_layout_matches_local(ctx):
+@pytest.mark.parametrize(
+    "mesh_kw",
+    [
+        {"dp_shard": 4, "tp": 2, "ep_shard": 8},
+        # cp in the token axes AND the ep suffix: t@cp_s flatten path
+        {"dp_shard": 2, "cp_shard": 2, "tp": 2, "ep_shard": 4},
+    ],
+    ids=["dp_tp", "dp_cp_tp"],
+)
+def test_ep_token_layout_matches_local(mesh_kw):
     """The token-layout EP flow (shard_map riding the residual
     [B@dp, T@cp, D] sharding, non-token ep axes subdividing ownership)
     computes the same loss/grads as the local path."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    ctx = MeshParameters(**mesh_kw).build(jax.devices())
     tokens, positions = _inputs()
     local = _model()
     variables = local.init(jax.random.PRNGKey(0), tokens, positions, tokens)
@@ -127,7 +137,7 @@ def test_ep_token_layout_matches_local(ctx):
 
     import dataclasses
 
-    # thread the residual layout: batch over dp, no cp in this mesh
+    # thread the residual layout (batch over dp; t over cp_s when present)
     cfg = dataclasses.replace(
         Qwen3MoeConfig.tiny(ep_axes=ctx.ep_shard_axes),
         moe_token_axes=(ctx.batch_axes, ctx.sequence_axes),
