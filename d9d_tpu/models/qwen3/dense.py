@@ -124,7 +124,7 @@ class Qwen3DenseCausalLM(nn.Module):
     config: Qwen3DenseConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
-    ce_chunk_size: int = 2048
+    ce_chunk_size: int = 512
     act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

@@ -343,7 +343,7 @@ class Qwen3MoeCausalLM(nn.Module):
     config: Qwen3MoeConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
-    ce_chunk_size: int = 2048
+    ce_chunk_size: int = 512
     act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

@@ -21,7 +21,7 @@ class SdpaPallasFlashConfig(pydantic.BaseModel):
     """Pallas flash-attention kernel (TPU only)."""
 
     type: Literal["pallas_flash"] = "pallas_flash"
-    block_q: int = 512
+    block_q: int = 1024
     block_kv: int = 512
 
 

@@ -445,8 +445,15 @@ def _zero_cotangent(x):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def make_pallas_flash_sdpa(block_q: int = 512, block_kv: int = 512):
-    """Build an SdpaBackend backed by the Pallas flash kernel."""
+def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
+    """Build an SdpaBackend backed by the Pallas flash kernel.
+
+    Default block sizes follow the r3 on-chip sweep (tools/bench_kernels.py,
+    BASELINE.md): 1024x512 won fwd+bwd at every swept shape (t=2048/8192
+    d=64, t=4096 d=128) over 512x512 and the smaller tilings; blocks are
+    clamped to the padded sequence length below, so small inputs are
+    unaffected.
+    """
 
     def sdpa(
         q: Array,

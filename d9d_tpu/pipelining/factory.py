@@ -55,20 +55,27 @@ class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
     stages_per_rank: int = 1
 
 
+# Zero-bubble schedules default to cache_full per the r3 on-chip
+# microbench (tools/bench_pp.py, BASELINE.md): with 2 virtual stages on one
+# chip, zb1p/remat ran 30% slower than 1F1B (each dI and dW phase recomputes
+# the stage forward) while zb1p/cache_full tied it. remat remains available
+# for memory-bound real-PP runs where filling bubbles with W-compute pays.
+
+
 class ZeroBubble1PScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_1p"] = "zero_bubble_1p"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full"] = "cache_full"
     stages_per_rank: int = 1
 
 
 class ZeroBubbleVScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_v"] = "zero_bubble_v"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full"] = "cache_full"
 
 
 class DualPipeVScheduleConfig(pydantic.BaseModel):
     kind: Literal["dual_pipe_v"] = "dual_pipe_v"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full"] = "cache_full"
 
 
 PipelineScheduleConfig = Annotated[

@@ -14,29 +14,19 @@ kernel variants:
 
 Run on the TPU chip:   python tools/bench_kernels.py
 CPU smoke:             JAX_PLATFORMS=cpu python tools/bench_kernels.py --tiny
-Prints one JSON line per (bench, provider, config): median ms over reps.
+Prints one JSON line per (bench, provider, config): mean ms/call over a
+drained dispatch queue (see timeit), or an error line if the case OOMs.
 BASELINE.md records the measured winners; ops defaults follow them.
 """
 
 import argparse
 import json
-import time
+import pathlib
+import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-def timeit(fn, *args, reps=20, warmup=3):
-    import jax
-
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2] * 1e3  # median ms
+from tools.benchtime import timeit  # noqa: E402 — needs the path bootstrap
 
 
 def emit(bench, provider, config, ms):
@@ -47,6 +37,35 @@ def emit(bench, provider, config, ms):
         ),
         flush=True,
     )
+
+
+def emit_timed(bench, provider, config, fn, *args, **kw):
+    """emit() a timing, or an error line if this case doesn't fit the chip
+    (e.g. eager SDPA at t=8192 materializes >16 GB of score tensors and
+    OOMs HBM — that's a result worth recording, not a harness crash).
+    timeit returning None (RTT jitter swamped the signal) is reported as
+    an error line too, never as a fake 0 ms."""
+    try:
+        ms = timeit(fn, *args, **kw)
+    except Exception as e:  # noqa: BLE001 — record chip-side failures
+        print(
+            json.dumps(
+                {"bench": bench, "provider": provider, "config": config,
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            ),
+            flush=True,
+        )
+        return
+    if ms is None:
+        print(
+            json.dumps(
+                {"bench": bench, "provider": provider, "config": config,
+                 "error": "unmeasurable: fetch-RTT jitter exceeded signal"}
+            ),
+            flush=True,
+        )
+    else:
+        emit(bench, provider, config, ms)
 
 
 def bench_sdpa(tiny):
@@ -79,13 +98,13 @@ def bench_sdpa(tiny):
         cfg = f"b{b}_t{t}_h{hq}:{hkv}_d{d}"
         for name, sdpa in providers.items():
             fwd = jax.jit(lambda q, k, v, f=sdpa: f(q, k, v, causal=True))
-            emit("sdpa_fwd", name, cfg, timeit(fwd, q, k, v))
+            emit_timed("sdpa_fwd", name, cfg, fwd, q, k, v)
 
             def loss(q, k, v, f=sdpa):
                 return jnp.sum(f(q, k, v, causal=True).astype(jnp.float32))
 
             bwd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            emit("sdpa_fwd_bwd", name, cfg, timeit(bwd, q, k, v))
+            emit_timed("sdpa_fwd_bwd", name, cfg, bwd, q, k, v)
 
 
 def bench_linear_ce(tiny):
@@ -120,11 +139,11 @@ def bench_linear_ce(tiny):
             )
     cfg = f"n{n}_d{d}_v{v}"
     for name, fn in variants.items():
-        emit("linear_ce_fwd", name, cfg, timeit(fn, h, w, labels))
+        emit_timed("linear_ce_fwd", name, cfg, fn, h, w, labels)
         grad = jax.jit(
             jax.grad(lambda h, w, l, f=fn: jnp.sum(f(h, w, l)), argnums=(0, 1))
         )
-        emit("linear_ce_fwd_bwd", name, cfg, timeit(grad, h, w, labels))
+        emit_timed("linear_ce_fwd_bwd", name, cfg, grad, h, w, labels)
 
 
 def bench_elementwise(tiny):
@@ -137,10 +156,10 @@ def bench_elementwise(tiny):
     x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.bfloat16)
     y = jax.random.normal(jax.random.PRNGKey(1), (n, d), jnp.bfloat16)
     w = jnp.ones((d,), jnp.float32)
-    emit("rms_norm", "jnp_fused", f"n{n}_d{d}",
-         timeit(jax.jit(lambda x, w: rms_norm(x, w)), x, w))
-    emit("silu_mul", "jnp_fused", f"n{n}_d{d}",
-         timeit(jax.jit(silu_mul), x, y))
+    emit_timed("rms_norm", "jnp_fused", f"n{n}_d{d}",
+               jax.jit(lambda x, w: rms_norm(x, w)), x, w)
+    emit_timed("silu_mul", "jnp_fused", f"n{n}_d{d}",
+               jax.jit(silu_mul), x, y)
 
 
 def bench_stochastic(tiny):
@@ -155,12 +174,12 @@ def bench_stochastic(tiny):
     n = 4096 if tiny else 1 << 24
     x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
     key = jax.random.PRNGKey(1)
-    emit("stochastic_round", "jnp_bit_twiddle", f"n{n}",
-         timeit(jax.jit(stochastic_round_to_bf16), x, key))
+    emit_timed("stochastic_round", "jnp_bit_twiddle", f"n{n}",
+               jax.jit(stochastic_round_to_bf16), x, key)
     if jax.default_backend() == "tpu":
         seed = jnp.uint32(7)
-        emit("stochastic_round", "pallas_prng", f"n{n}",
-             timeit(jax.jit(stochastic_round_to_bf16_pallas), x, seed))
+        emit_timed("stochastic_round", "pallas_prng", f"n{n}",
+                   jax.jit(stochastic_round_to_bf16_pallas), x, seed)
 
 
 def main():
@@ -172,6 +191,12 @@ def main():
     )
     args = ap.parse_args()
     import jax
+
+    if args.tiny:
+        # --tiny is the CPU smoke: force the platform programmatically —
+        # the container's sitecustomize registers the axon TPU backend at
+        # interpreter startup, so the JAX_PLATFORMS env var is ignored
+        jax.config.update("jax_platforms", "cpu")
 
     print(json.dumps({"device": jax.devices()[0].device_kind,
                       "backend": jax.default_backend()}), flush=True)

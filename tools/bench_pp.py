@@ -18,7 +18,11 @@ records the measured numbers.
 
 import argparse
 import json
+import pathlib
+import sys
 import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
@@ -68,7 +72,6 @@ def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
 
 def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
             trace_dir=None):
-    import contextlib
 
     import jax
     import numpy as np
@@ -89,27 +92,44 @@ def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
             microbatch_size=microbatch,
         )
 
-    del contextlib  # timing and tracing are separate passes below
+    # warmup (incl. compilation) first. Sync via host fetches (see
+    # tools/benchtime.py: block_until_ready lies through the axon tunnel —
+    # r3: zb1p "measured" 4.6x faster than 1f1b because the loss fetched
+    # early while W-phase work was still queued). The loss fetch alone only
+    # drains up to the loss computation; the optimizer update of the final
+    # step trails it, so fetch a param leaf per stage too — those transfer
+    # AFTER the update in queue order.
+    from tools.benchtime import host_fetch_sync
 
-    # warmup (incl. compilation) first
+    def drain(m):
+        float(m["loss"])
+        for rt in engine.stages.values():
+            host_fetch_sync(rt.params)
+
     for _ in range(warmup):
         m = engine.step(make_microbatches())
-    jax.block_until_ready(m["loss"])
+    drain(m)
+    # drain() itself costs several sequential fetch round-trips (~70 ms
+    # each through the tunnel); measure it on the already-materialized
+    # state and subtract from the timed window below
+    t0 = time.perf_counter()
+    drain(m)
+    drain_cost = time.perf_counter() - t0
 
     # timed loop runs UNPROFILED — per-op trace collection would inflate
     # the step times this harness records in BASELINE.md
     t0 = time.perf_counter()
     for _ in range(steps):
         m = engine.step(make_microbatches())
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    drain(m)
+    dt = time.perf_counter() - t0 - drain_cost
 
     if trace_dir:
         # separate short traced pass: steady-state dispatch gaps only
         with jax.profiler.trace(trace_dir):
             for _ in range(min(steps, 3)):
                 m = engine.step(make_microbatches())
-            jax.block_until_ready(m["loss"])
+            drain(m)
     return dt / steps
 
 
@@ -123,6 +143,14 @@ def main():
         "(inspect executor dispatch gaps / overlap in xprof)",
     )
     args = ap.parse_args()
+
+    if args.tiny:
+        # --tiny is the CPU smoke: force the platform programmatically —
+        # the container's sitecustomize registers the axon TPU backend at
+        # interpreter startup, so the JAX_PLATFORMS env var is ignored
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
 
@@ -157,7 +185,8 @@ def main():
         ("1f1b", "remat",
          Interleaved1F1BScheduleConfig(stages_per_rank=2)),
         ("zb1p", "remat",
-         ZeroBubble1PScheduleConfig(stages_per_rank=2)),
+         ZeroBubble1PScheduleConfig(
+             stages_per_rank=2, residual_policy="remat")),
         ("zb1p", "cache_full",
          ZeroBubble1PScheduleConfig(
              stages_per_rank=2, residual_policy="cache_full")),

@@ -1,0 +1,57 @@
+"""Shared timing helpers for benchmarking through the axon TPU tunnel.
+
+`jax.block_until_ready` returns before remote execution finishes through
+the tunnel (r3 measured a chained 1.1-TFLOP matmul at 0.02 ms "per call"
+under it), so every harness here syncs by fetching a value to the host —
+a device->host transfer drains the device's in-order execution queue for
+real. The fetch itself costs a ~70 ms round-trip, which the helpers
+measure (median of several samples on an already-materialized value) and
+subtract, or amortize over enough reps that it vanishes.
+
+One module so the methodology can't drift between harnesses again
+(r3 review: three hand-rolled copies had already diverged).
+"""
+
+import time
+
+
+def host_fetch_sync(out):
+    """Force completion of everything dispatched so far by fetching one
+    element of ``out`` (any pytree of jax arrays) to the host."""
+    import jax
+    import numpy as np
+
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jax.device_get(leaf if leaf.ndim == 0 else leaf.ravel()[0]))
+
+
+def measure_rtt(out, samples: int = 3) -> float:
+    """Median seconds for a host fetch of an already-materialized value —
+    the fixed overhead to subtract from fetch-synced timings. Multiple
+    samples because single-shot tunnel RTT jitters by tens of ms."""
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        host_fetch_sync(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def timeit(fn, *args, reps: int = 50, warmup: int = 3):
+    """Mean ms/call over ``reps`` back-to-back dispatches with ONE host
+    fetch at the end, RTT-corrected. Returns None when the corrected time
+    is not positive (RTT jitter swamped the signal — the caller should
+    report the case as unmeasurable rather than 0 ms)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    host_fetch_sync(out)
+    rtt = measure_rtt(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    host_fetch_sync(out)
+    dt = time.perf_counter() - t0 - rtt
+    if dt <= 0:
+        return None
+    return dt / reps * 1e3  # mean ms/call

@@ -4,6 +4,9 @@
 # tees results into bench_results/. Fill BASELINE.md from these.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+# tools/*.py import d9d_tpu; sys.path[0] is tools/, so the repo root must
+# be on PYTHONPATH explicitly
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p bench_results
 echo "== bench.py (dense + MoE rows)"
 python bench.py | tee bench_results/bench.json
