@@ -204,6 +204,8 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
     (example/qwen3_moe/pretrain.json:57-80: 16 layers, 128 experts, top-8,
     hidden 768), sized to fit one chip's HBM.
     """
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -263,6 +265,8 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
             num_experts=64,
             num_experts_per_tok=8,
             remat=True,
+            # tuning knob for on-chip sweeps, like the dense row's
+            remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
         )
         seq_len, batch = 2048, 8
         steps_warmup, steps_measure = 3, 10
@@ -276,8 +280,6 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
     # bf16 moments (the reference's own optimizer family) cuts optimizer
     # state to 2.7G, which fits microbatch 2 — set D9D_BENCH_MOE_UB=2 to
     # run that variant; the recorded row is the validated microbatch-1 one.
-    import os
-
     microbatch = batch if tiny else int(os.environ.get("D9D_BENCH_MOE_UB", "1"))
 
     class Provider(ModelProvider):
@@ -285,6 +287,12 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
             return Qwen3MoeCausalLM(
                 config=cfg, sdpa=build_sdpa_backend(), stage=stage,
                 dtype=dtype,
+                # the microbatch>=2 variant runs the reference's flagship
+                # recipe — bf16 master weights + stochastic-rounding AdamW
+                # — which also removes the per-traversal fp32->bf16 cast
+                # of every weight (2.7G of fp32 reads per pass)
+                param_dtype=jnp.float32 if microbatch <= 1 or tiny
+                else jnp.bfloat16,
                 # at microbatch 1 the CCE input is only 2048 tokens: one
                 # big chunk beats the global 512 default (which wins at
                 # n=16384; r3: 25.3k vs 24.5k tok/s for this config).

@@ -153,10 +153,13 @@ def init_distributed(
 
     if not cfg.is_explicit:
         # No explicit coordinator. On Cloud TPU pods jax auto-detects from
-        # the TPU metadata; elsewhere there is nothing to do.
-        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-            "MEGASCALE_COORDINATOR_ADDRESS"
-        ):
+        # the TPU metadata; elsewhere there is nothing to do. A single
+        # entry in TPU_WORKER_HOSTNAMES means one host (some single-chip
+        # containers set it to "localhost") — auto-init only for >1 worker,
+        # where a coordinator actually exists to be detected.
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi_worker = len([h for h in hostnames.split(",") if h.strip()]) > 1
+        if multi_worker or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
             jax.distributed.initialize(
                 initialization_timeout=initialization_timeout_s
             )

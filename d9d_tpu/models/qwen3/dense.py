@@ -31,11 +31,27 @@ from d9d_tpu.pipelining import (
 
 
 def _remat_policy(name: str):
-    """Map a config string to a jax.checkpoint policy (None = save nothing)."""
+    """Map a config string to a jax.checkpoint policy (None = save nothing).
+
+    ``save_expensive`` keeps every plain matmul output (dots-no-batch-dims)
+    PLUS the named expensive ops the stock dot policies can't see — the
+    Pallas flash output ("sdpa_out") and the MoE grouped-matmul outputs and
+    their permuted input rows ("moe_grouped_dot"/"moe_permuted_rows") —
+    so backward recomputes only cheap elementwise work. Costs activation
+    memory proportional to layer width; "full" remains the default for
+    memory-bound configs.
+    """
     if name == "full":
         return None
     if name == "dots_no_batch":
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if name == "save_expensive":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            jax.checkpoint_policies.save_only_these_names(
+                "sdpa_out", "moe_grouped_dot", "moe_permuted_rows"
+            ),
+        )
     raise ValueError(f"unknown remat_policy {name!r}")
 
 

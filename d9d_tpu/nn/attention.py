@@ -10,6 +10,7 @@ from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn import logical_axes as la
@@ -118,6 +119,9 @@ class GroupedQueryAttention(nn.Module):
             sinks=sinks,
             mask=mask,
         )
+        # named so the "save_expensive" remat policy can keep the flash
+        # kernel's output instead of re-running it in the backward pass
+        attn = checkpoint_name(attn, "sdpa_out")
 
         out = attn.reshape(b, t, h * d)
         if self.use_output_gate:
@@ -250,6 +254,7 @@ class MultiHeadLatentAttention(nn.Module):
         out = self.sdpa(
             q, k, v, causal=True, softmax_scale=d_qk**-0.5, mask=mask
         )
+        out = checkpoint_name(out, "sdpa_out")
         if pad > 0:
             out = out[..., :d_v]
         out = out.reshape(b, t, h * d_v)

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from d9d_tpu.core.types import Array
+from d9d_tpu.ops.moe import stable_expert_order
 
 __all__ = ["ep_buffer_rows", "ep_dispatch_compute_combine"]
 
@@ -117,14 +118,15 @@ def ep_dispatch_compute_combine(
     d_model = x_loc.shape[-1]
     me = lax.axis_index(ep_axes)
 
-    # 1. sort assignment rows by global expert id
+    # 1. group assignment rows by global expert id (sort-free stable
+    # permutation — see ops/moe.py stable_expert_order; TPU sorts are
+    # bitonic and this runs per MoE layer per microbatch)
     ids_flat = ids_loc.reshape(-1)
-    order = jnp.argsort(ids_flat, stable=True)  # [m]
+    order, _, counts = stable_expert_order(ids_flat, e_loc * ep_world)
     token_of = order // k
     x_rows = jnp.take(x_loc, token_of, axis=0)  # [m, D]
 
     # 2. tiny count exchange: S[s, e] = rows shard s routes to expert e
-    counts = jnp.bincount(ids_flat, length=e_loc * ep_world)
     S = lax.all_gather(counts, ep_axes, axis=0)  # [W, E]
     # rows shard s sends to shard d
     R = S.reshape(ep_world, ep_world, e_loc).sum(axis=-1)  # [W(src), W(dst)]
@@ -177,9 +179,8 @@ def ep_dispatch_compute_combine(
     labels = (q[:, None] >= jnp.take(incl, src_of, axis=0)).sum(axis=1)
     labels = jnp.clip(labels, 0, e_loc - 1)  # padding rows → last group
 
-    by_expert = jnp.argsort(labels, stable=True)
+    by_expert, _, group_sizes = stable_expert_order(labels, e_loc)
     rows_sorted = jnp.take(recv, by_expert, axis=0)
-    group_sizes = jnp.bincount(labels, length=e_loc).astype(jnp.int32)
 
     y_sorted = expert_fn(rows_sorted, group_sizes)
     y_buf = jnp.zeros_like(y_sorted).at[by_expert].set(y_sorted)

@@ -26,13 +26,48 @@ class TokenSort(NamedTuple):
 
     sort_idx: [N*K] position in the flattened (token-major) pair array for
         each sorted row; row r of the permuted layout is pair sort_idx[r].
+    dest: [N*K] inverse permutation — where pair i lands in the sorted
+        layout (``dest[sort_idx[r]] == r``).
     token_idx: [N*K] owning token of each sorted row (= sort_idx // K).
     group_sizes: [E] rows per expert, in sorted order.
     """
 
     sort_idx: Array
+    dest: Array
     token_idx: Array
     group_sizes: Array
+
+
+def stable_expert_order(
+    flat_ids: Array, num_experts: int
+) -> tuple[Array, Array, Array]:
+    """Stable grouping permutation over expert ids WITHOUT a sort.
+
+    Returns ``(sort_idx [M], dest [M], group_sizes [E])`` where
+    ``flat_ids[sort_idx]`` is grouped by expert with original order
+    preserved within each group — exactly ``argsort(flat_ids, stable=True)``
+    — and ``dest`` is the inverse permutation (where row i lands). Computed
+    as one-hot → cumsum → scatter. TPU sorts lower to bitonic networks
+    (log² passes); a log-depth cumsum over the [M, E] one-hot plus one
+    scatter is much cheaper at MoE shapes, and the MoE layer runs this per
+    layer per microbatch.
+    """
+    m = flat_ids.shape[0]
+    one_hot = (
+        flat_ids[:, None] == jnp.arange(num_experts, dtype=flat_ids.dtype)
+    ).astype(jnp.int32)
+    prefix = jnp.cumsum(one_hot, axis=0)  # inclusive per-expert counts
+    group_sizes = prefix[-1]
+    # rank of pair i among same-expert pairs, in original order
+    rank = jnp.take_along_axis(prefix, flat_ids[:, None], axis=1)[:, 0] - 1
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]]
+    )
+    dest = offsets[flat_ids] + rank  # where pair i lands in sorted layout
+    sort_idx = jnp.zeros((m,), jnp.int32).at[dest].set(
+        jnp.arange(m, dtype=jnp.int32), mode="drop", unique_indices=True
+    )
+    return sort_idx, dest, group_sizes.astype(jnp.int32)
 
 
 def sort_tokens_by_expert(topk_ids: Array, num_experts: int) -> TokenSort:
@@ -42,12 +77,12 @@ def sort_tokens_by_expert(topk_ids: Array, num_experts: int) -> TokenSort:
     """
     n, k = topk_ids.shape
     flat_ids = topk_ids.reshape(n * k)
-    sort_idx = jnp.argsort(flat_ids, stable=True)
-    group_sizes = jnp.bincount(flat_ids, length=num_experts)
+    sort_idx, dest, group_sizes = stable_expert_order(flat_ids, num_experts)
     return TokenSort(
         sort_idx=sort_idx,
+        dest=dest,
         token_idx=sort_idx // k,
-        group_sizes=group_sizes.astype(jnp.int32),
+        group_sizes=group_sizes,
     )
 
 
@@ -58,19 +93,31 @@ def permute_tokens(
 
     x: [N, D]; probs: [N, K] → ([N*K, D], [N*K]).
     """
-    permuted_x = jnp.take(x, sort.token_idx, axis=0)
+    from jax.ad_checkpoint import checkpoint_name
+
+    # named for the "save_expensive" remat policy: the grouped-matmul
+    # backward needs these rows (dW), and recomputing them means redoing
+    # the gather under remat
+    permuted_x = checkpoint_name(
+        jnp.take(x, sort.token_idx, axis=0), "moe_permuted_rows"
+    )
     permuted_probs = jnp.take(probs.reshape(-1), sort.sort_idx, axis=0)
     return permuted_x, permuted_probs
 
 
 def unpermute_combine(y: Array, sort: TokenSort, num_tokens: int) -> Array:
-    """Scatter-add expert outputs back to their owning tokens.
+    """Combine expert outputs back to their owning tokens.
 
     y: [N*K, D] (already prob-weighted) → [N, D]. The reverse of
-    ``permute_tokens``; gradients flow as the corresponding gather.
+    ``permute_tokens``. Formulated as a duplicate-free gather by ``dest``
+    followed by a K-row sum instead of ``zeros.at[token_idx].add(y)``:
+    the scatter-add collides K ways on every token (each token owns K
+    expert rows) while ``dest`` is a permutation, so both this gather and
+    its VJP (a scatter at unique indices) are collision-free on TPU.
     """
-    out = jnp.zeros((num_tokens, y.shape[-1]), dtype=y.dtype)
-    return out.at[sort.token_idx].add(y)
+    k = sort.dest.shape[0] // num_tokens
+    pair_y = jnp.take(y, sort.dest, axis=0)  # token-major pair rows
+    return pair_y.reshape(num_tokens, k, y.shape[-1]).sum(axis=1)
 
 
 def grouped_matmul(x: Array, weight: Array, group_sizes: Array) -> Array:
@@ -78,8 +125,20 @@ def grouped_matmul(x: Array, weight: Array, group_sizes: Array) -> Array:
 
     x: [rows, in], weight: [E, in, out], group_sizes: [E] with
     sum(group_sizes) <= rows (trailing rows produce unspecified values —
-    callers mask or pad with a zero expert).
+    callers mask or pad with a zero expert). The output carries a
+    checkpoint name: ``ragged_dot`` is a custom call the stock
+    ``checkpoint_dots*`` policies don't match, so the "save_expensive"
+    remat policy saves it by name instead of recomputing the experts'
+    FLOPs in the backward pass.
     """
-    return lax.ragged_dot(
-        x, weight, group_sizes.astype(jnp.int32), preferred_element_type=x.dtype
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(
+        lax.ragged_dot(
+            x,
+            weight,
+            group_sizes.astype(jnp.int32),
+            preferred_element_type=x.dtype,
+        ),
+        "moe_grouped_dot",
     )

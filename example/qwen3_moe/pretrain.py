@@ -20,6 +20,10 @@ import os
 import sys
 from pathlib import Path
 
+# run as a plain script from anywhere: d9d_tpu lives two levels up and is
+# not pip-installed in this environment
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
 import jax
 
 # honor JAX_PLATFORMS even when the environment pre-imported jax (some

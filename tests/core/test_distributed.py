@@ -99,3 +99,26 @@ def test_init_num_processes_one_short_circuits(monkeypatch):
     monkeypatch.setenv("D9D_NUM_PROCESSES", "1")
     monkeypatch.setenv("D9D_PROCESS_ID", "0")
     assert init_distributed() is False
+
+
+def test_single_worker_hostnames_is_noop(monkeypatch):
+    """Single-chip containers may export TPU_WORKER_HOSTNAMES=localhost
+    (one entry, no pod): init_distributed must treat that as single-process
+    instead of calling jax.distributed.initialize with no coordinator."""
+    from d9d_tpu.core import distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(dist, "_owns_runtime", False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+    for var in ("D9D_COORDINATOR", "D9D_NUM_PROCESSES", "D9D_PROCESS_ID",
+                "MASTER_ADDR"):
+        monkeypatch.delenv(var, raising=False)
+
+    called = []
+    monkeypatch.setattr(
+        dist.jax.distributed, "initialize",
+        lambda *a, **k: called.append((a, k)),
+    )
+    assert dist.init_distributed() is False
+    assert called == []

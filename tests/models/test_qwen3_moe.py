@@ -9,6 +9,7 @@ import pytest
 from d9d_tpu.core import MeshParameters
 from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
 from d9d_tpu.nn.moe import MoELayer
+from d9d_tpu.nn.sdpa import build_sdpa_backend
 from d9d_tpu.ops.attention.eager import eager_sdpa
 
 B, T = 4, 16
@@ -253,3 +254,44 @@ def test_hybrid_padding_mask_blocks_contamination(ctx):
     )
     assert not np.allclose(np.asarray(out_a[:, 4:]), np.asarray(out_c[:, 4:]),
                            atol=1e-5)
+
+
+class TestRematPolicies:
+    """All remat policies must produce identical gradients — they differ
+    only in what gets recomputed vs saved (models/qwen3/dense.py
+    _remat_policy; "save_expensive" keeps named flash/grouped-dot outputs)."""
+
+    def test_grad_parity_across_policies(self):
+        toks = jnp.ones((2, 16), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+        grads = {}
+        for policy in ("full", "dots_no_batch", "save_expensive"):
+            cfg = Qwen3MoeConfig(
+                vocab_ranges=(("default", 64),), hidden_size=32,
+                num_layers=2, num_heads=2, num_kv_heads=1, head_dim=16,
+                moe_intermediate_size=32, num_experts=4,
+                num_experts_per_tok=2, remat=True, remat_policy=policy,
+            )
+            m = Qwen3MoeCausalLM(
+                config=cfg, sdpa=build_sdpa_backend(), dtype=jnp.float32
+            )
+            variables = m.init(jax.random.PRNGKey(0), toks, pos, toks)
+            params = variables["params"]
+            rest = {k: v for k, v in variables.items() if k != "params"}
+
+            def loss(p):
+                out = m.apply(
+                    {"params": p, **rest}, toks, pos, toks,
+                    mutable=["moe_stats", "moe_buffers"],
+                )[0]
+                return sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree.leaves(out)
+                )
+
+            grads[policy] = jax.jit(jax.grad(loss))(params)
+
+        ref = jax.tree.leaves(grads["full"])
+        for policy in ("dots_no_batch", "save_expensive"):
+            for a, b in zip(ref, jax.tree.leaves(grads[policy])):
+                np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

@@ -260,3 +260,35 @@ class TestPartialRope:
         out = module.apply(params, x, cos[None], sin[None])
         assert out.shape == (1, 6, 32)
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestStableExpertOrder:
+    """The sort-free grouping permutation must reproduce stable argsort
+    exactly (ops/moe.py: one-hot -> cumsum -> scatter replaces the bitonic
+    sort the MoE layer would otherwise run per layer per microbatch)."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_stable_argsort(self, seed):
+        from d9d_tpu.ops.moe import sort_tokens_by_expert, stable_expert_order
+
+        r = np.random.RandomState(seed)
+        n, k, e = r.randint(1, 200), r.randint(1, 9), r.randint(1, 65)
+        ids = jnp.asarray(r.randint(0, e, size=(n, k)), jnp.int32)
+        flat = ids.reshape(-1)
+        got_idx, got_dest, got_sizes = stable_expert_order(flat, e)
+        np.testing.assert_array_equal(
+            np.asarray(got_dest)[np.asarray(got_idx)], np.arange(flat.shape[0])
+        )
+        np.testing.assert_array_equal(got_idx, jnp.argsort(flat, stable=True))
+        np.testing.assert_array_equal(got_sizes, jnp.bincount(flat, length=e))
+        ts = sort_tokens_by_expert(ids, e)
+        np.testing.assert_array_equal(ts.token_idx, got_idx // k)
+
+    def test_empty_experts_and_single_expert(self):
+        from d9d_tpu.ops.moe import stable_expert_order
+
+        # all pairs on one expert; other experts empty
+        flat = jnp.full((7,), 3, jnp.int32)
+        idx, _, sizes = stable_expert_order(flat, 8)
+        np.testing.assert_array_equal(idx, np.arange(7))
+        assert int(sizes[3]) == 7 and int(sizes.sum()) == 7

@@ -84,8 +84,10 @@ def bench_sdpa(tiny):
         from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
 
         providers["pallas_flash"] = make_pallas_flash_sdpa()
-        # block-size sweep: the default 512x512 is a guess, not a tune
-        for bq, bkv in ((256, 512), (512, 256), (1024, 512), (256, 256)):
+        # block-size sweep around the adopted 1024x512 default (r3); the
+        # biggest tilings stay within VMEM: fp32 scores 2048x1024 = 8 MB
+        for bq, bkv in ((512, 512), (256, 512), (512, 256), (1024, 512),
+                        (1024, 1024), (2048, 1024)):
             providers[f"pallas_flash_q{bq}_kv{bkv}"] = make_pallas_flash_sdpa(
                 block_q=bq, block_kv=bkv
             )

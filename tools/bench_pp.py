@@ -110,11 +110,15 @@ def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
         m = engine.step(make_microbatches())
     drain(m)
     # drain() itself costs several sequential fetch round-trips (~70 ms
-    # each through the tunnel); measure it on the already-materialized
-    # state and subtract from the timed window below
-    t0 = time.perf_counter()
-    drain(m)
-    drain_cost = time.perf_counter() - t0
+    # each through the tunnel, jittering by tens of ms); measure it on the
+    # already-materialized state — median of 3 like benchtime.measure_rtt —
+    # and subtract from the timed window below
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        drain(m)
+        samples.append(time.perf_counter() - t0)
+    drain_cost = sorted(samples)[1]
 
     # timed loop runs UNPROFILED — per-op trace collection would inflate
     # the step times this harness records in BASELINE.md
@@ -122,7 +126,7 @@ def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
     for _ in range(steps):
         m = engine.step(make_microbatches())
     drain(m)
-    dt = time.perf_counter() - t0 - drain_cost
+    dt = max(time.perf_counter() - t0 - drain_cost, 1e-9)
 
     if trace_dir:
         # separate short traced pass: steady-state dispatch gaps only
