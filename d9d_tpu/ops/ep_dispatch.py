@@ -179,11 +179,14 @@ def ep_dispatch_compute_combine(
     labels = (q[:, None] >= jnp.take(incl, src_of, axis=0)).sum(axis=1)
     labels = jnp.clip(labels, 0, e_loc - 1)  # padding rows → last group
 
-    by_expert, _, group_sizes = stable_expert_order(labels, e_loc)
+    by_expert, dest, group_sizes = stable_expert_order(labels, e_loc)
     rows_sorted = jnp.take(recv, by_expert, axis=0)
 
     y_sorted = expert_fn(rows_sorted, group_sizes)
-    y_buf = jnp.zeros_like(y_sorted).at[by_expert].set(y_sorted)
+    # un-sort via the inverse permutation as a gather (dest[by_expert[r]]
+    # == r) — cheaper than a zeros+scatter on TPU, same as ops/moe.py's
+    # unpermute_combine
+    y_buf = jnp.take(y_sorted, dest, axis=0)
 
     # 5. mirrored return trip (swap send/recv roles). My slice for source s
     # must land where s's sorted rows for me begin: s's own block layout.

@@ -1,0 +1,30 @@
+"""Dev tools must keep working (same rationale as test_bench.py)."""
+
+import pathlib
+import subprocess
+import sys
+
+import jax
+
+
+def test_trace_summary_runs(tmp_path, devices):
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.sort(x @ x, axis=-1)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (128, 128))
+    f(x).block_until_ready()
+    with jax.profiler.trace(str(tmp_path)):
+        f(x).block_until_ready()
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path), "--all-lanes", "--top", "5"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "total timed op time" in out.stdout
+    assert "category" in out.stdout

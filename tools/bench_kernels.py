@@ -10,6 +10,7 @@ kernel variants:
                vs the naive full-logits path
 - rms_norm:    jnp/XLA-fused implementation
 - silu_mul:    jnp/XLA-fused implementation
+- gated_delta: linear-attention chunked WY form vs recurrent oracle
 - stochastic:  bf16 stochastic-rounding copy, jnp bit-twiddle vs pallas prng
 
 Run on the TPU chip:   python tools/bench_kernels.py
@@ -164,6 +165,47 @@ def bench_elementwise(tiny):
                jax.jit(silu_mul), x, y)
 
 
+def bench_gated_delta(tiny):
+    """Linear-attention (GDN) providers: chunked WY form vs the recurrent
+    oracle, fwd and fwd+bwd — the hybrid model family's hot op."""
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.ops.gated_delta import (
+        gated_delta_rule_chunked,
+        gated_delta_rule_recurrent,
+    )
+
+    b, t, h, dk, dv = (1, 128, 2, 16, 16) if tiny else (2, 2048, 8, 96, 128)
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(ks[0], (b, t, h, dk), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, h, dk), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, h, dv), jnp.float32)
+    g = -jax.nn.softplus(jax.random.normal(ks[3], (b, t, h), jnp.float32))
+    beta = jax.nn.sigmoid(jax.random.normal(ks[4], (b, t, h), jnp.float32))
+    cfg = f"b{b}_t{t}_h{h}_dk{dk}_dv{dv}"
+
+    providers = {"recurrent": gated_delta_rule_recurrent}
+    for chunk in ([32] if tiny else [32, 64, 128]):
+        providers[f"chunked_c{chunk}"] = (
+            lambda *a, c=chunk, **kw: gated_delta_rule_chunked(
+                *a, chunk_size=c, **kw
+            )
+        )
+    for name, fn in providers.items():
+        fwd = jax.jit(lambda q, k, v, g, beta, f=fn: f(q, k, v, g, beta)[0])
+        emit_timed("gated_delta_fwd", name, cfg, fwd, q, k, v, g, beta)
+        bwd = jax.jit(
+            jax.grad(
+                lambda q, k, v, g, beta, f=fn: jnp.sum(
+                    f(q, k, v, g, beta)[0].astype(jnp.float32)
+                ),
+                argnums=(0, 1, 2),
+            )
+        )
+        emit_timed("gated_delta_fwd_bwd", name, cfg, bwd, q, k, v, g, beta)
+
+
 def bench_stochastic(tiny):
     import jax
     import jax.numpy as jnp
@@ -188,7 +230,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true")
     ap.add_argument(
-        "--only", choices=["sdpa", "linear_ce", "elementwise", "stochastic"],
+        "--only",
+        choices=["sdpa", "linear_ce", "elementwise", "gated_delta",
+                 "stochastic"],
         default=None,
     )
     args = ap.parse_args()
@@ -206,6 +250,7 @@ def main():
         "sdpa": bench_sdpa,
         "linear_ce": bench_linear_ce,
         "elementwise": bench_elementwise,
+        "gated_delta": bench_gated_delta,
         "stochastic": bench_stochastic,
     }
     for name, fn in benches.items():

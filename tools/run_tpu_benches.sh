@@ -1,17 +1,51 @@
 #!/usr/bin/env bash
-# One-shot on-chip bench capture: runs the three harnesses sequentially
-# (never concurrently — the TPU tunnel claims one process at a time) and
-# tees results into bench_results/. Fill BASELINE.md from these.
+# One-shot on-chip bench capture: runs every harness + config sweep
+# sequentially (never concurrently — the TPU tunnel claims one process at a
+# time) and tees results into bench_results/. Fill BASELINE.md from these.
+# Designed to be resumable: each leg appends to its own file, so re-running
+# after a tunnel drop only repeats the unfinished leg (comment out done legs).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # tools/*.py import d9d_tpu; sys.path[0] is tools/, so the repo root must
 # be on PYTHONPATH explicitly
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p bench_results
-echo "== bench.py (dense + MoE rows)"
-python bench.py | tee bench_results/bench.json
+
+echo "== bench.py default (dense full-remat + MoE ub1): the headline row"
+python bench.py | tee -a bench_results/bench.jsonl
+
+echo "== dense remat-policy sweep"
+for pol in dots_no_batch save_expensive; do
+  echo "-- remat_policy=$pol"
+  D9D_BENCH_REMAT_POLICY=$pol python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+import json, os
+import bench
+r = bench.run_bench()
+r["detail"]["remat_policy"] = os.environ["D9D_BENCH_REMAT_POLICY"]
+print(json.dumps(r))
+EOF
+done
+
+echo "== MoE sweep: save_expensive remat at ub1; ub2 bf16-params variant"
+D9D_BENCH_REMAT_POLICY=save_expensive python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+import json, os
+import bench
+r = bench.run_bench_moe()
+r["detail"]["remat_policy"] = "save_expensive"
+print(json.dumps(r))
+EOF
+D9D_BENCH_MOE_UB=2 python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub2_bf16_params_stochastic_adamw"
+print(json.dumps(r))
+EOF
+
 echo "== kernel latency harness"
 python tools/bench_kernels.py | tee bench_results/kernels.jsonl
+
 echo "== pipeline schedule microbench"
 python tools/bench_pp.py | tee bench_results/pp.jsonl
+
 echo "done — see bench_results/"

@@ -1,0 +1,143 @@
+"""Summarize a jax.profiler trace: device time by op category and top ops.
+
+Usage:  python tools/trace_summary.py <logdir> [--top 25]
+
+<logdir> is whatever was passed to ``jax.profiler.trace`` (the tool walks
+into the newest ``plugins/profile/<run>/`` underneath it and reads every
+``*.trace.json.gz``). Prints one table of device-lane time grouped into
+categories (matmul / custom-call / sort / scatter-gather / copy-layout /
+collective / fusion / other) and the top individual ops — the quickest way
+to see where an MoE or pipeline step actually spends its time without
+opening xprof. Host-side lanes (Python, runtime threads) are excluded;
+on CPU traces, where XLA compute runs on host threads, pass --all-lanes.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+# order matters: collectives first, or all-gather/reduce-scatter would be
+# swallowed by the scatter-gather pattern
+CATEGORIES = [
+    ("collective", re.compile(
+        r"all-reduce|all-gather|all-to-all|reduce-scatter|collective|permute",
+        re.I)),
+    ("matmul", re.compile(r"dot|matmul|conv|einsum|ragged-dot", re.I)),
+    ("custom-call", re.compile(r"custom-call|tpu_custom_call|pallas", re.I)),
+    ("sort", re.compile(r"\bsort|top-k|topk", re.I)),
+    ("scatter-gather", re.compile(r"scatter|gather|dynamic-slice|dynamic-update", re.I)),
+    ("copy-layout", re.compile(r"copy|transpose|bitcast|reshape|pad\b", re.I)),
+    ("fusion", re.compile(r"fusion|fused", re.I)),
+]
+
+
+def categorize(name: str) -> str:
+    for cat, rx in CATEGORIES:
+        if rx.search(name):
+            return cat
+    return "other"
+
+
+def newest_profile_dir(logdir: str) -> str:
+    runs = sorted(glob.glob(os.path.join(logdir, "plugins", "profile", "*")))
+    if not runs:
+        # maybe logdir IS a profile run dir already
+        if glob.glob(os.path.join(logdir, "*.trace.json.gz")):
+            return logdir
+        raise SystemExit(f"no plugins/profile/* runs under {logdir}")
+    return runs[-1]
+
+
+def load_events(run_dir: str):
+    events, processes, threads = [], {}, {}
+    for path in glob.glob(os.path.join(run_dir, "*.trace.json.gz")):
+        data = json.loads(gzip.open(path).read())
+        for e in data.get("traceEvents", []):
+            ph = e.get("ph")
+            if ph == "M":
+                if e.get("name") == "process_name":
+                    processes[e["pid"]] = e["args"]["name"]
+                elif e.get("name") == "thread_name":
+                    threads[(e["pid"], e.get("tid"))] = e["args"]["name"]
+            elif ph == "X":
+                events.append(e)
+    return events, processes, threads
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument(
+        "--all-lanes", action="store_true",
+        help="include host lanes (needed for CPU traces, where XLA compute "
+        "runs on host threads)",
+    )
+    args = ap.parse_args()
+
+    run_dir = newest_profile_dir(args.logdir)
+    events, processes, threads = load_events(run_dir)
+
+    def is_device_lane(pid) -> bool:
+        return "/device:" in processes.get(pid, "")
+
+    # Device processes carry several thread lanes ("XLA Ops" plus
+    # module/step span lanes, where one module event ~= the sum of its op
+    # events) — keep only the op lane when it exists or totals double.
+    device_pids = {p for p in processes if is_device_lane(p)}
+    op_tids = {
+        (pid, tid)
+        for (pid, tid), name in threads.items()
+        if pid in device_pids and "XLA Ops" in name
+    }
+    pids_with_op_lane = {pid for pid, _ in op_tids}
+
+    def keep(e) -> bool:
+        pid, tid = e.get("pid"), e.get("tid")
+        if args.all_lanes:
+            return True
+        if pid not in device_pids:
+            return False
+        if pid in pids_with_op_lane:
+            return (pid, tid) in op_tids
+        return True
+
+    by_name = collections.Counter()
+    lanes = collections.Counter()
+    for e in events:
+        if not keep(e):
+            continue
+        dur = e.get("dur", 0)  # microseconds
+        if dur <= 0:
+            continue
+        by_name[e["name"]] += dur
+        lanes[processes.get(e.get("pid"), "?")] += dur
+
+    if not by_name:
+        hint = "" if args.all_lanes else " (try --all-lanes for CPU traces)"
+        raise SystemExit(f"no timed events found in {run_dir}{hint}")
+
+    total = sum(by_name.values())
+    by_cat = collections.Counter()
+    for name, dur in by_name.items():
+        by_cat[categorize(name)] += dur
+
+    print(f"run: {run_dir}")
+    print(f"lanes: {dict(lanes)}")
+    print(f"\ntotal timed op time: {total/1e3:.3f} ms\n")
+    print(f"{'category':<16}{'ms':>12}{'share':>9}")
+    for cat, dur in by_cat.most_common():
+        print(f"{cat:<16}{dur/1e3:>12.3f}{dur/total:>8.1%}")
+    print(f"\ntop {args.top} ops:")
+    print(f"{'ms':>10}  {'share':>6}  name")
+    for name, dur in by_name.most_common(args.top):
+        print(f"{dur/1e3:>10.3f}  {dur/total:>6.1%}  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()
