@@ -39,7 +39,8 @@ def _flops_accounting(cfg, *, seq_len, active_param_count):
     return model, hardware
 
 
-def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len):
+def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len,
+             profile_tag=None):
     # Sync via a host fetch, NOT block_until_ready: through the axon TPU
     # tunnel block_until_ready returns before remote execution finishes
     # (see tools/benchtime.py). run_step is one jitted executable, so
@@ -56,6 +57,20 @@ def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len):
         m = trainer.run_step(next(data_iter))
     host_fetch_sync(m["loss"])
     dt = time.perf_counter() - t0 - rtt
+
+    # optional SEPARATE traced pass (after timing, so trace collection
+    # can't inflate the recorded numbers): set D9D_BENCH_PROFILE_DIR and
+    # feed the capture to tools/trace_summary.py
+    import os
+
+    profile_root = os.environ.get("D9D_BENCH_PROFILE_DIR")
+    if profile_root and profile_tag:
+        import jax
+
+        with jax.profiler.trace(os.path.join(profile_root, profile_tag)):
+            for _ in range(2):
+                m = trainer.run_step(next(data_iter))
+            host_fetch_sync(m["loss"])
     return steps * batch * seq_len / dt
 
 
@@ -164,6 +179,7 @@ def run_bench(*, tiny: bool = False) -> dict:
     tok_per_s = _measure(
         trainer, iter(Data().build()), warmup=steps_warmup,
         steps=steps_measure, batch=batch, seq_len=seq_len,
+        profile_tag=None if tiny else "dense",
     )
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(trainer.params)
@@ -341,6 +357,7 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
     tok_per_s = _measure(
         trainer, iter(Data().build()), warmup=steps_warmup,
         steps=steps_measure, batch=batch, seq_len=seq_len,
+        profile_tag=None if tiny else "moe",
     )
 
     # active params: experts scaled by top_k/num_experts, everything else 1x

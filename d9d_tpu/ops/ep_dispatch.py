@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from d9d_tpu.core.types import Array
-from d9d_tpu.ops.moe import stable_expert_order
+from d9d_tpu.ops.moe import combine_pairs, stable_expert_order
 
 __all__ = ["ep_buffer_rows", "ep_dispatch_compute_combine"]
 
@@ -122,7 +122,7 @@ def ep_dispatch_compute_combine(
     # permutation — see ops/moe.py stable_expert_order; TPU sorts are
     # bitonic and this runs per MoE layer per microbatch)
     ids_flat = ids_loc.reshape(-1)
-    order, _, counts = stable_expert_order(ids_flat, e_loc * ep_world)
+    order, pair_dest, counts = stable_expert_order(ids_flat, e_loc * ep_world)
     token_of = order // k
     x_rows = jnp.take(x_loc, token_of, axis=0)  # [m, D]
 
@@ -203,7 +203,7 @@ def ep_dispatch_compute_combine(
     )
 
     # 6. weight by router probs, fold the k assignments per token
+    # (collision-free gather form — see ops/moe.py combine_pairs)
     probs_rows = jnp.take(probs_loc.reshape(-1), order)
-    out = jnp.zeros((n, d_model), home.dtype)
-    out = out.at[token_of].add(home * probs_rows[:, None].astype(home.dtype))
-    return out
+    weighted = home * probs_rows[:, None].astype(home.dtype)
+    return combine_pairs(weighted, pair_dest, n)

@@ -105,19 +105,30 @@ def permute_tokens(
     return permuted_x, permuted_probs
 
 
+def combine_pairs(y: Array, dest: Array, num_tokens: int) -> Array:
+    """Fold expert-sorted pair rows back to their owning tokens.
+
+    y: [N*K, D] expert-sorted rows (already prob-weighted); ``dest`` the
+    inverse permutation from :func:`stable_expert_order` → [N, D].
+    Formulated as a duplicate-free gather by ``dest`` followed by a K-row
+    sum instead of ``zeros.at[token_idx].add(y)``: the scatter-add
+    collides K ways on every token (each token owns K expert rows) while
+    ``dest`` is a permutation, so both this gather and its VJP (a scatter
+    at unique indices) are collision-free on TPU. Shared by the local MoE
+    path and the EP shard_map combine.
+    """
+    k = dest.shape[0] // num_tokens
+    pair_y = jnp.take(y, dest, axis=0)  # token-major pair rows
+    return pair_y.reshape(num_tokens, k, y.shape[-1]).sum(axis=1)
+
+
 def unpermute_combine(y: Array, sort: TokenSort, num_tokens: int) -> Array:
-    """Combine expert outputs back to their owning tokens.
+    """Combine expert outputs back to their owning tokens (local path).
 
     y: [N*K, D] (already prob-weighted) → [N, D]. The reverse of
-    ``permute_tokens``. Formulated as a duplicate-free gather by ``dest``
-    followed by a K-row sum instead of ``zeros.at[token_idx].add(y)``:
-    the scatter-add collides K ways on every token (each token owns K
-    expert rows) while ``dest`` is a permutation, so both this gather and
-    its VJP (a scatter at unique indices) are collision-free on TPU.
+    ``permute_tokens``; see :func:`combine_pairs` for the formulation.
     """
-    k = sort.dest.shape[0] // num_tokens
-    pair_y = jnp.take(y, sort.dest, axis=0)  # token-major pair rows
-    return pair_y.reshape(num_tokens, k, y.shape[-1]).sum(axis=1)
+    return combine_pairs(y, sort.dest, num_tokens)
 
 
 def grouped_matmul(x: Array, weight: Array, group_sizes: Array) -> Array:

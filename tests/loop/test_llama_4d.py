@@ -1,0 +1,84 @@
+"""Llama-class (no qk-norm) dense model under the 4D layout (PP x FSDP x TP
++ remat) — BASELINE.md target config 4 shrunk to the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop import (
+    AdamWProvider,
+    CausalLMTask,
+    DatasetProvider,
+    ModelProvider,
+    Trainer,
+    TrainerConfig,
+)
+from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.nn.sdpa import build_sdpa_backend
+from d9d_tpu.parallel import fsdp_plan
+
+VOCAB = 128
+
+
+def test_llama_class_trains_under_pp_fsdp_tp(devices):
+    ctx = MeshParameters(pp=2, dp_shard=2, tp=2).build(devices)
+    cfg = Qwen3DenseConfig(
+        vocab_ranges=(("default", VOCAB),),
+        hidden_size=64,
+        num_layers=4,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        intermediate_size=128,
+        qk_norm=False,  # the Llama-family attention shape
+        remat=True,
+        remat_policy="save_expensive",
+    )
+
+    class Provider(ModelProvider):
+        def build_module(self, stage):
+            return Qwen3DenseCausalLM(
+                config=cfg,
+                sdpa=build_sdpa_backend(),
+                stage=stage,
+                act_sharding=NamedSharding(
+                    ctx.stage_mesh(stage.stage_index),
+                    P(ctx.batch_axes, ctx.sequence_axes),
+                ),
+                dtype=jnp.float32,
+            )
+
+        def build_plan(self, c):
+            return fsdp_plan(c, with_tp=True)
+
+        def sample_inputs(self, b, t):
+            z = jnp.zeros((b, t), jnp.int32)
+            return (z, z, z)
+
+    class Data(DatasetProvider):
+        def build(self):
+            base = np.random.RandomState(0).randint(0, VOCAB, size=(8, 33))
+            while True:
+                yield {"input_ids": base}
+
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=8,
+            microbatch_size=2,
+            seq_len=32,
+            total_steps=8,
+            log_every=1,
+            learning_rate=3e-3,
+            pipeline={"kind": "interleaved_1f1b"},
+        ),
+        model_provider=Provider(),
+        dataset_provider=Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    hist = trainer.train()
+    l0, l1 = float(hist[0]["loss"]), float(hist[-1]["loss"])
+    assert l1 < l0 - 0.3, (l0, l1)

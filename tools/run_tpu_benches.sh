@@ -10,6 +10,9 @@ cd "$(dirname "$0")/.."
 # be on PYTHONPATH explicitly
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p bench_results
+# fresh files per invocation; -a below only accumulates within this run
+: > bench_results/bench.jsonl
+: > bench_results/bench_sweep.jsonl
 
 echo "== bench.py default (dense full-remat + MoE ub1): the headline row"
 python bench.py | tee -a bench_results/bench.jsonl

@@ -97,6 +97,15 @@ def main():
     }
     pids_with_op_lane = {pid for pid, _ in op_tids}
 
+    degraded = device_pids - pids_with_op_lane
+    if degraded and not args.all_lanes:
+        print(
+            f"warning: device process(es) {sorted(degraded)} have no "
+            "'XLA Ops' lane — module/step span lanes are being counted, "
+            "totals may be ~2x actual op time",
+            file=sys.stderr,
+        )
+
     def keep(e) -> bool:
         pid, tid = e.get("pid"), e.get("tid")
         if args.all_lanes:
