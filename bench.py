@@ -132,7 +132,9 @@ def run_bench(*, tiny: bool = False) -> dict:
             # tuning knob for on-chip sweeps (BASELINE.md methodology)
             remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
         )
-        seq_len, batch = 2048, 8
+        # batch knob for on-chip sweeps: more rows per step amortize
+        # per-kernel overheads if HBM allows (full remat leaves plenty)
+        seq_len, batch = 2048, int(os.environ.get("D9D_BENCH_BATCH", "8"))
         steps_warmup, steps_measure = 3, 10
         dtype = jnp.bfloat16
 

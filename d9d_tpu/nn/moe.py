@@ -169,15 +169,23 @@ def grouped_swiglu_apply(
     down_w: Array,
     dtype: jnp.dtype,
 ) -> Array:
-    """Functional core shared by the local path and the EP shard_map body."""
+    """Functional core shared by the local path and the EP shard_map body.
+
+    Gate and up projections run as ONE grouped matmul over a runtime
+    concatenation ``[E, in, 2*inter]``: the expert-sorted activation rows
+    stream from HBM once instead of twice and per-expert M-tiles are
+    reused across both projections, while parameters (and therefore
+    checkpoints, HF mappers, PEFT and sharding plans) stay separate
+    gate/up tensors.
+    """
     x = permuted_x.astype(dtype)
-    gate_w = gate_w.astype(dtype)
-    up_w = up_w.astype(dtype)
-    down_w = down_w.astype(dtype)
-    hidden = silu_mul(
-        grouped_matmul(x, gate_w, group_sizes),
-        grouped_matmul(x, up_w, group_sizes),
+    inter = gate_w.shape[-1]
+    gate_up_w = jnp.concatenate(
+        [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
     )
+    down_w = down_w.astype(dtype)
+    h_gu = grouped_matmul(x, gate_up_w, group_sizes)  # [M, 2*inter]
+    hidden = silu_mul(h_gu[..., :inter], h_gu[..., inter:])
     out = grouped_matmul(hidden, down_w, group_sizes)
     return out * permuted_probs[:, None].astype(dtype)
 

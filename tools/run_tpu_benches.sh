@@ -10,9 +10,13 @@ cd "$(dirname "$0")/.."
 # be on PYTHONPATH explicitly
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p bench_results
-# fresh files per invocation; -a below only accumulates within this run
-: > bench_results/bench.jsonl
-: > bench_results/bench_sweep.jsonl
+# fresh files per invocation so stale rows can't mix into BASELINE.md;
+# when resuming after a tunnel drop (commented-out finished legs), set
+# D9D_BENCH_RESUME=1 to keep the already-captured rows
+if [[ "${D9D_BENCH_RESUME:-0}" != "1" ]]; then
+  : > bench_results/bench.jsonl
+  : > bench_results/bench_sweep.jsonl
+fi
 
 echo "== bench.py default (dense full-remat + MoE ub1): the headline row"
 python bench.py | tee -a bench_results/bench.jsonl
