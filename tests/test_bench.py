@@ -48,7 +48,7 @@ def test_bench_pp_tiny_runs(devices):
 
     rows = [_json.loads(l) for l in lines]
     assert any("winner" in r for r in rows)
-    assert sum("schedule" in r for r in rows) == 3
+    assert sum("schedule" in r for r in rows) == 5
 
 
 def test_bench_moe_tiny_runs(devices):

@@ -160,8 +160,10 @@ def main():
 
     from d9d_tpu.models.qwen3 import Qwen3DenseConfig
     from d9d_tpu.pipelining.factory import (
+        DualPipeVScheduleConfig,
         Interleaved1F1BScheduleConfig,
         ZeroBubble1PScheduleConfig,
+        ZeroBubbleVScheduleConfig,
     )
 
     if args.tiny:
@@ -194,6 +196,10 @@ def main():
         ("zb1p", "cache_full",
          ZeroBubble1PScheduleConfig(
              stages_per_rank=2, residual_policy="cache_full")),
+        # V-style schedules are fixed at 2 stages/rank — same virtual-stage
+        # rig; defaults (cache_full) per the measured policy
+        ("zbv", "cache_full", ZeroBubbleVScheduleConfig()),
+        ("dualpipev", "cache_full", DualPipeVScheduleConfig()),
     ]
     results = []
     for name, policy, sched in combos:
