@@ -14,6 +14,7 @@ from d9d_tpu.core.mesh import (
     MESH_AXIS_NAMES,
     MeshContext,
     MeshParameters,
+    resolve_ambient_mesh,
 )
 from d9d_tpu.core.tree_sharding import (
     SpecReplicate,
@@ -38,6 +39,7 @@ __all__ = [
     "MESH_AXIS_NAMES",
     "MeshContext",
     "MeshParameters",
+    "resolve_ambient_mesh",
     "SpecReplicate",
     "SpecShard",
     "shard_spec_on_dim",

@@ -44,6 +44,35 @@ MESH_AXIS_NAMES: tuple[str, ...] = (
 )
 
 
+def resolve_ambient_mesh(required_axes=(), *, fallback=None, what="this op"):
+    """The mesh a mesh-aware op should shard_map over, resolved at TRACE
+    time: the ambient abstract mesh when one is set (under the pipeline
+    engine each stage jits against its own pp-less submesh — a baked
+    build-time mesh would disagree with the context there), else
+    ``fallback``. Raises if neither exists or ``required_axes`` are
+    missing. One helper so the resolution rule can't diverge between the
+    ring SDPA, the MoE EP path, and the SDPA factory.
+    """
+    import jax.sharding as jsh
+
+    mesh = jsh.get_abstract_mesh()
+    if mesh is None or not mesh.shape:
+        mesh = fallback
+    if mesh is None or not mesh.shape:
+        raise RuntimeError(
+            f"{what} needs an ambient mesh; build it via "
+            "MeshParameters.build() (which calls jax.set_mesh)"
+        )
+    missing = [a for a in required_axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(
+            f"{what}: axes {missing} not in the context mesh "
+            f"{dict(mesh.shape)} — was a different mesh built after "
+            "this module was configured?"
+        )
+    return mesh
+
+
 def _suffix_axes_covering(
     size: int, axes: Sequence[tuple[str, int]]
 ) -> tuple[str, ...]:

@@ -350,20 +350,10 @@ class MoELayer(nn.Module):
         self, hidden: Array, topk_ids: Array, topk_probs: Array
     ) -> Array:
         """hidden [B, T, D], ids/probs [B, T, K] → [B, T, D]."""
+        from d9d_tpu.core.mesh import resolve_ambient_mesh
+
         ep_axes = tuple(self.ep_axes)
-        mesh = jax.sharding.get_abstract_mesh()
-        if not mesh.shape:
-            raise RuntimeError(
-                "MoE EP path needs an ambient mesh; build it via "
-                "MeshParameters.build() (which calls jax.set_mesh)"
-            )
-        missing = [a for a in ep_axes if a not in mesh.shape]
-        if missing:
-            raise ValueError(
-                f"ep_axes {missing} not in the ambient mesh "
-                f"{dict(mesh.shape)} — was a different mesh built after "
-                f"this model was configured?"
-            )
+        mesh = resolve_ambient_mesh(ep_axes, what="MoE EP path")
         ep_size = 1
         for a in ep_axes:
             ep_size *= mesh.shape[a]

@@ -54,15 +54,13 @@ def build_sdpa_backend(config: SdpaBackendConfig | None = None) -> SdpaBackend:
             block_q=config.block_q, block_kv=config.block_kv
         )
     if isinstance(config, SdpaRingConfig):
-        from jax.sharding import get_abstract_mesh
-
+        from d9d_tpu.core.mesh import resolve_ambient_mesh
         from d9d_tpu.ops.attention.ring import make_ring_sdpa
 
-        mesh = get_abstract_mesh()
-        if mesh is None or mesh.empty:
-            raise ValueError(
-                "ring sdpa needs an ambient mesh — build a MeshContext first"
-            )
+        mesh = resolve_ambient_mesh(
+            (config.seq_axis, *config.batch_axes, *config.head_axes),
+            what="ring sdpa",
+        )
         return make_ring_sdpa(
             mesh,
             seq_axis=config.seq_axis,

@@ -182,6 +182,17 @@ def make_ring_sdpa(
                 "q_segments and kv_segments must be provided together"
             )
 
+        # Resolve the mesh at TRACE time: under the pipeline engine each
+        # stage jits against its own pp-less submesh, and a shard_map
+        # whose mesh disagrees with the context mesh is an error.
+        from d9d_tpu.core.mesh import resolve_ambient_mesh
+
+        m = resolve_ambient_mesh(
+            (seq_axis, *batch_axes, *head_axes),
+            fallback=mesh,
+            what="ring attention",
+        )
+
         # validate divisibility up front: without this, a mis-sized input
         # surfaces as an opaque shard_map in_specs error deep in the jit
         # (and the batch stager silently falls back to batch-only sharding
@@ -189,7 +200,7 @@ def make_ring_sdpa(
         def _size(axes):
             out = 1
             for a in axes:
-                out *= mesh.shape[a]
+                out *= m.shape[a]
             return out
 
         b, t, hq, _ = q.shape
@@ -231,7 +242,7 @@ def make_ring_sdpa(
 
         @functools.partial(
             jax.shard_map,
-            mesh=mesh,
+            mesh=m,
             in_specs=in_specs,
             out_specs=qkv_spec,
             check_vma=False,

@@ -1,12 +1,14 @@
-"""PP x EP composition: a Qwen3-MoE model with expert-parallel experts
-training under the pipeline engine — the reference example's headline
-layout (pretrain.json: PP=4 x DP_r=2 x EP=2) shrunk to the 8-device mesh
-(pp=2 x dp_s=2 x ep=2). The multichip dryrun covers EP and PP separately;
-this is the composed path."""
+"""PP x EP composition: Qwen3-MoE with expert-parallel experts training
+through the pipeline engine — the reference example's headline layout
+(pretrain.json: PP=4 x DP_r=2 x EP=2) shrunk to the CPU mesh: a 4-device
+pp=2 x dp_s=2 leg with ep=2 overlaying dp_s, and the full 8-device
+pp=2 x dp_s=2 x tp=2 leg with ep=4 overlaying dp_s x tp. The multichip
+dryrun covers EP and PP separately; these are the composed paths."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core import MeshParameters
@@ -25,8 +27,7 @@ from d9d_tpu.parallel import fsdp_ep_plan
 VOCAB = 128
 
 
-def test_moe_ep_trains_under_pp(devices):
-    ctx = MeshParameters(pp=2, dp_shard=2, ep_shard=2).build(devices[:4])
+def _train_pp_ep(ctx, *, with_tp: bool, seed: int) -> list[dict]:
     cfg = Qwen3MoeConfig(
         vocab_ranges=(("default", VOCAB),),
         hidden_size=64,
@@ -56,7 +57,7 @@ def test_moe_ep_trains_under_pp(devices):
             )
 
         def build_plan(self, c):
-            return fsdp_ep_plan(c)
+            return fsdp_ep_plan(c, with_tp=with_tp)
 
         def sample_inputs(self, b, t):
             z = jnp.zeros((b, t), jnp.int32)
@@ -64,7 +65,7 @@ def test_moe_ep_trains_under_pp(devices):
 
     class Data(DatasetProvider):
         def build(self):
-            base = np.random.RandomState(0).randint(0, VOCAB, size=(8, 33))
+            base = np.random.RandomState(seed).randint(0, VOCAB, size=(8, 33))
             while True:
                 yield {"input_ids": base}
 
@@ -84,72 +85,17 @@ def test_moe_ep_trains_under_pp(devices):
         task=CausalLMTask(),
         optimizer_provider=AdamWProvider(),
     )
-    hist = trainer.train()
+    return trainer.train()
+
+
+@pytest.mark.parametrize("layout", ["pp_dp_ep", "pp_dp_tp_ep"])
+def test_moe_ep_trains_under_pp(devices, layout):
+    if layout == "pp_dp_ep":
+        ctx = MeshParameters(pp=2, dp_shard=2, ep_shard=2).build(devices[:4])
+        with_tp = False
+    else:
+        ctx = MeshParameters(pp=2, dp_shard=2, tp=2, ep_shard=4).build(devices)
+        with_tp = True
+    hist = _train_pp_ep(ctx, with_tp=with_tp, seed=1 if with_tp else 0)
     l0, l1 = float(hist[0]["loss"]), float(hist[-1]["loss"])
-    assert l1 < l0 - 0.3, (l0, l1)
-
-
-def test_moe_ep_tp_trains_under_pp_full_composition(devices):
-    """pp=2 x dp_s=2 x tp=2 with ep=4 overlaying dp_s x tp — every
-    parallelism family this framework ships, in one training run."""
-    ctx = MeshParameters(pp=2, dp_shard=2, tp=2, ep_shard=4).build(devices)
-    cfg = Qwen3MoeConfig(
-        vocab_ranges=(("default", VOCAB),),
-        hidden_size=64,
-        num_layers=4,
-        num_heads=4,
-        num_kv_heads=2,
-        head_dim=16,
-        moe_intermediate_size=64,
-        num_experts=8,
-        num_experts_per_tok=2,
-        remat=False,
-        ep_axes=ctx.ep_shard_axes,
-        moe_token_axes=(ctx.batch_axes, ctx.sequence_axes),
-    )
-
-    class Provider(ModelProvider):
-        def build_module(self, stage):
-            return Qwen3MoeCausalLM(
-                config=cfg,
-                sdpa=build_sdpa_backend(),
-                stage=stage,
-                act_sharding=NamedSharding(
-                    ctx.stage_mesh(stage.stage_index),
-                    P(ctx.batch_axes, ctx.sequence_axes),
-                ),
-                dtype=jnp.float32,
-            )
-
-        def build_plan(self, c):
-            return fsdp_ep_plan(c, with_tp=True)
-
-        def sample_inputs(self, b, t):
-            z = jnp.zeros((b, t), jnp.int32)
-            return (z, z, z)
-
-    class Data(DatasetProvider):
-        def build(self):
-            base = np.random.RandomState(1).randint(0, VOCAB, size=(8, 33))
-            while True:
-                yield {"input_ids": base}
-
-    trainer = Trainer(
-        ctx=ctx,
-        config=TrainerConfig(
-            global_batch_size=8,
-            microbatch_size=4,
-            seq_len=32,
-            total_steps=8,
-            log_every=1,
-            learning_rate=3e-3,
-            pipeline={"kind": "interleaved_1f1b"},
-        ),
-        model_provider=Provider(),
-        dataset_provider=Data(),
-        task=CausalLMTask(),
-        optimizer_provider=AdamWProvider(),
-    )
-    hist = trainer.train()
-    l0, l1 = float(hist[0]["loss"]), float(hist[-1]["loss"])
-    assert l1 < l0 - 0.3, (l0, l1)
+    assert l1 < l0 - 0.3, (layout, l0, l1)
