@@ -18,7 +18,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
 
 _CHILD = """
 import os, sys
