@@ -44,6 +44,40 @@ MESH_AXIS_NAMES: tuple[str, ...] = (
 )
 
 
+def interleave_for_pp(devices, pp: int):
+    """Order ``devices`` so every pipeline stage's submesh spans every
+    process evenly.
+
+    The mesh's leading axis is ``pp``; with jax's default device order a
+    pp slice would be a contiguous block of one process's devices, making
+    every stage jit un-runnable from the other processes (a submesh some
+    process cannot address at all) and every stage boundary a cross-host
+    copy. Interleaving gives each process ``local/pp`` devices in every
+    stage: stage programs are ordinary SPMD over all hosts and boundary
+    transfers stay process-local (see pipelining/runtime/transfer.py).
+    No-op for a single process.
+    """
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    if len(by_proc) <= 1:
+        return list(devices)
+    per = {p: len(ds) for p, ds in by_proc.items()}
+    bad = {p: n for p, n in per.items() if n % pp != 0}
+    if bad:
+        raise ValueError(
+            f"interleave_for_pp: per-process device counts {per} must be "
+            f"divisible by pp={pp}"
+        )
+    out = []
+    for s in range(pp):
+        for p in sorted(by_proc):
+            ds = by_proc[p]
+            n = len(ds) // pp
+            out.extend(ds[s * n:(s + 1) * n])
+    return out
+
+
 def resolve_ambient_mesh(required_axes=(), *, fallback=None, what="this op"):
     """The mesh a mesh-aware op should shard_map over, resolved at TRACE
     time: the ambient abstract mesh when one is set (under the pipeline

@@ -64,6 +64,24 @@ def _deep_merge(trees: list[PyTree]) -> PyTree:
     return out
 
 
+def _put_key_replicated(key, submesh) -> jax.Array:
+    """Commit an RNG key to a stage submesh, replicated — staged through
+    the host. A device->device ``device_put`` between differently-sized
+    device lists (the key lives on the full mesh / a single device, the
+    stage submesh is a subset) is unsupported for cross-process meshes
+    ("CopyArrays only supports destination device list of the same size"),
+    while a host->device put onto any sharding always works: each process
+    materializes its addressable shards of the replicated value.
+    """
+    sharding = NamedSharding(submesh, P())
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        data = np.asarray(jax.random.key_data(key))
+        return jax.random.wrap_key_data(
+            jax.device_put(data, sharding), impl=jax.random.key_impl(key)
+        )
+    return jax.device_put(np.asarray(key), sharding)
+
+
 def build_pipeline_stages(
     *,
     ctx: MeshContext,
@@ -98,17 +116,29 @@ def build_pipeline_stages(
         info = PipelineStageInfo(stage_index=s, num_stages=num_stages)
         module = model_provider.build_module(info)
         submesh = ctx.stage_mesh(stage_owner[s])
-        # commit the stage's init key to its submesh: keys minted under
-        # the ambient full mesh carry that mesh in their sharding type
-        # and would poison the submesh-scoped init jit
-        rng_s = jax.device_put(
-            jax.random.fold_in(init_rng, s), NamedSharding(submesh, P())
-        )
+        # the stage's init key stays HOST data and is materialized inside
+        # the jit: a device-resident key would (a) carry the ambient full
+        # mesh in its sharding and poison the submesh-scoped init jit, and
+        # (b) be un-fetchable as a closed-over constant when the submesh
+        # spans multiple processes
+        folded = jax.random.fold_in(init_rng, s)
+        if jnp.issubdtype(folded.dtype, jax.dtypes.prng_key):
+            rng_host = np.asarray(jax.random.key_data(folded))
+            rng_impl = jax.random.key_impl(folded)
+        else:
+            rng_host = np.asarray(folded)
+            rng_impl = None
         carry_zero = _zeros_like_sdt(carry_sdt)
 
         def raw_init(
-            module=module, rng=rng_s, carry=carry_zero, last=info.is_last
+            module=module, rng_host=rng_host, rng_impl=rng_impl,
+            carry=carry_zero, last=info.is_last,
         ):
+            rng = (
+                jax.random.wrap_key_data(jnp.asarray(rng_host), impl=rng_impl)
+                if rng_impl is not None
+                else jnp.asarray(rng_host)
+            )
             return task.stage_init(module, rng, carry, kwargs_s, state_s, last)
 
         if stage_params is not None and s in stage_params:
@@ -205,9 +235,8 @@ class PipelineTrainEngine:
 
             for s, rt in self.stages.items():
                 submesh = ctx.stage_mesh(self.stage_owner[s])
-                rng_s = jax.device_put(
-                    jax.random.fold_in(init_rng, 10_000 + s),
-                    NamedSharding(submesh, P()),
+                rng_s = _put_key_replicated(
+                    jax.random.fold_in(init_rng, 10_000 + s), submesh
                 )
                 with jax.set_mesh(submesh):
                     base, adapters = peft_method.inject(rt.params, rng_s)

@@ -39,6 +39,7 @@ from d9d_tpu.pipelining.program.actions import (
 )
 from d9d_tpu.pipelining.program.validate import validate_program
 from d9d_tpu.pipelining.runtime.stage import PipelineStageRuntime
+from d9d_tpu.pipelining.runtime.transfer import put_compat
 
 __all__ = ["PipelineExecutionResult", "PipelineScheduleExecutor"]
 
@@ -89,9 +90,7 @@ class PipelineScheduleExecutor:
 
     @staticmethod
     def _put(tree: PyTree, sharding) -> PyTree:
-        if sharding is None:
-            return tree
-        return jax.device_put(tree, sharding)
+        return put_compat(tree, sharding)
 
     def step(self, microbatches: list[PyTree]) -> PipelineExecutionResult:
         """Run the program over ``microbatches`` (list of host/device pytrees)."""

@@ -1,0 +1,60 @@
+"""Cross-stage-mesh transfers that work on every runtime.
+
+Pipeline stages live on different submeshes, and the executor/optimizer
+move activations, grad-norm scalars, and clip factors between them with
+``jax.device_put``. Runtimes differ in what they accept for a
+device->device copy between *different device sets*: TPU (TFRT) supports
+it (experimentally) — the fast path — while CPU multi-controller rejects
+it. ``put_compat`` falls back to reassembling from addressable shards:
+for every destination device this process owns, the matching global slice
+must already live on a source device this process owns, which holds for
+replicated values (every process has a local copy) and for pipeline
+layouts that keep stage boundaries process-local (interleave processes
+across the non-pp axes). Single-device copies are always legal and stay
+async — no host round-trip.
+"""
+
+import jax
+
+from d9d_tpu.core.types import PyTree
+
+__all__ = ["put_compat"]
+
+
+def _tuple_index(idx) -> tuple:
+    return tuple(
+        (s.start, s.stop, s.step) if isinstance(s, slice) else s for s in idx
+    )
+
+
+def _shardwise_put(x: jax.Array, sharding) -> jax.Array:
+    if not hasattr(x, "addressable_shards"):
+        return jax.device_put(x, sharding)
+    by_index = {}
+    for s in x.addressable_shards:
+        by_index.setdefault(_tuple_index(s.index), s.data)
+    idx_map = sharding.devices_indices_map(x.shape)
+    pieces = []
+    for dev in sharding.addressable_devices:
+        key = _tuple_index(idx_map[dev])
+        if key not in by_index:
+            raise ValueError(
+                "pipeline stage transfer needs a slice this process does "
+                "not own; lay pp stages out so every process holds the "
+                "same global slices on both sides of a stage boundary "
+                "(interleave processes across the non-pp axes), or use a "
+                "runtime with cross-host device transfers"
+            )
+        pieces.append(jax.device_put(by_index[key], dev))
+    return jax.make_array_from_single_device_arrays(x.shape, sharding, pieces)
+
+
+def put_compat(tree: PyTree, sharding) -> PyTree:
+    """``jax.device_put`` onto ``sharding``, with the shard-wise fallback
+    for runtimes that reject different-device-set copies."""
+    if sharding is None:
+        return tree
+    try:
+        return jax.device_put(tree, sharding)
+    except Exception:
+        return jax.tree.map(lambda x: _shardwise_put(x, sharding), tree)

@@ -24,6 +24,7 @@ import optax
 
 from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.pipelining.runtime.transfer import put_compat
 
 __all__ = ["PipelinedOptimizer"]
 
@@ -100,14 +101,14 @@ class PipelinedOptimizer:
         for s in sorted(stage_grads):
             with self._scoped(s):
                 sq = self._sq_norm(stage_grads[s])
-            sq_norms.append(jax.device_put(sq, anchor))
+            sq_norms.append(put_compat(sq, anchor))
         with self._scoped(last):
             norm, factor = self._combine(sq_norms, weight_sum)
 
         new_params: dict[int, PyTree] = {}
         new_states: dict[int, PyTree] = {}
         for s in sorted(stage_params):
-            f = jax.device_put(factor, self.scalar_shardings[s])
+            f = put_compat(factor, self.scalar_shardings[s])
             with self._scoped(s):
                 new_params[s], new_states[s] = self._update(
                     stage_params[s], opt_states[s], stage_grads[s], f

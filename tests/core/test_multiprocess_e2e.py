@@ -3,9 +3,14 @@
 Two OS processes, each with 4 virtual CPU devices, bootstrap through
 ``init_distributed`` (explicit localhost coordinator — the same channel a
 pod launch uses, reference configured.py:18,67-75), build one
-process-spanning 8-device mesh via ``MeshParameters.build``, and train an
-FSDP-sharded model for 6 steps with cross-process collectives (Gloo).
-Both processes must follow the identical loss trajectory.
+process-spanning 8-device mesh via ``MeshParameters.build``, and train
+with cross-process collectives (Gloo). Both processes must follow the
+identical loss trajectory. Two layouts:
+
+- ``fsdp``: dp_shard=8 across both processes;
+- ``pp``: pp=2 x dp_shard=4 with ``interleave_for_pp`` device ordering —
+  every pipeline stage spans both processes, stage boundaries stay
+  process-local (pipelining/runtime/transfer.py).
 
 This is the localhost-scaled version of the multi-host pod story
 (VERDICT r2 missing #1): everything between "two processes start" and
@@ -39,7 +44,13 @@ from d9d_tpu.parallel import fsdp_plan
 
 devs = jax.devices()
 assert len(devs) == 8, len(devs)  # 4 local x 2 processes
-ctx = MeshParameters(dp_shard=8).build(devs)
+LAYOUT = os.environ["TEST_LAYOUT"]
+if LAYOUT == "pp":
+    from d9d_tpu.core import interleave_for_pp
+
+    ctx = MeshParameters(pp=2, dp_shard=4).build(interleave_for_pp(devs, 2))
+else:
+    ctx = MeshParameters(dp_shard=8).build(devs)
 vocab = 64
 cfg = Qwen3DenseConfig(vocab_ranges=(("default", vocab),), hidden_size=32,
                        num_layers=2, num_heads=2, num_kv_heads=1, head_dim=16,
@@ -59,10 +70,12 @@ class D(DatasetProvider):
         while True:
             yield {"input_ids": base}
 
+pipeline = {"kind": "interleaved_1f1b"} if LAYOUT == "pp" else None
 tr = Trainer(ctx=ctx,
-             config=TrainerConfig(global_batch_size=8, microbatch_size=8,
+             config=TrainerConfig(global_batch_size=8,
+                                  microbatch_size=4 if LAYOUT == "pp" else 8,
                                   seq_len=32, total_steps=6, log_every=1,
-                                  learning_rate=5e-3),
+                                  learning_rate=5e-3, pipeline=pipeline),
              model_provider=P_(), dataset_provider=D(), task=CausalLMTask(),
              optimizer_provider=AdamWProvider())
 hist = tr.train()
@@ -78,7 +91,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_bootstrap_and_fsdp_training(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("layout", ["fsdp", "pp"])
+def test_two_process_bootstrap_and_training(tmp_path, layout):
     child = tmp_path / "child.py"
     child.write_text(_CHILD)
     port = _free_port()
@@ -92,6 +109,7 @@ def test_two_process_bootstrap_and_fsdp_training(tmp_path):
             "D9D_COORDINATOR": f"localhost:{port}",
             "D9D_NUM_PROCESSES": "2",
             "D9D_PROCESS_ID": str(pid),
+            "TEST_LAYOUT": layout,
         }
         procs.append(
             subprocess.Popen(
