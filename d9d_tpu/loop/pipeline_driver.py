@@ -64,22 +64,31 @@ def _deep_merge(trees: list[PyTree]) -> PyTree:
     return out
 
 
+def _key_to_host(key):
+    """RNG key → (host ndarray, key impl | None). Keys must cross mesh
+    boundaries as host data: a device-resident key carries its mesh in the
+    sharding type, and cannot be fetched/closed over when that mesh spans
+    processes. ``impl`` is None for old-style raw uint32 keys."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(key)), jax.random.key_impl(key)
+    return np.asarray(key), None
+
+
+def _key_from_host(data, impl):
+    """Inverse of ``_key_to_host`` (traceable — usable inside jit)."""
+    arr = jnp.asarray(data)
+    return jax.random.wrap_key_data(arr, impl=impl) if impl is not None else arr
+
+
 def _put_key_replicated(key, submesh) -> jax.Array:
     """Commit an RNG key to a stage submesh, replicated — staged through
-    the host. A device->device ``device_put`` between differently-sized
-    device lists (the key lives on the full mesh / a single device, the
-    stage submesh is a subset) is unsupported for cross-process meshes
-    ("CopyArrays only supports destination device list of the same size"),
-    while a host->device put onto any sharding always works: each process
-    materializes its addressable shards of the replicated value.
-    """
+    the host (see ``_key_to_host``)."""
     sharding = NamedSharding(submesh, P())
-    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
-        data = np.asarray(jax.random.key_data(key))
-        return jax.random.wrap_key_data(
-            jax.device_put(data, sharding), impl=jax.random.key_impl(key)
-        )
-    return jax.device_put(np.asarray(key), sharding)
+    data, impl = _key_to_host(key)
+    put = jax.device_put(data, sharding)
+    return (
+        jax.random.wrap_key_data(put, impl=impl) if impl is not None else put
+    )
 
 
 def build_pipeline_stages(
@@ -121,24 +130,14 @@ def build_pipeline_stages(
         # mesh in its sharding and poison the submesh-scoped init jit, and
         # (b) be un-fetchable as a closed-over constant when the submesh
         # spans multiple processes
-        folded = jax.random.fold_in(init_rng, s)
-        if jnp.issubdtype(folded.dtype, jax.dtypes.prng_key):
-            rng_host = np.asarray(jax.random.key_data(folded))
-            rng_impl = jax.random.key_impl(folded)
-        else:
-            rng_host = np.asarray(folded)
-            rng_impl = None
+        rng_host, rng_impl = _key_to_host(jax.random.fold_in(init_rng, s))
         carry_zero = _zeros_like_sdt(carry_sdt)
 
         def raw_init(
             module=module, rng_host=rng_host, rng_impl=rng_impl,
             carry=carry_zero, last=info.is_last,
         ):
-            rng = (
-                jax.random.wrap_key_data(jnp.asarray(rng_host), impl=rng_impl)
-                if rng_impl is not None
-                else jnp.asarray(rng_host)
-            )
+            rng = _key_from_host(rng_host, rng_impl)
             return task.stage_init(module, rng, carry, kwargs_s, state_s, last)
 
         if stage_params is not None and s in stage_params:

@@ -49,12 +49,42 @@ def _shardwise_put(x: jax.Array, sharding) -> jax.Array:
     return jax.make_array_from_single_device_arrays(x.shape, sharding, pieces)
 
 
+# Whether this runtime accepts a direct device_put between different
+# device sets (TPU/TFRT: yes; CPU multi-controller: no). Probed on the
+# first cross-set transfer and cached — the step path then branches
+# instead of raising and catching per transfer.
+_cross_set_direct: bool | None = None
+
+
 def put_compat(tree: PyTree, sharding) -> PyTree:
     """``jax.device_put`` onto ``sharding``, with the shard-wise fallback
-    for runtimes that reject different-device-set copies."""
+    for runtimes that reject different-device-set copies. Same-set puts
+    and host->device stages always take the direct path, so unrelated
+    device_put failures surface unmasked there."""
+    global _cross_set_direct
     if sharding is None:
         return tree
-    try:
-        return jax.device_put(tree, sharding)
-    except Exception:
-        return jax.tree.map(lambda x: _shardwise_put(x, sharding), tree)
+
+    dst_set = getattr(sharding, "device_set", None)
+
+    def one(x):
+        global _cross_set_direct
+        src = getattr(x, "sharding", None)
+        cross = (
+            src is not None
+            and dst_set is not None
+            and getattr(src, "device_set", dst_set) != dst_set
+        )
+        if not cross or _cross_set_direct is True:
+            return jax.device_put(x, sharding)
+        if _cross_set_direct is False:
+            return _shardwise_put(x, sharding)
+        try:
+            out = jax.device_put(x, sharding)
+        except ValueError:
+            _cross_set_direct = False
+            return _shardwise_put(x, sharding)
+        _cross_set_direct = True
+        return out
+
+    return jax.tree.map(one, tree)

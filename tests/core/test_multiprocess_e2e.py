@@ -23,6 +23,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 
 _CHILD = """
 import os, sys
@@ -90,8 +92,6 @@ def _free_port() -> int:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
-
-import pytest
 
 
 @pytest.mark.parametrize("layout", ["fsdp", "pp"])
