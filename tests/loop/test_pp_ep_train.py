@@ -85,7 +85,15 @@ def _train_pp_ep(ctx, *, with_tp: bool, seed: int) -> list[dict]:
         task=CausalLMTask(),
         optimizer_provider=AdamWProvider(),
     )
-    return trainer.train()
+    hist = trainer.train()
+    # forward-only path (inference program) with EP inside the stages:
+    # eval loss on the training batch must sit near the last train loss
+    raw = {"input_ids": np.random.RandomState(seed).randint(
+        0, VOCAB, size=(8, 33))}
+    eval_loss = trainer.loss_on_batch(raw)
+    assert abs(eval_loss - float(hist[-1]["loss"])) < 0.5, (
+        eval_loss, float(hist[-1]["loss"]))
+    return hist
 
 
 @pytest.mark.parametrize("layout", ["pp_dp_ep", "pp_dp_tp_ep"])
