@@ -73,17 +73,26 @@ class D(DatasetProvider):
             yield {"input_ids": base}
 
 pipeline = {"kind": "interleaved_1f1b"} if LAYOUT == "pp" else None
+total_steps = int(os.environ.get("TEST_TOTAL_STEPS", "6"))
+ckpt_dir = os.environ.get("TEST_CKPT_DIR")
 tr = Trainer(ctx=ctx,
              config=TrainerConfig(global_batch_size=8,
                                   microbatch_size=4 if LAYOUT == "pp" else 8,
-                                  seq_len=32, total_steps=6, log_every=1,
-                                  learning_rate=5e-3, pipeline=pipeline),
+                                  seq_len=32, total_steps=total_steps,
+                                  log_every=1, learning_rate=5e-3,
+                                  pipeline=pipeline,
+                                  checkpoint_dir=ckpt_dir,
+                                  checkpoint_every_steps=3 if ckpt_dir else None),
              model_provider=P_(), dataset_provider=D(), task=CausalLMTask(),
              optimizer_provider=AdamWProvider())
 hist = tr.train()
 l0, l1 = float(hist[0]["loss"]), float(hist[-1]["loss"])
-print(f"RESULT {l0:.6f} {l1:.6f}", flush=True)
-assert l1 < l0 - 0.2, (l0, l1)
+first_step = hist[0]["step"]
+print(f"RESULT step{first_step} {l0:.6f} {l1:.6f}", flush=True)
+if os.environ.get("TEST_EXPECT_RESUME"):
+    assert first_step == 4, first_step  # resumed past the step-3 save
+else:
+    assert l1 < l0 - 0.2, (l0, l1)
 """
 
 
@@ -92,6 +101,39 @@ def _free_port() -> int:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
+
+
+def _spawn_pair(child, root, layout, extra_env):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(root),
+            "D9D_COORDINATOR": f"localhost:{port}",
+            "D9D_NUM_PROCESSES": "2",
+            "D9D_PROCESS_ID": str(pid),
+            "TEST_LAYOUT": layout,
+            **extra_env,
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(child)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=540)
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err[-3000:]}"
+        outs.append(out)
+    return [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT")
+    ]
 
 
 @pytest.mark.parametrize("layout", ["fsdp", "pp"])
@@ -134,3 +176,26 @@ def test_two_process_bootstrap_and_training(tmp_path, layout):
     assert len(results) == 2
     # identical trajectory on both processes (same global computation)
     assert results[0] == results[1], results
+
+
+def test_two_process_checkpoint_resume(tmp_path):
+    """Multi-host orbax job-state checkpointing: a 2-process FSDP run saves
+    at step 3; a FRESH pair of processes resumes from the shared directory
+    and continues at step 4 — the reference's restart-and-auto-resume
+    recovery story (checkpointer.py:150-161) across hosts."""
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    root = pathlib.Path(__file__).resolve().parent.parent.parent
+    ckpt = str(tmp_path / "shared_ckpt")
+
+    first = _spawn_pair(child, root, "fsdp", {
+        "TEST_TOTAL_STEPS": "3", "TEST_CKPT_DIR": ckpt,
+    })
+    assert len(first) == 2 and first[0] == first[1]
+
+    resumed = _spawn_pair(child, root, "fsdp", {
+        "TEST_TOTAL_STEPS": "6", "TEST_CKPT_DIR": ckpt,
+        "TEST_EXPECT_RESUME": "1",
+    })
+    assert len(resumed) == 2 and resumed[0] == resumed[1]
+    assert resumed[0].split()[1] == "step4", resumed
