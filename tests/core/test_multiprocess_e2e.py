@@ -40,9 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 from d9d_tpu.loop import (AdamWProvider, CausalLMTask, DatasetProvider,
                           ModelProvider, Trainer, TrainerConfig)
-from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.models.qwen3 import (Qwen3DenseCausalLM, Qwen3DenseConfig,
+                                   Qwen3MoeCausalLM, Qwen3MoeConfig)
 from d9d_tpu.nn.sdpa import build_sdpa_backend
-from d9d_tpu.parallel import fsdp_plan
+from d9d_tpu.parallel import fsdp_ep_plan, fsdp_plan
 
 devs = jax.devices()
 assert len(devs) == 8, len(devs)  # 4 local x 2 processes
@@ -51,18 +52,32 @@ if LAYOUT == "pp":
     from d9d_tpu.core import interleave_for_pp
 
     ctx = MeshParameters(pp=2, dp_shard=4).build(interleave_for_pp(devs, 2))
+elif LAYOUT == "ep":
+    # expert parallelism ACROSS processes: the ragged all-to-all flow's
+    # shard_map spans both hosts
+    ctx = MeshParameters(dp_shard=8, ep_shard=8).build(devs)
 else:
     ctx = MeshParameters(dp_shard=8).build(devs)
 vocab = 64
-cfg = Qwen3DenseConfig(vocab_ranges=(("default", vocab),), hidden_size=32,
-                       num_layers=2, num_heads=2, num_kv_heads=1, head_dim=16,
-                       intermediate_size=64, remat=False)
+if LAYOUT == "ep":
+    cfg = Qwen3MoeConfig(vocab_ranges=(("default", vocab),), hidden_size=32,
+                         num_layers=2, num_heads=2, num_kv_heads=1,
+                         head_dim=16, moe_intermediate_size=32, num_experts=8,
+                         num_experts_per_tok=2, remat=False,
+                         ep_axes=ctx.ep_shard_axes,
+                         moe_token_axes=(ctx.batch_axes, ctx.sequence_axes))
+else:
+    cfg = Qwen3DenseConfig(vocab_ranges=(("default", vocab),), hidden_size=32,
+                           num_layers=2, num_heads=2, num_kv_heads=1,
+                           head_dim=16, intermediate_size=64, remat=False)
 
 class P_(ModelProvider):
     def build_module(self, stage):
-        return Qwen3DenseCausalLM(config=cfg, sdpa=build_sdpa_backend(),
-                                  stage=stage, dtype=jnp.float32)
-    def build_plan(self, c): return fsdp_plan(c)
+        cls = Qwen3MoeCausalLM if LAYOUT == "ep" else Qwen3DenseCausalLM
+        return cls(config=cfg, sdpa=build_sdpa_backend(),
+                   stage=stage, dtype=jnp.float32)
+    def build_plan(self, c):
+        return fsdp_ep_plan(c) if LAYOUT == "ep" else fsdp_plan(c)
     def sample_inputs(self, b, t):
         z = jnp.zeros((b, t), jnp.int32); return (z, z, z)
 
@@ -136,7 +151,7 @@ def _spawn_pair(child, root, layout, extra_env):
     ]
 
 
-@pytest.mark.parametrize("layout", ["fsdp", "pp"])
+@pytest.mark.parametrize("layout", ["fsdp", "pp", "ep"])
 def test_two_process_bootstrap_and_training(tmp_path, layout):
     child = tmp_path / "child.py"
     child.write_text(_CHILD)
