@@ -56,6 +56,10 @@ elif LAYOUT == "ep":
     # expert parallelism ACROSS processes: the ragged all-to-all flow's
     # shard_map spans both hosts
     ctx = MeshParameters(dp_shard=8, ep_shard=8).build(devs)
+elif LAYOUT == "cp":
+    # ring attention ACROSS processes: the kv ring's ppermute hops the
+    # process boundary every step
+    ctx = MeshParameters(dp_shard=4, cp_shard=2).build(devs)
 else:
     ctx = MeshParameters(dp_shard=8).build(devs)
 vocab = 64
@@ -71,11 +75,19 @@ else:
                            num_layers=2, num_heads=2, num_kv_heads=1,
                            head_dim=16, intermediate_size=64, remat=False)
 
+if LAYOUT == "cp":
+    from d9d_tpu.nn.sdpa import SdpaRingConfig
+
+    SDPA = build_sdpa_backend(SdpaRingConfig(
+        seq_axis="cp_s", batch_axes=("dp_r", "dp_s"), head_axes=()))
+else:
+    SDPA = build_sdpa_backend()
+
+
 class P_(ModelProvider):
     def build_module(self, stage):
         cls = Qwen3MoeCausalLM if LAYOUT == "ep" else Qwen3DenseCausalLM
-        return cls(config=cfg, sdpa=build_sdpa_backend(),
-                   stage=stage, dtype=jnp.float32)
+        return cls(config=cfg, sdpa=SDPA, stage=stage, dtype=jnp.float32)
     def build_plan(self, c):
         return fsdp_ep_plan(c) if LAYOUT == "ep" else fsdp_plan(c)
     def sample_inputs(self, b, t):
@@ -151,7 +163,7 @@ def _spawn_pair(child, root, layout, extra_env):
     ]
 
 
-@pytest.mark.parametrize("layout", ["fsdp", "pp", "ep"])
+@pytest.mark.parametrize("layout", ["fsdp", "pp", "ep", "cp"])
 def test_two_process_bootstrap_and_training(tmp_path, layout):
     child = tmp_path / "child.py"
     child.write_text(_CHILD)
