@@ -204,6 +204,71 @@ def test_two_process_bootstrap_and_training(tmp_path, layout):
     # identical trajectory on both processes (same global computation)
     assert results[0] == results[1], results
 
+    if layout == "fsdp":
+        # ...and the SAME trajectory as an in-process run of the identical
+        # config on this session's 8-device mesh: two hosts + Gloo
+        # collectives must not change the math, only the execution geometry
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from d9d_tpu.core import MeshParameters
+        from d9d_tpu.loop import (AdamWProvider, CausalLMTask,
+                                  DatasetProvider, ModelProvider, Trainer,
+                                  TrainerConfig)
+        from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+        from d9d_tpu.nn.sdpa import build_sdpa_backend
+        from d9d_tpu.parallel import fsdp_plan
+
+        vocab = 64
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", vocab),), hidden_size=32, num_layers=2,
+            num_heads=2, num_kv_heads=1, head_dim=16, intermediate_size=64,
+            remat=False,
+        )
+
+        class P_(ModelProvider):
+            def build_module(self, stage):
+                return Qwen3DenseCausalLM(
+                    config=cfg, sdpa=build_sdpa_backend(), stage=stage,
+                    dtype=jnp.float32,
+                )
+
+            def build_plan(self, c):
+                return fsdp_plan(c)
+
+            def sample_inputs(self, b, t):
+                z = jnp.zeros((b, t), jnp.int32)
+                return (z, z, z)
+
+        class D_(DatasetProvider):
+            def build(self):
+                base = np.random.RandomState(0).randint(
+                    0, vocab, size=(8, 33)
+                )
+                while True:
+                    yield {"input_ids": base}
+
+        ctx = MeshParameters(dp_shard=8).build(jax.devices())
+        tr = Trainer(
+            ctx=ctx,
+            config=TrainerConfig(
+                global_batch_size=8, microbatch_size=8, seq_len=32,
+                total_steps=6, log_every=1, learning_rate=5e-3,
+            ),
+            model_provider=P_(),
+            dataset_provider=D_(),
+            task=CausalLMTask(),
+            optimizer_provider=AdamWProvider(),
+        )
+        hist = tr.train()
+        _, _, child_l0, child_l1 = results[0].split()
+        np.testing.assert_allclose(
+            [float(hist[0]["loss"]), float(hist[-1]["loss"])],
+            [float(child_l0), float(child_l1)],
+            rtol=1e-4,
+        )
+
 
 def test_two_process_checkpoint_resume(tmp_path):
     """Multi-host orbax job-state checkpointing: a 2-process FSDP run saves
