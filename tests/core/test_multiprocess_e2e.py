@@ -204,10 +204,11 @@ def test_two_process_bootstrap_and_training(tmp_path, layout):
     # identical trajectory on both processes (same global computation)
     assert results[0] == results[1], results
 
-    if layout == "fsdp":
+    if layout in ("fsdp", "pp"):
         # ...and the SAME trajectory as an in-process run of the identical
         # config on this session's 8-device mesh: two hosts + Gloo
-        # collectives must not change the math, only the execution geometry
+        # collectives (and, for pp, the shard-wise boundary transfers)
+        # must not change the math, only the execution geometry
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -249,12 +250,18 @@ def test_two_process_bootstrap_and_training(tmp_path, layout):
                 while True:
                     yield {"input_ids": base}
 
-        ctx = MeshParameters(dp_shard=8).build(jax.devices())
+        if layout == "pp":
+            ctx = MeshParameters(pp=2, dp_shard=4).build(jax.devices())
+        else:
+            ctx = MeshParameters(dp_shard=8).build(jax.devices())
         tr = Trainer(
             ctx=ctx,
             config=TrainerConfig(
-                global_batch_size=8, microbatch_size=8, seq_len=32,
-                total_steps=6, log_every=1, learning_rate=5e-3,
+                global_batch_size=8,
+                microbatch_size=4 if layout == "pp" else 8,
+                seq_len=32, total_steps=6, log_every=1, learning_rate=5e-3,
+                pipeline={"kind": "interleaved_1f1b"}
+                if layout == "pp" else None,
             ),
             model_provider=P_(),
             dataset_provider=D_(),
