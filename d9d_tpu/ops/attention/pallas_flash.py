@@ -50,17 +50,29 @@ class _FlashConfig:
     has_segments: bool
     block_q: int
     block_kv: int
-    seq_len: int  # real (unpadded) length
+    seq_len: int  # real (unpadded) kv length
     interpret: bool
+    # When True the kernel takes a leading SMEM int32[2] = [q_offset,
+    # k_offset] input and causal/window masking runs on GLOBAL positions
+    # (local + offset). This is how ring attention reuses the kernel: each
+    # ring step attends a local q chunk against a rotating k/v chunk whose
+    # global offsets are device-dependent (traced), so they cannot live in
+    # this static config.
+    has_positions: bool = False
 
 
-def _mask_block(s, cfg: _FlashConfig, iq, ik, q_seg, k_seg):
+def _mask_block(s, cfg: _FlashConfig, iq, ik, q_seg, k_seg, qoff=None, koff=None):
     """Apply length / causal / window / segment masking to one [bq, bkv]
-    logit block."""
+    logit block. ``qoff``/``koff`` are traced global-position offsets
+    (SMEM scalars) when ``cfg.has_positions``."""
     bq, bkv = s.shape
     q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-    k_pos = ik * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-    mask = k_pos < cfg.seq_len
+    k_loc = ik * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    mask = k_loc < cfg.seq_len  # padding is local regardless of offsets
+    k_pos = k_loc
+    if qoff is not None:
+        q_pos = q_pos + qoff
+        k_pos = k_pos + koff
     if cfg.causal:
         mask &= k_pos <= q_pos
     if cfg.window is not None:
@@ -70,13 +82,25 @@ def _mask_block(s, cfg: _FlashConfig, iq, ik, q_seg, k_seg):
     return jnp.where(mask, s, NEG_BIG)
 
 
-def _skip_block(cfg: _FlashConfig, iq, ik):
-    """True when the whole kv block is masked for the whole q block."""
+def _skip_block(cfg: _FlashConfig, iq, ik, qoff=None, koff=None):
+    """True when the whole kv block is masked for the whole q block.
+
+    Static (python bool arithmetic) without offsets; with traced offsets it
+    becomes a scalar predicate — ``pl.when`` accepts both, and on TPU the
+    dynamic form still skips the MXU work (e.g. every block of a ring step
+    whose kv chunk is entirely in the causal future)."""
+    q_lo = iq * cfg.block_q
+    q_hi = q_lo + cfg.block_q - 1
+    k_lo = ik * cfg.block_kv
+    k_hi = k_lo + cfg.block_kv - 1
+    if qoff is not None:
+        q_lo, q_hi = q_lo + qoff, q_hi + qoff
+        k_lo, k_hi = k_lo + koff, k_hi + koff
     skip = jnp.asarray(False)
     if cfg.causal:
-        skip |= ik * cfg.block_kv > iq * cfg.block_q + cfg.block_q - 1
+        skip |= k_lo > q_hi
     if cfg.window is not None:
-        skip |= (ik + 1) * cfg.block_kv - 1 <= iq * cfg.block_q - cfg.window
+        skip |= k_hi <= q_lo - cfg.window
     return skip
 
 
@@ -90,7 +114,16 @@ def _read_segs(cfg: _FlashConfig, qseg_ref, kseg_ref):
     return q_seg, k_seg
 
 
+def _read_offsets(cfg: _FlashConfig, refs):
+    """Split off the leading SMEM offsets ref when positions are in use."""
+    if not cfg.has_positions:
+        return None, None, refs
+    offs_ref, *rest = refs
+    return offs_ref[0], offs_ref[1], tuple(rest)
+
+
 def _fwd_kernel(*refs, cfg: _FlashConfig):
+    qoff, koff, refs = _read_offsets(cfg, refs)
     if cfg.has_segments:
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref = refs[:5]
         o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[5:]
@@ -106,7 +139,7 @@ def _fwd_kernel(*refs, cfg: _FlashConfig):
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
+    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik, qoff, koff)))
     def _compute():
         q = q_ref[0, 0, :, :].astype(jnp.float32)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
@@ -115,7 +148,7 @@ def _fwd_kernel(*refs, cfg: _FlashConfig):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg, qoff, koff)
 
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
@@ -139,6 +172,7 @@ def _fwd_kernel(*refs, cfg: _FlashConfig):
 
 
 def _bwd_dq_kernel(*refs, cfg: _FlashConfig):
+    qoff, koff, refs = _read_offsets(cfg, refs)
     if cfg.has_segments:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref = refs[:8]
         dq_ref, dq_acc = refs[8:]
@@ -152,7 +186,7 @@ def _bwd_dq_kernel(*refs, cfg: _FlashConfig):
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
+    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik, qoff, koff)))
     def _compute():
         q = q_ref[0, 0, :, :].astype(jnp.float32)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
@@ -165,7 +199,7 @@ def _bwd_dq_kernel(*refs, cfg: _FlashConfig):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg, qoff, koff)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -179,6 +213,7 @@ def _bwd_dq_kernel(*refs, cfg: _FlashConfig):
 
 
 def _bwd_dkv_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
+    qoff, koff, refs = _read_offsets(cfg, refs)
     if cfg.has_segments:
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref = refs[:8]
         dk_ref, dv_ref, dk_acc, dv_acc = refs[8:]
@@ -195,7 +230,7 @@ def _bwd_dkv_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik)))
+    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik, qoff, koff)))
     def _compute():
         q = q_ref[0, 0, :, :].astype(jnp.float32)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
@@ -208,7 +243,7 @@ def _bwd_dkv_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * cfg.scale
-        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg)
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg, qoff, koff)
         p = jnp.exp(s - lse)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -265,13 +300,16 @@ def _to_bhtd(x, pad):
     return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
-    o, _ = _flash_fwd(cfg, q, k, v, sinks, q_seg, kv_seg)
-    return o
+def _offs_args(cfg: _FlashConfig, offsets):
+    """Leading SMEM input (spec, buffer) when positions are in use."""
+    if not cfg.has_positions:
+        return (), ()
+    return (pl.BlockSpec(memory_space=pltpu.SMEM),), (offsets,)
 
 
-def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
+def _fwd_call(cfg: _FlashConfig, q, k, v, offsets, q_seg, kv_seg):
+    """Raw forward kernel invocation: ``(o [B,T,H,D], lse [B,H,T])``,
+    no sink correction."""
     b, t, h, d = q.shape
     _, s, hkv, _ = k.shape
     g = h // hkv
@@ -280,6 +318,7 @@ def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
     n_q, n_kv = tq // cfg.block_q, tk // cfg.block_kv
 
     qp, kp, vp = _to_bhtd(q, pad_q), _to_bhtd(k, pad_k), _to_bhtd(v, pad_k)
+    offs_specs, offs_bufs = _offs_args(cfg, offsets)
 
     grid = (b, h, n_q, n_kv)
     kernel = functools.partial(_fwd_kernel, cfg=cfg)
@@ -287,6 +326,7 @@ def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
         kernel,
         grid=grid,
         in_specs=[
+            *offs_specs,
             pl.BlockSpec((1, 1, cfg.block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, cfg.block_kv, d),
@@ -316,34 +356,48 @@ def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
         ],
         compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, *_seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k))
+    )(*offs_bufs, qp, kp, vp,
+      *_seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k))
 
     o = o[:, :, :t]
     lse = lse[:, :, :t, 0]  # [B, H, T]
+    o = jnp.transpose(o, (0, 2, 1, 3))  # back to [B, T, H, D]
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
+    o, _ = _flash_fwd(cfg, q, k, v, sinks, q_seg, kv_seg)
+    return o
+
+
+def _flash_fwd(cfg: _FlashConfig, q, k, v, sinks, q_seg, kv_seg):
+    o, lse = _fwd_call(cfg, q, k, v, None, q_seg, kv_seg)
     if cfg.has_sinks:
         # sink joins only the denominator: l' = l + exp(sink - m), so
         # o' = o / (1 + exp(sink - lse)) and lse' = lse + log1p(same).
         z = jnp.clip(sinks.astype(jnp.float32)[None, :, None] - lse, max=60.0)
-        corr = jnp.exp(z)
-        o = (o.astype(jnp.float32) / (1.0 + corr)[..., None]).astype(o.dtype)
+        corr = jnp.exp(z)  # [B, H, T]
+        inv = (1.0 / (1.0 + corr)).transpose(0, 2, 1)[..., None]  # [B,T,H,1]
+        o = (o.astype(jnp.float32) * inv).astype(o.dtype)
         lse = lse + jnp.log1p(corr)
-    o_out = jnp.transpose(o, (0, 2, 1, 3))  # back to [B, T, H, D]
-    return o_out, (q, k, v, sinks, q_seg, kv_seg, o_out, lse)
+    return o, (q, k, v, sinks, q_seg, kv_seg, o, lse)
 
 
-def _flash_bwd(cfg: _FlashConfig, residuals, do):
-    q, k, v, sinks, q_seg, kv_seg, o, lse = residuals
+def _bwd_call(cfg: _FlashConfig, q, k, v, do, lse, delta, offsets, q_seg, kv_seg):
+    """Raw backward kernel invocations: ``(dq, dk, dv)`` in [B,T,H,D].
+
+    ``delta`` is the per-row correction the kernels subtract inside
+    ``ds = p · (dp − delta) · scale`` — pass ``rowsum(dO⊙O)`` for a plain
+    output cotangent, or ``rowsum(dO⊙O) − dlse`` when an lse cotangent is
+    in play (∂lse/∂s = p, so it folds into the same term)."""
     b, t, h, d = q.shape
     _, s, hkv, _ = k.shape
     g = h // hkv
     pad_q, pad_k = _pad_len(t, cfg.block_q), _pad_len(s, cfg.block_kv)
     tq, tk = t + pad_q, s + pad_k
     n_q, n_kv = tq // cfg.block_q, tk // cfg.block_kv
-
-    # Δ = rowsum(dO ⊙ O) per (b, h, t); O was saved by the forward.
-    delta = jnp.einsum(
-        "bthd,bthd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
-    )
+    offs_specs, offs_bufs = _offs_args(cfg, offsets)
 
     def col(x, pad):  # [B, H, T] → padded [B, H, Tq, 1]
         x = jnp.pad(x, ((0, 0), (0, 0), (0, pad))) if pad else x
@@ -365,6 +419,7 @@ def _flash_bwd(cfg: _FlashConfig, residuals, do):
         functools.partial(_bwd_dq_kernel, cfg=cfg),
         grid=(b, h, n_q, n_kv),
         in_specs=[
+            *offs_specs,
             q_like, kv_like, kv_like, q_like, col_like, col_like,
             *_seg_specs(
                 cfg,
@@ -377,7 +432,7 @@ def _flash_bwd(cfg: _FlashConfig, residuals, do):
         scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
         compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, dop, lsep, deltap, *segs)
+    )(*offs_bufs, qp, kp, vp, dop, lsep, deltap, *segs)
 
     # grid: (b, hkv, kv-block, g·q-block) — q heads and q blocks share the
     # inner sequential dim so dk/dv accumulate across both
@@ -396,6 +451,7 @@ def _flash_bwd(cfg: _FlashConfig, residuals, do):
         functools.partial(_bwd_dkv_kernel, cfg=cfg, n_q_blocks=n_q),
         grid=(b, hkv, n_kv, g * n_q),
         in_specs=[
+            *offs_specs,
             q_gather, kv_self, kv_self, q_gather, col_gather, col_gather,
             *_seg_specs(
                 cfg,
@@ -414,11 +470,22 @@ def _flash_bwd(cfg: _FlashConfig, residuals, do):
         ],
         compiler_params=_compiler_params(cfg),
         interpret=cfg.interpret,
-    )(qp, kp, vp, dop, lsep, deltap, *segs)
+    )(*offs_bufs, qp, kp, vp, dop, lsep, deltap, *segs)
 
     dq = jnp.transpose(dq[:, :, :t], (0, 2, 1, 3))
     dk = jnp.transpose(dk[:, :, :s], (0, 2, 1, 3))
     dv = jnp.transpose(dv[:, :, :s], (0, 2, 1, 3))
+    return dq, dk, dv
+
+
+def _flash_bwd(cfg: _FlashConfig, residuals, do):
+    q, k, v, sinks, q_seg, kv_seg, o, lse = residuals
+
+    # Δ = rowsum(dO ⊙ O) per (b, h, t); O was saved by the forward.
+    delta = jnp.einsum(
+        "bthd,bthd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
+    )
+    dq, dk, dv = _bwd_call(cfg, q, k, v, do, lse, delta, None, q_seg, kv_seg)
 
     if cfg.has_sinks:
         # p_sink[b,h,t] = exp(sink_h - lse); dsink = -Σ p_sink · Δ
@@ -443,6 +510,107 @@ def _zero_cotangent(x):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_ol(cfg: _FlashConfig, q, k, v, offsets, q_seg, kv_seg):
+    """Flash block returning ``(o, lse)`` — the composable form ring
+    attention stitches across devices. Differentiable in BOTH outputs:
+    the lse cotangent from the downstream combine folds into the existing
+    backward kernels through the delta term (see :func:`_bwd_call`)."""
+    return _fwd_call(cfg, q, k, v, offsets, q_seg, kv_seg)
+
+
+def _flash_ol_fwd(cfg: _FlashConfig, q, k, v, offsets, q_seg, kv_seg):
+    o, lse = _fwd_call(cfg, q, k, v, offsets, q_seg, kv_seg)
+    return (o, lse), (q, k, v, offsets, q_seg, kv_seg, o, lse)
+
+
+def _flash_ol_bwd(cfg: _FlashConfig, residuals, cotangents):
+    q, k, v, offsets, q_seg, kv_seg, o, lse = residuals
+    do, dlse = cotangents
+    delta = jnp.einsum(
+        "bthd,bthd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
+    ) - dlse.astype(jnp.float32)
+    dq, dk, dv = _bwd_call(cfg, q, k, v, do, lse, delta, offsets, q_seg, kv_seg)
+    return (dq, dk, dv, _zero_cotangent(offsets),
+            _zero_cotangent(q_seg), _zero_cotangent(kv_seg))
+
+
+_flash_ol.defvjp(_flash_ol_fwd, _flash_ol_bwd)
+
+
+def _clamp_block(block: int, n: int) -> int:
+    return min(block, max(8, 2 ** math.ceil(math.log2(max(n, 1)))))
+
+
+def combine_attention_chunks(
+    o: Array, lse: Array, o_new: Array, lse_new: Array
+) -> tuple[Array, Array]:
+    """Merge two normalized partial attention results ``(o [B,T,H,D],
+    lse [B,H,T])`` over disjoint key sets into one — the logsumexp combine
+    every :func:`flash_attention_block` consumer (ring steps, chunked
+    simulations) must apply. Accumulates in fp32."""
+    merged = jnp.logaddexp(lse, lse_new)
+    w0 = jnp.exp(lse - merged).transpose(0, 2, 1)[..., None]
+    w1 = jnp.exp(lse_new - merged).transpose(0, 2, 1)[..., None]
+    out = o.astype(jnp.float32) * w0 + o_new.astype(jnp.float32) * w1
+    return out, merged
+
+
+def flash_attention_block(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    q_offset: Array | int,
+    k_offset: Array | int,
+    causal: bool = True,
+    softmax_scale: float | None = None,
+    window_size: int | None = None,
+    q_segments: Array | None = None,
+    kv_segments: Array | None = None,
+    block_q: int = 1024,
+    block_kv: int = 512,
+    interpret: bool | None = None,
+) -> tuple[Array, Array]:
+    """One flash-attention block at arbitrary global offsets → ``(o, lse)``.
+
+    ``q [B,T,Hq,D]`` attends ``k/v [B,S,Hkv,D]`` as if the q rows sat at
+    global positions ``q_offset + [0,T)`` and the keys at
+    ``k_offset + [0,S)`` (offsets may be traced, e.g. derived from
+    ``lax.axis_index`` inside shard_map). Causal/window masking uses those
+    global positions; blocks wholly outside them are skipped on the fly.
+    Rows with no visible key come back as ``o=garbage, lse≈-1e30`` — a
+    downstream logsumexp-combine weighs them to zero.
+
+    This is the per-ring-step primitive: combine partial results with
+    ``new_lse = logaddexp(lse_a, lse_b)`` and
+    ``o = exp(lse_a-new_lse)·o_a + exp(lse_b-new_lse)·o_b``. Both outputs
+    are differentiable (reference treats attention as always-flash —
+    d9d/kernel/flash_attn/function.py:331; this brings the CP ring to the
+    same bar).
+    """
+    t, s, d = q.shape[1], k.shape[1], q.shape[-1]
+    if (q_segments is None) != (kv_segments is None):
+        raise ValueError("q_segments and kv_segments must be provided together")
+    cfg = _FlashConfig(
+        causal=causal,
+        scale=softmax_scale if softmax_scale is not None else d**-0.5,
+        window=window_size,
+        has_sinks=False,
+        has_segments=q_segments is not None,
+        block_q=_clamp_block(block_q, t),
+        block_kv=_clamp_block(block_kv, s),
+        seq_len=s,
+        interpret=(jax.default_backend() != "tpu"
+                   if interpret is None else interpret),
+        has_positions=True,
+    )
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
+    return _flash_ol(cfg, q, k, v, offsets, q_segments, kv_segments)
 
 
 def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
@@ -488,8 +656,8 @@ def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
             window=window_size,
             has_sinks=sinks is not None,
             has_segments=q_segments is not None,
-            block_q=min(block_q, max(8, 2 ** math.ceil(math.log2(max(t, 1))))),
-            block_kv=min(block_kv, max(8, 2 ** math.ceil(math.log2(max(t, 1))))),
+            block_q=_clamp_block(block_q, t),
+            block_kv=_clamp_block(block_kv, t),
             seq_len=t,
             interpret=jax.default_backend() != "tpu",
         )

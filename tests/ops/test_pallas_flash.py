@@ -172,3 +172,63 @@ class TestSegments:
         q = rng(1, 16, 1, 8)
         with pytest.raises(ValueError):
             flash(q, q, q, q_segments=_packed_segments(1, 16, 2))
+
+
+class TestAttentionBlock:
+    """flash_attention_block: the (o, lse) chunk primitive ring attention
+    composes. Chunked calls at global offsets + an lse-combine must equal
+    one full-sequence attention, fwd and bwd (the bwd exercises the dlse
+    cotangent folding into delta)."""
+
+    def _combine(self, parts):
+        from d9d_tpu.ops.attention.pallas_flash import combine_attention_chunks
+
+        o, lse = parts[0]
+        for o2, lse2 in parts[1:]:
+            o, lse = combine_attention_chunks(o, lse, o2, lse2)
+        return o
+
+    @pytest.mark.parametrize("n_chunks,kw", [
+        (2, {}),
+        (4, {"window_size": 13}),
+        (2, {"causal": False}),
+    ])
+    def test_chunked_matches_full(self, n_chunks, kw):
+        from d9d_tpu.ops.attention.pallas_flash import flash_attention_block
+
+        b, t, hq, hkv, d = 2, 64, 4, 2, 16
+        q = rng(b, t, hq, d)
+        k, v = rng(b, t, hkv, d, seed=1), rng(b, t, hkv, d, seed=2)
+        seg = _packed_segments(b, t, 3)
+        c = t // n_chunks
+
+        def loss_chunked(q, k, v):
+            parts = [
+                flash_attention_block(
+                    q, k[:, i * c:(i + 1) * c], v[:, i * c:(i + 1) * c],
+                    q_offset=0, k_offset=i * c,
+                    q_segments=seg, kv_segments=seg[:, i * c:(i + 1) * c],
+                    block_q=16, block_kv=16, **kw)
+                for i in range(n_chunks)
+            ]
+            return (self._combine(parts) ** 2).sum()
+
+        def loss_full(q, k, v):
+            return (eager_sdpa(q, k, v, q_segments=seg,
+                               kv_segments=seg, **kw) ** 2).sum()
+
+        lc, gc = jax.value_and_grad(loss_chunked, (0, 1, 2))(q, k, v)
+        le, ge = jax.value_and_grad(loss_full, (0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(lc, le, rtol=2e-3, atol=2e-3)
+        for a, b_ in zip(gc, ge):
+            np.testing.assert_allclose(a, b_, rtol=5e-3, atol=5e-3)
+
+    def test_fully_future_chunk_is_weightless(self):
+        from d9d_tpu.ops.attention.pallas_flash import flash_attention_block
+
+        q = rng(1, 16, 2, 8)
+        k, v = rng(1, 16, 2, 8, seed=1), rng(1, 16, 2, 8, seed=2)
+        # keys sit entirely in the causal future of every query
+        o, lse = flash_attention_block(
+            q, k, v, q_offset=0, k_offset=1024, block_q=16, block_kv=16)
+        assert np.all(np.asarray(lse) < -1e29)

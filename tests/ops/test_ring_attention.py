@@ -190,6 +190,46 @@ def test_ring_packed_segments_match_eager(devices, mesh_kw, batch_axes, head_axe
         _assert_close(gr, ge, atol=1e-4, rtol=1e-4)
 
 
+def test_ring_flash_matches_eager_impl(devices):
+    """The two ring block implementations (Pallas flash blocks with the
+    lse-combine vs the fp32 einsum oracle) agree fwd+bwd on a config
+    exercising causal+window+sinks together."""
+    ctx = MeshParameters(cp_shard=4).build(devices[:4])
+    b, t, hq, hkv, d = 2, 32, 4, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), b, t, hq, hkv, d)
+    sinks = jax.random.normal(jax.random.PRNGKey(8), (hq,))
+    sh = NamedSharding(ctx.mesh, P(None, "cp_s", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def make_loss(impl):
+        ring = make_ring_sdpa(
+            ctx.mesh, seq_axis="cp_s", batch_axes=(), head_axes=(), impl=impl
+        )
+
+        def loss(q, k, v, s):
+            o = ring(q, k, v, causal=True, window_size=11, sinks=s)
+            return jnp.sum(jnp.sin(o)), o
+
+        return jax.jit(jax.value_and_grad(loss, (0, 1, 2, 3), has_aux=True))
+
+    (l_f, o_f), g_f = make_loss("flash")(qs, ks, vs, sinks)
+    (l_e, o_e), g_e = make_loss("eager")(qs, ks, vs, sinks)
+    _assert_close(o_f, o_e)
+    _assert_close(l_f, l_e)
+    for gf, ge in zip(g_f, g_e):
+        _assert_close(gf, ge, atol=1e-4, rtol=1e-4)
+
+
+def test_ring_rejects_unknown_impl(devices):
+    ctx = MeshParameters(cp_shard=4).build(devices[:4])
+    ring = make_ring_sdpa(
+        ctx.mesh, seq_axis="cp_s", batch_axes=(), head_axes=(), impl="nope"
+    )
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 1, 8, 2, 2, 4)
+    with pytest.raises(ValueError, match="ring block impl"):
+        jax.jit(lambda a, b_, c: ring(a, b_, c))(q, k, v)
+
+
 def test_ring_segments_require_both(devices):
     ctx = MeshParameters(cp_shard=4).build(devices[:4])
     ring = make_ring_sdpa(ctx.mesh, seq_axis="cp_s", batch_axes=(), head_axes=())

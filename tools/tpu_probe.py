@@ -1,0 +1,49 @@
+"""Probe whether the axon TPU tunnel is alive, with a hard timeout.
+
+jax backend init hangs indefinitely when the tunnel is down (the axon
+register hook intercepts get_backend even for JAX_PLATFORMS=cpu), so the
+probe runs in a child process killed after --timeout seconds.
+
+Exit 0 + one JSON line on stdout when alive; exit 3 when down.
+"""
+import argparse
+import json
+import subprocess
+import sys
+
+CHILD = r"""
+import time
+t0 = time.time()
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+v = float((x @ x).sum())
+print(__import__("json").dumps({
+    "alive": True, "n": len(d), "kind": d[0].device_kind,
+    "init_s": round(time.time() - t0, 1), "matmul": v,
+}))
+"""
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", CHILD],
+            timeout=args.timeout, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"alive": False, "why": f"hung >{args.timeout}s"}))
+        return 3
+    line = (out.stdout or "").strip().splitlines()
+    if out.returncode == 0 and line:
+        print(line[-1])
+        return 0
+    print(json.dumps({"alive": False, "why": f"rc={out.returncode}",
+                      "tail": (out.stderr or "")[-300:]}))
+    return 3
+
+if __name__ == "__main__":
+    sys.exit(main())
