@@ -16,6 +16,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core.mesh import MeshContext
+from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
 
 
@@ -87,7 +88,8 @@ def make_batch_stager(
                     )
             return flat_sharding
 
-        batch_r = jax.tree.map(reshape, batch)
-        return jax.device_put(batch_r, jax.tree.map(pick, batch_r))
+        with annotate("loop.batch_staging"):
+            batch_r = jax.tree.map(reshape, batch)
+            return jax.device_put(batch_r, jax.tree.map(pick, batch_r))
 
     return stage

@@ -12,6 +12,8 @@ from pathlib import Path
 
 import jax
 
+from d9d_tpu.core.tracing import set_trace_annotations
+
 logger = logging.getLogger("d9d_tpu.profiler")
 
 
@@ -46,15 +48,20 @@ class JobProfiler:
             out.mkdir(parents=True, exist_ok=True)
             logger.info("profiler: tracing steps %d..%d -> %s",
                         step, step + self.active_steps - 1, out)
+            # host-side action/staging annotations only exist inside
+            # capture windows — zero cost on unprofiled steps
+            set_trace_annotations(True)
             jax.profiler.start_trace(str(out))
             self._tracing_until = step + self.active_steps
 
     def step_end(self, step: int) -> None:
         if self._tracing_until is not None and step + 1 >= self._tracing_until:
             jax.profiler.stop_trace()
+            set_trace_annotations(False)
             self._tracing_until = None
 
     def close(self) -> None:
         if self._tracing_until is not None:
             jax.profiler.stop_trace()
+            set_trace_annotations(False)
             self._tracing_until = None

@@ -71,9 +71,10 @@ def build_train_step(
             loss_sum, weight, metrics = task.loss_fn(module, p, mb, rng)
             return loss_sum, (weight, metrics)
 
-        (loss_sum, (weight, metrics)), grads = jax.value_and_grad(
-            scalar_loss, has_aux=True
-        )(params)
+        with jax.named_scope("train/microbatch_grad"):
+            (loss_sum, (weight, metrics)), grads = jax.value_and_grad(
+                scalar_loss, has_aux=True
+            )(params)
         return loss_sum, weight, metrics, grads
 
     def step(params, opt_state, batch, rng):
@@ -115,22 +116,28 @@ def build_train_step(
         )
 
         # sum-then-scale: grads of Σ loss_sum scaled by 1 / Σ weight
-        inv_w = 1.0 / jnp.maximum(weight_sum, 1e-8)
-        grads = jax.tree.map(lambda g: g * inv_w, grads)
-        loss = loss_sum * inv_w
+        with jax.named_scope("train/grad_scale_clip"):
+            inv_w = 1.0 / jnp.maximum(weight_sum, 1e-8)
+            grads = jax.tree.map(lambda g: g * inv_w, grads)
+            loss = loss_sum * inv_w
 
-        grad_norm = global_grad_norm(grads)
-        if max_grad_norm is not None:
-            clip = jnp.minimum(1.0, max_grad_norm / jnp.maximum(grad_norm, 1e-12))
-            grads = jax.tree.map(lambda g: g * clip, grads)
+            grad_norm = global_grad_norm(grads)
+            if max_grad_norm is not None:
+                clip = jnp.minimum(
+                    1.0, max_grad_norm / jnp.maximum(grad_norm, 1e-12)
+                )
+                grads = jax.tree.map(lambda g: g * clip, grads)
 
         # OptimizerOwnsApply capabilities (core/protocol.py): fp32 grads
         # pass-through + optimizer-owned parameter write
-        if not getattr(optimizer, "accepts_fp32_grads", False):
-            grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        apply = getattr(optimizer, "apply_updates", optax.apply_updates)
-        params = apply(params, updates)
+        with jax.named_scope("train/optimizer"):
+            if not getattr(optimizer, "accepts_fp32_grads", False):
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads, params
+                )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            apply = getattr(optimizer, "apply_updates", optax.apply_updates)
+            params = apply(params, updates)
 
         out_metrics = {
             "loss": loss,

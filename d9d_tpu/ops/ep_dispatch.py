@@ -148,16 +148,17 @@ def ep_dispatch_compute_combine(
 
     # 3. dispatch hidden rows
     recv_buf = jnp.zeros((buf_rows, d_model), x_rows.dtype)
-    recv = _ragged_a2a(
-        x_rows,
-        recv_buf,
-        input_offsets.astype(jnp.int32),
-        send_sizes.astype(jnp.int32),
-        output_offsets.astype(jnp.int32),
-        recv_sizes.astype(jnp.int32),
-        ep_axes=ep_axes,
-        ep_world=ep_world,
-    )
+    with jax.named_scope("ep/dispatch_a2a"):
+        recv = _ragged_a2a(
+            x_rows,
+            recv_buf,
+            input_offsets.astype(jnp.int32),
+            send_sizes.astype(jnp.int32),
+            output_offsets.astype(jnp.int32),
+            recv_sizes.astype(jnp.int32),
+            ep_axes=ep_axes,
+            ep_world=ep_world,
+        )
 
     # 4. label received rows with their local expert. A source's slice is
     # expert-sorted; capacity cuts its tail. kcnt[s, e] = kept rows of
@@ -182,7 +183,8 @@ def ep_dispatch_compute_combine(
     by_expert, dest, group_sizes = stable_expert_order(labels, e_loc)
     rows_sorted = jnp.take(recv, by_expert, axis=0)
 
-    y_sorted = expert_fn(rows_sorted, group_sizes)
+    with jax.named_scope("ep/expert_compute"):
+        y_sorted = expert_fn(rows_sorted, group_sizes)
     # un-sort via the inverse permutation as a gather (dest[by_expert[r]]
     # == r) — cheaper than a zeros+scatter on TPU, same as ops/moe.py's
     # unpermute_combine
@@ -191,16 +193,17 @@ def ep_dispatch_compute_combine(
     # 5. mirrored return trip (swap send/recv roles). My slice for source s
     # must land where s's sorted rows for me begin: s's own block layout.
     return_offsets = _excl_cumsum(R, axis=1)[:, me]
-    home = _ragged_a2a(
-        y_buf,
-        jnp.zeros((m, d_model), y_buf.dtype),
-        recv_offsets.astype(jnp.int32),
-        recv_sizes.astype(jnp.int32),
-        return_offsets.astype(jnp.int32),
-        send_sizes.astype(jnp.int32),
-        ep_axes=ep_axes,
-        ep_world=ep_world,
-    )
+    with jax.named_scope("ep/combine_a2a"):
+        home = _ragged_a2a(
+            y_buf,
+            jnp.zeros((m, d_model), y_buf.dtype),
+            recv_offsets.astype(jnp.int32),
+            recv_sizes.astype(jnp.int32),
+            return_offsets.astype(jnp.int32),
+            send_sizes.astype(jnp.int32),
+            ep_axes=ep_axes,
+            ep_world=ep_world,
+        )
 
     # 6. weight by router probs, fold the k assignments per token
     # (collision-free gather form — see ops/moe.py combine_pairs)

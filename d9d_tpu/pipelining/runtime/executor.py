@@ -11,6 +11,19 @@ dispatch provides the overlap the reference gets from per-process
 execution — the host races ahead enqueuing work for all stage device
 groups while earlier computations are still running.
 
+Because every action costs host dispatch time (BASELINE.md measured ≈9%
+at pp=2/µB=8 with zero real communication), the interpretation loop is
+pre-compiled at construction: the program is flattened once into a list of
+(bound handler, action, trace label) triples — no isinstance chains or
+label formatting on the step path — microbatch kwargs are staged onto
+each stage's submesh through a bounded sliding window (async puts that
+overlap compute instead of splitting dispatch gaps mid-schedule, refilled
+as entries are consumed so residency stays O(window), not O(microbatches)),
+and per-microbatch loss statistics are summed in ONE fused jit at step end
+instead of one tiny dispatch per microbatch. Each action dispatch is wrapped in a gated
+``TraceAnnotation`` (core/tracing.py) mirroring the reference's
+``record_function`` per action (runtime/executor.py:96).
+
 Buffer lifecycle (reference computations.py:29,121): the executor stores
 per (stage, microbatch) only the input carry (the remat residual) and the
 output cotangent between its producing backward and consuming
@@ -23,6 +36,7 @@ from typing import Any
 
 import jax
 
+from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.program.actions import (
     Action,
@@ -55,6 +69,33 @@ class PipelineExecutionResult:
     outputs: list[PyTree] | None = None  # forward-only: last-stage aux per mb
 
 
+class _StepState:
+    """Per-step mutable buffers (fresh per ``step`` call)."""
+
+    __slots__ = (
+        "carries", "states", "inputs", "kwargs_d", "kwargs_h", "kwargs_next",
+        "cots", "grad_in", "fwd_out", "grads", "aux", "outputs",
+        "weight_done",
+    )
+
+    def __init__(self, num_microbatches: int):
+        self.carries: dict[int, PyTree] = {}  # mb → first-stage carry
+        self.states: dict[int, PyTree] = {}  # mb → last-stage task state
+        # per-(stage, mb) device buffers
+        self.inputs: dict[tuple[int, int], PyTree] = {}  # carry in (residual)
+        self.kwargs_d: dict[tuple[int, int], PyTree] = {}  # kwargs on submesh
+        self.kwargs_h: list[PyTree] = []  # mb → host kwargs tree
+        self.kwargs_next: dict[int, int] = {}  # stage → next mb to pre-stage
+        self.cots: dict[tuple[int, int], PyTree] = {}  # cot wrt stage output
+        self.grad_in: dict[tuple[int, int], PyTree] = {}  # dI awaiting send
+        self.fwd_out: dict[tuple[int, int], PyTree] = {}  # out awaiting send
+        self.grads: dict[int, PyTree] = {}
+        self.aux: list[Any] = []  # (loss, weight, metrics) per microbatch
+        self.outputs: list[PyTree | None] = [None] * num_microbatches
+        # (stage, mb) whose weight grads were already produced at the I slot
+        self.weight_done: set[tuple[int, int]] = set()
+
+
 class PipelineScheduleExecutor:
     """Executes one train/eval step per call.
 
@@ -85,6 +126,54 @@ class PipelineScheduleExecutor:
             train=train,
         )
         self.order: tuple[tuple[int, Action], ...] = sim.order
+        self._last = self.stages[self.num_stages - 1]
+        self._sum_aux = None  # built lazily (jit over the aux list pytree)
+        self._plan = self._compile_plan()
+
+    # ------------------------------------------------------------------
+    # plan compilation: one (handler, action, label) triple per action,
+    # Compose flattened — the step loop does zero type dispatch
+
+    _HANDLERS = {
+        ForwardCompute: "_act_forward",
+        ForwardSend: "_act_forward_send",
+        BackwardFull: "_act_backward_full",
+        BackwardInput: "_act_backward_input",
+        BackwardWeight: "_act_backward_weight",
+        BackwardSend: "_act_backward_send",
+    }
+
+    _LABELS = {
+        ForwardCompute: "fwd",
+        ForwardSend: "fwd_send",
+        BackwardFull: "bwd",
+        BackwardInput: "bwd_dI",
+        BackwardWeight: "bwd_dW",
+        BackwardSend: "bwd_send",
+    }
+
+    def _compile_plan(self):
+        plan = []
+
+        def add(action: Action) -> None:
+            if isinstance(action, Compose):
+                for member in action.actions:
+                    add(member)
+                return
+            if isinstance(action, (ForwardRecv, BackwardRecv)):
+                return  # transfers already target the consumer at the Send
+            name = self._HANDLERS.get(type(action))
+            if name is None:  # pragma: no cover
+                raise TypeError(f"unknown action {action!r}")
+            label = (
+                f"pp.{self._LABELS[type(action)]}"
+                f".s{action.stage}.mb{action.microbatch}"
+            )
+            plan.append((getattr(self, name), action, label))
+
+        for _rank, action in self.order:
+            add(action)
+        return tuple(plan)
 
     # ------------------------------------------------------------------
 
@@ -100,170 +189,213 @@ class PipelineScheduleExecutor:
                 f"got {len(microbatches)}"
             )
         first = self.stages[0]
-        last = self.stages[self.num_stages - 1]
+        last = self._last
 
-        carries: dict[int, PyTree] = {}  # mb → first-stage carry
-        kwargs_h: dict[int, PyTree] = {}  # mb → host kwargs tree
-        states: dict[int, PyTree] = {}  # mb → last-stage task state
-        for mb, micro in enumerate(microbatches):
-            carry, kw, state = first.task.split_microbatch(micro)
-            carries[mb] = self._put(carry, first.carry_sharding)
-            kwargs_h[mb] = kw
-            states[mb] = self._put(state, last.state_sharding)
+        st = _StepState(self.num_microbatches)
+        with annotate("pp.stage_inputs"):
+            for mb, micro in enumerate(microbatches):
+                carry, kw, state = first.task.split_microbatch(micro)
+                st.carries[mb] = self._put(carry, first.carry_sharding)
+                st.kwargs_h.append(kw)
+                st.states[mb] = self._put(state, last.state_sharding)
+            # pre-stage a bounded window of kwargs per stage: the puts are
+            # async and overlap the first computes instead of splitting
+            # dispatch gaps mid-schedule, while device residency stays
+            # O(window + in-flight) instead of O(num_microbatches) — each
+            # consumed entry refills the window (_drop_kwargs)
+            window = min(self.num_microbatches, 2 * self.num_stages + 2)
+            for s in self.stages:
+                for mb in range(window):
+                    self._stage_kwargs(st, s, mb)
+                st.kwargs_next[s] = window
 
-        # per-(stage, mb) device buffers
-        inputs: dict[tuple[int, int], PyTree] = {}  # carry in (remat residual)
-        kwargs_d: dict[tuple[int, int], PyTree] = {}  # kwargs on stage submesh
-        cots: dict[tuple[int, int], PyTree] = {}  # cotangent wrt stage output
-        grad_in: dict[tuple[int, int], PyTree] = {}  # input grad awaiting send
-        fwd_out: dict[tuple[int, int], PyTree] = {}  # output awaiting send/use
+        for handler, action, label in self._plan:
+            with annotate(label):
+                handler(st, action)
 
-        grads: dict[int, PyTree] = {}
         loss_sum = weight_sum = None
         metrics_sum: dict[str, Any] = {}
-        outputs: list[PyTree | None] = [None] * self.num_microbatches
-        # (stage, mb) whose weight grads were already produced at the I slot
-        weight_done: set[tuple[int, int]] = set()
-
-        def stage_kwargs(s: int, mb: int) -> PyTree:
-            if (s, mb) not in kwargs_d:
-                kwargs_d[(s, mb)] = self._put(
-                    kwargs_h[mb], self.stages[s].kwargs_sharding
-                )
-            return kwargs_d[(s, mb)]
-
-        def add_loss(aux):
-            nonlocal loss_sum, weight_sum
-            loss, weight, metrics = aux
-            # scalar accumulation runs on the last stage's devices; scope its
-            # mesh so an ambient full mesh never conflicts with them
-            with last._scoped():
-                loss_sum = loss if loss_sum is None else loss_sum + loss
-                weight_sum = (
-                    weight if weight_sum is None else weight_sum + weight
-                )
-                for k, v in metrics.items():
-                    metrics_sum[k] = (
-                        v if k not in metrics_sum else metrics_sum[k] + v
-                    )
-
-        def add_grads(s: int, gp: PyTree):
-            stage = self.stages[s]
-            if s not in grads:
-                grads[s] = stage.cast_grads(gp)
-            else:
-                grads[s] = stage.accumulate(grads[s], gp)
-
-        def route_input_grad(s: int, mb: int, gc: PyTree):
-            """Store dI for the downstream (stage-1) consumer."""
-            if s == 0:
-                return
-            if self.stage_owner[s - 1] == self.stage_owner[s]:
-                cots[(s - 1, mb)] = gc  # local edge: no send action exists
-            else:
-                grad_in[(s, mb)] = gc  # cross-rank: BackwardSend will move it
-
-        def execute(action: Action) -> None:
-            if isinstance(action, Compose):
-                for member in action.actions:
-                    execute(member)
-                return
-            s, mb = action.stage, action.microbatch
-            stage = self.stages[s]
-            if isinstance(action, ForwardCompute):
-                if s == 0:
-                    inputs[(0, mb)] = carries.pop(mb)
-                elif (s, mb) not in inputs:
-                    # same-rank edge: pull directly from the producing stage
-                    inputs[(s, mb)] = fwd_out.pop((s - 1, mb))
-                carry = inputs[(s, mb)]
-                kw = stage_kwargs(s, mb)
-                if stage.info.is_last:
-                    if not self.train:
-                        if stage.has_output_fn:
-                            outputs[mb] = stage.forward_outputs(
-                                carry, kw, states[mb]
+        if st.aux:
+            # one fused jit sums every microbatch's (loss, weight, metrics)
+            # on the last stage's devices — replaces per-microbatch scalar
+            # dispatches on the action path. Fusable only when every
+            # microbatch produced the same aux structure; a task emitting
+            # different metric keys per microbatch falls back to the
+            # key-unioning merge.
+            with annotate("pp.loss_sum"), last._scoped():
+                structures = {jax.tree.structure(a) for a in st.aux}
+                if len(structures) == 1:
+                    if self._sum_aux is None:
+                        self._sum_aux = jax.jit(
+                            lambda auxes: jax.tree.reduce(
+                                lambda a, b: jax.tree.map(
+                                    lambda x, y: x + y, a, b
+                                ),
+                                auxes,
+                                is_leaf=lambda t: isinstance(t, tuple)
+                                and len(t) == 3,
                             )
-                        else:
-                            aux = stage.forward_loss(carry, kw, states[mb])
-                            add_loss(aux)
-                            outputs[mb] = aux
-                        inputs.pop((s, mb), None)
-                    # train: forward is folded into the backward's
-                    # value_and_grad (remat), nothing to run here
+                        )
+                    loss_sum, weight_sum, metrics_sum = self._sum_aux(st.aux)
                 else:
-                    fwd_out[(s, mb)] = stage.forward(carry, kw)
-                    if not self.train:
-                        inputs.pop((s, mb), None)
-            elif isinstance(action, ForwardSend):
-                out = fwd_out.pop((s, mb))
-                nxt = self.stages[s + 1]
-                inputs[(s + 1, mb)] = self._put(out, nxt.carry_sharding)
-            elif isinstance(action, ForwardRecv):
-                pass  # transfer already targeted this stage at the Send
-            elif isinstance(action, BackwardFull):
-                cot = None if stage.info.is_last else cots.pop((s, mb))
-                state = states.get(mb) if stage.info.is_last else None
-                gp, gc, aux = stage.backward_full(
-                    inputs.pop((s, mb)), stage_kwargs(s, mb), cot, state
-                )
-                kwargs_d.pop((s, mb), None)
-                if aux is not None:
-                    add_loss(aux)
-                add_grads(s, gp)
-                route_input_grad(s, mb, gc)
-            elif isinstance(action, BackwardInput):
-                if stage.residual_policy == "cache_full":
-                    # fused backward at the I slot: weight grads accumulate
-                    # now, the deferred BackwardWeight becomes a no-op
-                    cot = None if stage.info.is_last else cots.pop((s, mb), None)
-                    state = states.get(mb) if stage.info.is_last else None
-                    gp, gc, aux = stage.backward_full(
-                        inputs.pop((s, mb)), stage_kwargs(s, mb), cot, state
-                    )
-                    kwargs_d.pop((s, mb), None)
-                    if aux is not None:
-                        add_loss(aux)
-                    add_grads(s, gp)
-                    route_input_grad(s, mb, gc)
-                    weight_done.add((s, mb))
-                    return
-                cot = None if stage.info.is_last else cots.get((s, mb))
-                state = states.get(mb) if stage.info.is_last else None
-                gc, aux = stage.backward_input(
-                    inputs[(s, mb)], stage_kwargs(s, mb), cot, state
-                )
-                if aux is not None:
-                    add_loss(aux)
-                if gc is not None:
-                    route_input_grad(s, mb, gc)
-                # inputs/cot stay alive for the deferred weight backward
-            elif isinstance(action, BackwardWeight):
-                if (s, mb) in weight_done:
-                    weight_done.discard((s, mb))
-                    return
-                kw = stage_kwargs(s, mb)
-                cot = None if stage.info.is_last else cots.pop((s, mb), None)
-                state = states.get(mb) if stage.info.is_last else None
-                gp = stage.backward_weight(inputs.pop((s, mb)), kw, cot, state)
-                kwargs_d.pop((s, mb), None)
-                add_grads(s, gp)
-            elif isinstance(action, BackwardSend):
-                g = grad_in.pop((s, mb))
-                prev = self.stages[s - 1]
-                cots[(s - 1, mb)] = self._put(g, prev.carry_sharding)
-            elif isinstance(action, BackwardRecv):
-                pass
-            else:  # pragma: no cover
-                raise TypeError(f"unknown action {action!r}")
-
-        for _rank, action in self.order:
-            execute(action)
+                    for loss, weight, metrics in st.aux:
+                        loss_sum = loss if loss_sum is None else loss_sum + loss
+                        weight_sum = (
+                            weight if weight_sum is None
+                            else weight_sum + weight
+                        )
+                        for k, v in metrics.items():
+                            metrics_sum[k] = (
+                                v if k not in metrics_sum
+                                else metrics_sum[k] + v
+                            )
 
         return PipelineExecutionResult(
-            grads=grads if self.train else None,
+            grads=st.grads if self.train else None,
             loss_sum=loss_sum,
             weight_sum=weight_sum,
             metrics=metrics_sum,
-            outputs=outputs if not self.train else None,
+            outputs=st.outputs if not self.train else None,
         )
+
+    # ------------------------------------------------------------------
+    # shared helpers
+
+    def _stage_kwargs(self, st: _StepState, s: int, mb: int) -> None:
+        st.kwargs_d[(s, mb)] = self._put(
+            st.kwargs_h[mb], self.stages[s].kwargs_sharding
+        )
+
+    def _kwargs(self, st: _StepState, s: int, mb: int) -> PyTree:
+        kw = st.kwargs_d.get((s, mb))
+        if kw is None:  # outside the pre-staged window: stage on demand
+            self._stage_kwargs(st, s, mb)
+            kw = st.kwargs_d[(s, mb)]
+        return kw
+
+    def _drop_kwargs(self, st: _StepState, s: int, mb: int) -> None:
+        """Free a consumed kwargs buffer and refill the staging window."""
+        st.kwargs_d.pop((s, mb), None)
+        nxt = st.kwargs_next.get(s, self.num_microbatches)
+        if nxt < self.num_microbatches:
+            st.kwargs_next[s] = nxt + 1
+            self._stage_kwargs(st, s, nxt)
+
+    def _add_grads(self, st: _StepState, s: int, gp: PyTree) -> None:
+        stage = self.stages[s]
+        if s not in st.grads:
+            st.grads[s] = stage.cast_grads(gp)
+        else:
+            st.grads[s] = stage.accumulate(st.grads[s], gp)
+
+    def _route_input_grad(
+        self, st: _StepState, s: int, mb: int, gc: PyTree
+    ) -> None:
+        """Store dI for the downstream (stage-1) consumer."""
+        if s == 0:
+            return
+        if self.stage_owner[s - 1] == self.stage_owner[s]:
+            st.cots[(s - 1, mb)] = gc  # local edge: no send action exists
+        else:
+            st.grad_in[(s, mb)] = gc  # cross-rank: BackwardSend moves it
+
+    # ------------------------------------------------------------------
+    # action handlers (one per action type, bound into the plan)
+
+    def _act_forward(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        stage = self.stages[s]
+        if s == 0:
+            st.inputs[(0, mb)] = st.carries.pop(mb)
+        elif (s, mb) not in st.inputs:
+            # same-rank edge: pull directly from the producing stage
+            st.inputs[(s, mb)] = st.fwd_out.pop((s - 1, mb))
+        carry = st.inputs[(s, mb)]
+        kw = self._kwargs(st, s, mb)
+        if stage.info.is_last:
+            if not self.train:
+                if stage.has_output_fn:
+                    st.outputs[mb] = stage.forward_outputs(
+                        carry, kw, st.states[mb]
+                    )
+                else:
+                    aux = stage.forward_loss(carry, kw, st.states[mb])
+                    st.aux.append(aux)
+                    st.outputs[mb] = aux
+                st.inputs.pop((s, mb), None)
+                self._drop_kwargs(st, s, mb)  # eval: forward is last use
+            # train: forward is folded into the backward's
+            # value_and_grad (remat), nothing to run here
+        else:
+            st.fwd_out[(s, mb)] = stage.forward(carry, kw)
+            if not self.train:
+                st.inputs.pop((s, mb), None)
+                self._drop_kwargs(st, s, mb)  # eval: forward is last use
+
+    def _act_forward_send(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        out = st.fwd_out.pop((s, mb))
+        nxt = self.stages[s + 1]
+        st.inputs[(s + 1, mb)] = self._put(out, nxt.carry_sharding)
+
+    def _act_backward_full(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        stage = self.stages[s]
+        cot = None if stage.info.is_last else st.cots.pop((s, mb))
+        state = st.states.get(mb) if stage.info.is_last else None
+        gp, gc, aux = stage.backward_full(
+            st.inputs.pop((s, mb)), self._kwargs(st, s, mb), cot, state
+        )
+        self._drop_kwargs(st, s, mb)
+        if aux is not None:
+            st.aux.append(aux)
+        self._add_grads(st, s, gp)
+        self._route_input_grad(st, s, mb, gc)
+
+    def _act_backward_input(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        stage = self.stages[s]
+        if stage.residual_policy == "cache_full":
+            # fused backward at the I slot: weight grads accumulate
+            # now, the deferred BackwardWeight becomes a no-op
+            cot = None if stage.info.is_last else st.cots.pop((s, mb), None)
+            state = st.states.get(mb) if stage.info.is_last else None
+            gp, gc, aux = stage.backward_full(
+                st.inputs.pop((s, mb)), self._kwargs(st, s, mb), cot, state
+            )
+            self._drop_kwargs(st, s, mb)
+            if aux is not None:
+                st.aux.append(aux)
+            self._add_grads(st, s, gp)
+            self._route_input_grad(st, s, mb, gc)
+            st.weight_done.add((s, mb))
+            return
+        cot = None if stage.info.is_last else st.cots.get((s, mb))
+        state = st.states.get(mb) if stage.info.is_last else None
+        gc, aux = stage.backward_input(
+            st.inputs[(s, mb)], self._kwargs(st, s, mb), cot, state
+        )
+        if aux is not None:
+            st.aux.append(aux)
+        if gc is not None:
+            self._route_input_grad(st, s, mb, gc)
+        # inputs/cot stay alive for the deferred weight backward
+
+    def _act_backward_weight(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        stage = self.stages[s]
+        if (s, mb) in st.weight_done:
+            st.weight_done.discard((s, mb))
+            return
+        kw = self._kwargs(st, s, mb)
+        cot = None if stage.info.is_last else st.cots.pop((s, mb), None)
+        state = st.states.get(mb) if stage.info.is_last else None
+        gp = stage.backward_weight(st.inputs.pop((s, mb)), kw, cot, state)
+        self._drop_kwargs(st, s, mb)
+        self._add_grads(st, s, gp)
+
+    def _act_backward_send(self, st: _StepState, action: Action) -> None:
+        s, mb = action.stage, action.microbatch
+        g = st.grad_in.pop((s, mb))
+        prev = self.stages[s - 1]
+        st.cots[(s - 1, mb)] = self._put(g, prev.carry_sharding)

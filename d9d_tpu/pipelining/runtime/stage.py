@@ -109,17 +109,29 @@ class PipelineStageRuntime:
     residual_policy: str = "remat"
 
     def __post_init__(self) -> None:
-        self._fwd = jax.jit(self._fwd_impl)
-        self._fwd_loss = jax.jit(self._fwd_loss_impl)
-        self._fwd_out = jax.jit(self._fwd_out_impl)
-        self._bwd_full = jax.jit(self._bwd_full_impl)
-        self._bwd_input = jax.jit(self._bwd_input_impl)
-        self._bwd_weight = jax.jit(self._bwd_weight_impl)
-        self._acc = jax.jit(_tree_add, donate_argnums=(0,))
+        # device-side attribution: every op a stage function emits carries a
+        # "pp_s{k}/<phase>" named-scope prefix in captured traces (reference
+        # wraps the same regions in record_function — executor.py:96)
+        def scoped(name, fn):
+            sid = self.info.stage_index
+
+            def wrapped(*args):
+                with jax.named_scope(f"pp_s{sid}/{name}"):
+                    return fn(*args)
+
+            return wrapped
+
+        self._fwd = jax.jit(scoped("fwd", self._fwd_impl))
+        self._fwd_loss = jax.jit(scoped("fwd_loss", self._fwd_loss_impl))
+        self._fwd_out = jax.jit(scoped("fwd_out", self._fwd_out_impl))
+        self._bwd_full = jax.jit(scoped("bwd", self._bwd_full_impl))
+        self._bwd_input = jax.jit(scoped("bwd_dI", self._bwd_input_impl))
+        self._bwd_weight = jax.jit(scoped("bwd_dW", self._bwd_weight_impl))
+        self._acc = jax.jit(
+            scoped("grad_acc", _tree_add), donate_argnums=(0,)
+        )
         self._cast = jax.jit(
-            lambda g: jax.tree.map(
-                lambda x: x.astype(self.grad_dtype) if self.grad_dtype else x, g
-            )
+            lambda g: jax.tree.map(lambda x: x.astype(self.grad_dtype), g)
         )
 
     # ---- forward ---------------------------------------------------------
@@ -240,7 +252,9 @@ class PipelineStageRuntime:
     def cast_grads(self, grads: PyTree) -> PyTree:
         """First microbatch: adopt grads as the accumulator (cast to
         ``grad_dtype``); preserves the vjp output sharding, so no separate
-        zero-init is needed."""
+        zero-init is needed. No-dispatch identity when no cast is wanted."""
+        if self.grad_dtype is None:
+            return grads
         with self._scoped():
             return self._cast(grads)
 

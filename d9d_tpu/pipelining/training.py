@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from d9d_tpu.core.protocol import OptimizerProtocol
+from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.runtime.transfer import put_compat
 
@@ -48,28 +49,31 @@ class PipelinedOptimizer:
         apply_updates = getattr(opt, "apply_updates", optax.apply_updates)
 
         def sq_norm(grads):
-            return optax.global_norm(grads) ** 2
+            with jax.named_scope("pp_opt/sq_norm"):
+                return optax.global_norm(grads) ** 2
 
         def combine(sq_norms, weight_sum, max_norm):
             # grads are Σ_mb sums: scale by 1/Σweight, then clip the norm of
             # the *scaled* grads — norm(g/w) = sqrt(Σ sq)/w
-            inv_w = 1.0 / jnp.maximum(weight_sum, 1e-8)
-            norm = jnp.sqrt(sum(sq_norms)) * inv_w
-            clip = (
-                jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
-                if max_norm is not None
-                else 1.0
-            )
-            return norm, inv_w * clip
+            with jax.named_scope("pp_opt/combine"):
+                inv_w = 1.0 / jnp.maximum(weight_sum, 1e-8)
+                norm = jnp.sqrt(sum(sq_norms)) * inv_w
+                clip = (
+                    jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+                    if max_norm is not None
+                    else 1.0
+                )
+                return norm, inv_w * clip
 
         def update(params, opt_state, grads, factor):
-            grads = jax.tree.map(lambda g: g * factor, grads)
-            if not accepts_fp32:
-                grads = jax.tree.map(
-                    lambda g, p: g.astype(p.dtype), grads, params
-                )
-            updates, opt_state = opt.update(grads, opt_state, params)
-            return apply_updates(params, updates), opt_state
+            with jax.named_scope("pp_opt/update"):
+                grads = jax.tree.map(lambda g: g * factor, grads)
+                if not accepts_fp32:
+                    grads = jax.tree.map(
+                        lambda g, p: g.astype(p.dtype), grads, params
+                    )
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return apply_updates(params, updates), opt_state
 
         self._sq_norm = jax.jit(sq_norm)
         self._combine = jax.jit(
@@ -97,20 +101,24 @@ class PipelinedOptimizer:
         """→ (new_params, new_opt_states, grad_norm_of_scaled_grads)."""
         last = max(self.scalar_shardings)
         anchor = self.scalar_shardings[last]
-        sq_norms = []
-        for s in sorted(stage_grads):
-            with self._scoped(s):
-                sq = self._sq_norm(stage_grads[s])
-            sq_norms.append(put_compat(sq, anchor))
-        with self._scoped(last):
+        with annotate("pp_opt.sq_norms"):
+            sq_local = []
+            for s in sorted(stage_grads):
+                with self._scoped(s):
+                    sq_local.append(self._sq_norm(stage_grads[s]))
+            # batched hop: all per-stage scalars move to the anchor stage
+            # from one call site (VERDICT r3 item 3)
+            sq_norms = put_compat(sq_local, anchor)
+        with annotate("pp_opt.combine"), self._scoped(last):
             norm, factor = self._combine(sq_norms, weight_sum)
 
         new_params: dict[int, PyTree] = {}
         new_states: dict[int, PyTree] = {}
-        for s in sorted(stage_params):
-            f = put_compat(factor, self.scalar_shardings[s])
-            with self._scoped(s):
-                new_params[s], new_states[s] = self._update(
-                    stage_params[s], opt_states[s], stage_grads[s], f
-                )
+        with annotate("pp_opt.update"):
+            for s in sorted(stage_params):
+                f = put_compat(factor, self.scalar_shardings[s])
+                with self._scoped(s):
+                    new_params[s], new_states[s] = self._update(
+                        stage_params[s], opt_states[s], stage_grads[s], f
+                    )
         return new_params, new_states, norm

@@ -129,11 +129,19 @@ def measure(engine, *, batch, microbatch, seq_len, vocab, warmup, steps,
     dt = max(time.perf_counter() - t0 - drain_cost, 1e-9)
 
     if trace_dir:
-        # separate short traced pass: steady-state dispatch gaps only
-        with jax.profiler.trace(trace_dir):
-            for _ in range(min(steps, 3)):
-                m = engine.step(make_microbatches())
-            drain(m)
+        # separate short traced pass: steady-state dispatch gaps only,
+        # with per-action host annotations on (tools/trace_summary.py
+        # groups by them)
+        from d9d_tpu.core.tracing import set_trace_annotations
+
+        set_trace_annotations(True)
+        try:
+            with jax.profiler.trace(trace_dir):
+                for _ in range(min(steps, 3)):
+                    m = engine.step(make_microbatches())
+                drain(m)
+        finally:
+            set_trace_annotations(False)
     return dt / steps
 
 

@@ -10,6 +10,17 @@ collective / fusion / other) and the top individual ops — the quickest way
 to see where an MoE or pipeline step actually spends its time without
 opening xprof. Host-side lanes (Python, runtime threads) are excluded;
 on CPU traces, where XLA compute runs on host threads, pass --all-lanes.
+
+Two attribution tables ride the repo's own instrumentation
+(core/tracing.py — VERDICT r3 item 3, the ``record_function`` analogue):
+
+- **host regions**: TraceAnnotation events named ``pp.*`` (one per pipeline
+  action, by kind/stage/microbatch), ``pp_opt.*`` (optimizer phases) and
+  ``loop.*`` (batch staging), collapsed over stage/microbatch — shows where
+  the single-controller dispatch loop spends host time;
+- **device scopes**: device ops whose HLO metadata carries a
+  ``jax.named_scope`` path (``pp_s0/fwd``, ``ep/dispatch_a2a``,
+  ``train/optimizer``, …), grouped by the leading path components.
 """
 
 import argparse
@@ -67,6 +78,45 @@ def load_events(run_dir: str):
             elif ph == "X":
                 events.append(e)
     return events, processes, threads
+
+
+REGION_PREFIXES = ("pp.", "pp_opt.", "loop.")
+_MB_SUFFIX = re.compile(r"\.s\d+\.mb\d+$|\.mb\d+$")
+# named-scope paths as stamped by this repo's instrumentation; matched
+# anywhere in the op metadata because JAX prepends jit(<fn>)/ components
+_SCOPE = re.compile(
+    r"(?:^|/)((?:pp_s\d+|pp_opt|ep|train|loop|moe)/[\w.-]+)"
+)
+
+
+def summarize_host_regions(events):
+    """Aggregate the repo's TraceAnnotation regions (any lane), collapsed
+    over stage/microbatch → {label: (total_us, count)}."""
+    agg = {}
+    for e in events:
+        name = e.get("name", "")
+        if not name.startswith(REGION_PREFIXES):
+            continue
+        dur = e.get("dur", 0)
+        if dur <= 0:
+            continue
+        label = _MB_SUFFIX.sub("", name)
+        tot, cnt = agg.get(label, (0, 0))
+        agg[label] = (tot + dur, cnt + 1)
+    return agg
+
+
+def scope_of(e) -> str | None:
+    """This repo's named-scope path (2 components) from the op name or its
+    HLO metadata, e.g. 'pp_s0/fwd' or 'ep/dispatch_a2a' — tolerant of the
+    'jit(<fn>)/' prefix JAX stamps in front."""
+    for cand in (e.get("name", ""),
+                 str(e.get("args", {}).get("long_name", "")),
+                 str(e.get("args", {}).get("tf_op", ""))):
+        m = _SCOPE.search(cand)
+        if m:
+            return m.group(1)
+    return None
 
 
 def main():
@@ -146,6 +196,37 @@ def main():
     print(f"{'ms':>10}  {'share':>6}  name")
     for name, dur in by_name.most_common(args.top):
         print(f"{dur/1e3:>10.3f}  {dur/total:>6.1%}  {name[:100]}")
+
+    # device time grouped by named-scope path (pp_s*/{fwd,bwd}, ep/*, ...)
+    by_scope = collections.Counter()
+    for e in events:
+        if not keep(e):
+            continue
+        dur = e.get("dur", 0)
+        if dur <= 0:
+            continue
+        scope = scope_of(e)
+        if scope:
+            by_scope[scope] += dur
+    if by_scope:
+        print("\ndevice time by named scope:")
+        print(f"{'ms':>10}  {'share':>6}  scope")
+        for scope, dur in by_scope.most_common(args.top):
+            print(f"{dur/1e3:>10.3f}  {dur/total:>6.1%}  {scope}")
+
+    # host dispatch regions from the repo's TraceAnnotations (all lanes)
+    regions = summarize_host_regions(events)
+    if regions:
+        print("\nhost trace-annotation regions (Σ over stages/microbatches):")
+        print(f"{'ms':>10}  {'calls':>6}  {'ms/call':>9}  region")
+        for label, (tot, cnt) in sorted(
+            regions.items(), key=lambda kv: -kv[1][0]
+        ):
+            print(f"{tot/1e3:>10.3f}  {cnt:>6}  {tot/cnt/1e3:>9.4f}  {label}")
+    else:
+        print("\n(no pp./pp_opt./loop. trace-annotation regions in this "
+              "trace — capture with set_trace_annotations(True) or via "
+              "JobProfiler)")
 
 
 if __name__ == "__main__":
