@@ -86,10 +86,18 @@ class StatefulDataLoader:
 
     # -- state ---------------------------------------------------------
 
-    def state_dict(self) -> dict[str, Any]:
+    def position(self) -> dict[str, Any]:
+        """Local position snapshot (no collectives — safe to call from a
+        prefetch producer thread after each fetch). Because ``__iter__``
+        advances ``batch_index`` before yielding, the snapshot taken after
+        fetching batch ``b`` is exactly the resume point for a job that
+        consumed ``b``."""
         my = {"epoch": self._epoch, "batch_index": self._batch_index}
         if hasattr(self.dataset, "state_dict"):
             my["dataset"] = self.dataset.state_dict()
+        return my
+
+    def _merged_state(self, my: dict[str, Any]) -> dict[str, Any]:
         if jax.process_count() == 1:
             return {"process_0": my}
         # every feeder's position must land in the (primary-written) job
@@ -100,6 +108,16 @@ class StatefulDataLoader:
             f"process_{i}": s
             for i, s in enumerate(host_allgather_object(my))
         }
+
+    def state_dict(self) -> dict[str, Any]:
+        return self._merged_state(self.position())
+
+    def state_dict_at(self, position: dict[str, Any]) -> dict[str, Any]:
+        """State dict for an explicit :meth:`position` snapshot — how a
+        prefetching trainer checkpoints the *consumed* position while the
+        producer thread runs ahead (collective; call from the main thread
+        on every process together)."""
+        return self._merged_state(position)
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
         key = f"process_{jax.process_index()}"

@@ -1,0 +1,115 @@
+"""Background batch prefetch: overlap host input work with device steps.
+
+Reference: d9d/loop/component/data_loader_factory.py:102 — torchdata's
+worker-backed ``StatefulDataLoader`` keeps batch N+1's host work off the
+step path. TPU equivalent (VERDICT r3 item 4): a producer thread runs the
+whole host input pipeline — raw fetch from the loader, task
+``prepare_batch`` (numpy), and device staging (``device_put`` is
+thread-safe and async) — ``depth`` batches ahead of the consuming train
+loop, so step N's compute overlaps step N+1's input processing and
+host→device copy.
+
+Exact resume stays exact: the producer snapshots the loader's *position*
+right after each fetch (the loader advances before yielding, so the
+snapshot IS the resume point after consuming that batch), and the
+consumer records the snapshot of every batch it hands out. Checkpoints
+then serialize the loader state *as of the consumed batch* via
+``StatefulDataLoader.state_dict_at`` — never the producer's run-ahead
+position.
+"""
+
+import queue
+import threading
+from collections.abc import Callable, Iterator
+from typing import Any
+
+from d9d_tpu.core.tracing import annotate
+from d9d_tpu.core.types import PyTree
+
+__all__ = ["BatchPrefetcher"]
+
+_DONE = object()
+
+
+class BatchPrefetcher:
+    """Iterator of staged batches produced ``depth`` ahead on a thread.
+
+    ``stage_fn`` runs in the producer thread (prepare + device staging);
+    ``position_fn`` (optional) snapshots the underlying loader position
+    after each raw fetch — :attr:`consumed_position` then tracks the
+    resume point of the last batch handed to the consumer.
+    """
+
+    def __init__(
+        self,
+        data_iter: Iterator[PyTree],
+        stage_fn: Callable[[PyTree], PyTree],
+        *,
+        depth: int = 2,
+        position_fn: Callable[[], Any] | None = None,
+    ):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._iter = data_iter
+        self._stage_fn = stage_fn
+        self._position_fn = position_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self.consumed_position: Any | None = None
+        self._thread = threading.Thread(
+            target=self._produce, name="d9d-batch-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts promptly when the consumer closed."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    raw = next(self._iter)
+                except StopIteration:
+                    self._put(_DONE)
+                    return
+                pos = self._position_fn() if self._position_fn else None
+                with annotate("loop.prefetch_stage"):
+                    staged = self._stage_fn(raw)
+                if not self._put(("batch", staged, pos)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — reraised in consumer
+            self._put(("error", e, None))
+
+    # -- consumer ------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> PyTree:
+        item = self._q.get()
+        if item is _DONE:
+            raise StopIteration
+        kind, payload, pos = item
+        if kind == "error":
+            raise payload
+        self.consumed_position = pos
+        return payload
+
+    def close(self) -> None:
+        """Stop the producer and release its queue slot."""
+        self._stop.set()
+        try:  # unblock a producer waiting on a full queue
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -45,6 +45,11 @@ class TrainerConfig(pydantic.BaseModel):
     # manual GC (reference component/garbage_collector.py:13)
     gc_every_steps: int | None = 100
 
+    # background input pipeline: batches prepared + device-staged this many
+    # steps ahead on a producer thread (reference data_loader_factory.py:102
+    # worker-backed StatefulDataLoader); 0 = fetch/stage on the step path
+    prefetch_batches: int = 2
+
 
 class InferenceConfig(pydantic.BaseModel):
     model_config = pydantic.ConfigDict(extra="forbid")

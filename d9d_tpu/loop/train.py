@@ -11,6 +11,7 @@ hang watchdog, and sleep/wake host offload.
 
 import logging
 import time
+import warnings
 
 import jax
 import numpy as np
@@ -30,6 +31,7 @@ from d9d_tpu.loop.components.checkpointer import StateCheckpointer
 from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
 from d9d_tpu.loop.components.job_profiler import JobProfiler
 from d9d_tpu.loop.components.metric_collector import MetricCollector
+from d9d_tpu.loop.components.prefetch import BatchPrefetcher
 from d9d_tpu.loop.components.stepper import Stepper
 from d9d_tpu.loop.components.timeout_manager import TimeoutManager
 from d9d_tpu.loop.config import TrainerConfig
@@ -165,6 +167,7 @@ class Trainer:
         self.metric_collector = MetricCollector(self.task)
         self.run = None  # tracker run, opened in train()
         self._sleep_store: dict[SleepTag, tuple[PyTree, PyTree]] = {}
+        self._prefetcher = None  # BatchPrefetcher, live only inside train()
 
         self._stage = make_batch_stager(
             ctx,
@@ -227,8 +230,20 @@ class Trainer:
 
     def _job_meta(self) -> dict:
         meta = {"step": self.stepper.step}
-        if self.data_loader is not None and hasattr(self.data_loader, "state_dict"):
-            meta["data_loader"] = self.data_loader.state_dict()
+        if self.data_loader is not None:
+            # under prefetch the loader runs ahead of the trainer; the
+            # checkpoint must record the position of the last CONSUMED
+            # batch, not the producer's run-ahead position
+            if (
+                self._prefetcher is not None
+                and self._prefetcher.consumed_position is not None
+                and hasattr(self.data_loader, "state_dict_at")
+            ):
+                meta["data_loader"] = self.data_loader.state_dict_at(
+                    self._prefetcher.consumed_position
+                )
+            elif hasattr(self.data_loader, "state_dict"):
+                meta["data_loader"] = self.data_loader.state_dict()
         if self.run is not None:
             meta["tracker"] = self.run.state_dict()
         return meta
@@ -281,16 +296,46 @@ class Trainer:
             self.run.track_hparams(self.config.model_dump())
             t0 = time.perf_counter()
             data_iter = iter(self.data_loader)
+            use_prefetch = self.config.prefetch_batches > 0
+            if (
+                use_prefetch
+                and hasattr(self.data_loader, "state_dict")
+                and not hasattr(self.data_loader, "position")
+            ):
+                # a stateful loader we cannot snapshot per-fetch would get
+                # checkpointed at the producer's run-ahead position — keep
+                # resume exact by staying on the step path instead
+                warnings.warn(
+                    "data loader has state_dict() but no position(); "
+                    "disabling batch prefetch to keep checkpoint resume "
+                    "exact (add position()/state_dict_at() to re-enable)",
+                    stacklevel=2,
+                )
+                use_prefetch = False
+            if use_prefetch:
+                # producer thread runs fetch + prepare + device staging
+                # prefetch_batches ahead; must start AFTER _try_resume so
+                # it iterates from the restored loader position
+                self._prefetcher = BatchPrefetcher(
+                    data_iter,
+                    self._stage_batch,
+                    depth=self.config.prefetch_batches,
+                    position_fn=getattr(self.data_loader, "position", None),
+                )
             with self.timeout, self.gc:
                 while not self.stepper.finished:
                     try:
-                        raw = next(data_iter)
+                        if self._prefetcher is not None:
+                            raw, batch = None, next(self._prefetcher)
+                        else:
+                            raw = next(data_iter)
                     except StopIteration:
                         break
                     step = self.stepper.step
                     self.profiler.step_begin(step)
                     with self.events.bounded(ev.EVENT_STEP, trainer=self, step=step):
-                        batch = self._stage_batch(raw)
+                        if raw is not None:
+                            batch = self._stage_batch(raw)
                         with self.events.bounded(
                             ev.EVENT_FORWARD_BACKWARD, trainer=self, step=step
                         ):
@@ -341,6 +386,9 @@ class Trainer:
         finally:
             # release the profiler trace and flush/close the tracker run even
             # when a step raises (a dangling trace breaks the next train())
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
             self.profiler.close()
             if self.run is not None:
                 self.run.close()

@@ -16,11 +16,12 @@ at pp=2/µB=8 with zero real communication), the interpretation loop is
 pre-compiled at construction: the program is flattened once into a list of
 (bound handler, action, trace label) triples — no isinstance chains or
 label formatting on the step path — microbatch kwargs are staged onto
-each stage's submesh through a bounded sliding window (async puts that
-overlap compute instead of splitting dispatch gaps mid-schedule, refilled
-as entries are consumed so residency stays O(window), not O(microbatches)),
-and per-microbatch loss statistics are summed in ONE fused jit at step end
-instead of one tiny dispatch per microbatch. Each action dispatch is wrapped in a gated
+stage submeshes through a bounded sliding window ordered by the
+schedule's first use (async puts that overlap compute instead of
+splitting dispatch gaps mid-schedule, refilled as entries are consumed,
+so total staged residency stays O(window + in-flight) rather than
+O(stages x microbatches)), and per-microbatch loss statistics are summed
+in ONE fused jit at step end instead of one tiny dispatch per microbatch. Each action dispatch is wrapped in a gated
 ``TraceAnnotation`` (core/tracing.py) mirroring the reference's
 ``record_function`` per action (runtime/executor.py:96).
 
@@ -74,8 +75,8 @@ class _StepState:
 
     __slots__ = (
         "carries", "states", "inputs", "kwargs_d", "kwargs_h", "kwargs_next",
-        "cots", "grad_in", "fwd_out", "grads", "aux", "outputs",
-        "weight_done",
+        "kwargs_staged", "cots", "grad_in", "fwd_out", "grads", "aux",
+        "outputs", "weight_done",
     )
 
     def __init__(self, num_microbatches: int):
@@ -85,7 +86,8 @@ class _StepState:
         self.inputs: dict[tuple[int, int], PyTree] = {}  # carry in (residual)
         self.kwargs_d: dict[tuple[int, int], PyTree] = {}  # kwargs on submesh
         self.kwargs_h: list[PyTree] = []  # mb → host kwargs tree
-        self.kwargs_next: dict[int, int] = {}  # stage → next mb to pre-stage
+        self.kwargs_next: int = 0  # index into the first-use staging order
+        self.kwargs_staged: set[tuple[int, int]] = set()  # ever staged
         self.cots: dict[tuple[int, int], PyTree] = {}  # cot wrt stage output
         self.grad_in: dict[tuple[int, int], PyTree] = {}  # dI awaiting send
         self.fwd_out: dict[tuple[int, int], PyTree] = {}  # out awaiting send
@@ -173,6 +175,19 @@ class PipelineScheduleExecutor:
 
         for _rank, action in self.order:
             add(action)
+        # kwargs staging order: (stage, mb) pairs by FIRST use in the plan
+        # (sends never read kwargs) — the sliding window stages whatever
+        # the schedule needs soonest, regardless of stage
+        seen: set[tuple[int, int]] = set()
+        first_use: list[tuple[int, int]] = []
+        for _h, action, _l in plan:
+            if isinstance(action, (ForwardSend, BackwardSend)):
+                continue
+            key = (action.stage, action.microbatch)
+            if key not in seen:
+                seen.add(key)
+                first_use.append(key)
+        self._kwargs_first_use = tuple(first_use)
         return tuple(plan)
 
     # ------------------------------------------------------------------
@@ -198,16 +213,18 @@ class PipelineScheduleExecutor:
                 st.carries[mb] = self._put(carry, first.carry_sharding)
                 st.kwargs_h.append(kw)
                 st.states[mb] = self._put(state, last.state_sharding)
-            # pre-stage a bounded window of kwargs per stage: the puts are
-            # async and overlap the first computes instead of splitting
-            # dispatch gaps mid-schedule, while device residency stays
-            # O(window + in-flight) instead of O(num_microbatches) — each
-            # consumed entry refills the window (_drop_kwargs)
-            window = min(self.num_microbatches, 2 * self.num_stages + 2)
-            for s in self.stages:
-                for mb in range(window):
-                    self._stage_kwargs(st, s, mb)
-                st.kwargs_next[s] = window
+            # pre-stage a bounded window of kwargs in the schedule's
+            # first-use order: the puts are async and overlap the first
+            # computes instead of splitting dispatch gaps mid-schedule,
+            # while TOTAL staged residency stays O(window + in-flight)
+            # instead of O(stages x microbatches) — each consumed entry
+            # refills the window (_drop_kwargs)
+            window = min(
+                len(self._kwargs_first_use), 2 * self.num_stages + 2
+            )
+            for key in self._kwargs_first_use[:window]:
+                self._stage_kwargs(st, *key)
+            st.kwargs_next = window
 
         for handler, action, label in self._plan:
             with annotate(label):
@@ -262,6 +279,7 @@ class PipelineScheduleExecutor:
     # shared helpers
 
     def _stage_kwargs(self, st: _StepState, s: int, mb: int) -> None:
+        st.kwargs_staged.add((s, mb))
         st.kwargs_d[(s, mb)] = self._put(
             st.kwargs_h[mb], self.stages[s].kwargs_sharding
         )
@@ -274,12 +292,17 @@ class PipelineScheduleExecutor:
         return kw
 
     def _drop_kwargs(self, st: _StepState, s: int, mb: int) -> None:
-        """Free a consumed kwargs buffer and refill the staging window."""
+        """Free a consumed kwargs buffer and refill the staging window
+        with the next first-use entry not already staged."""
         st.kwargs_d.pop((s, mb), None)
-        nxt = st.kwargs_next.get(s, self.num_microbatches)
-        if nxt < self.num_microbatches:
-            st.kwargs_next[s] = nxt + 1
-            self._stage_kwargs(st, s, nxt)
+        order = self._kwargs_first_use
+        nxt = st.kwargs_next
+        while nxt < len(order) and order[nxt] in st.kwargs_staged:
+            nxt += 1
+        if nxt < len(order):
+            self._stage_kwargs(st, *order[nxt])
+            nxt += 1
+        st.kwargs_next = nxt
 
     def _add_grads(self, st: _StepState, s: int, gp: PyTree) -> None:
         stage = self.stages[s]

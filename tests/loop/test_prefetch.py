@@ -1,0 +1,113 @@
+"""BatchPrefetcher: run-ahead semantics, error propagation, exact resume.
+
+The overlap itself (input work off the step path) is a chip-side property
+benched by bench.py's real-dataset mode; here the contract is tested:
+the producer stages ahead, positions track CONSUMED batches (not the
+producer's run-ahead), errors surface in the consumer, and a checkpoint
+taken mid-stream under prefetch resumes at exactly the next unconsumed
+batch (reference data_loader_factory.py:102 exact-resume bar).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from d9d_tpu.loop.components.data_loader import StatefulDataLoader
+from d9d_tpu.loop.components.prefetch import BatchPrefetcher
+
+
+class _Dataset:
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.full((4,), i, np.int32)}
+
+
+def _loader(**kw):
+    return StatefulDataLoader(_Dataset(), batch_size=4, shuffle=False, **kw)
+
+
+def test_prefetch_yields_same_batches_and_positions():
+    plain = list(iter(_loader()))
+    loader = _loader()
+    pf = BatchPrefetcher(
+        iter(loader), lambda b: b, depth=2, position_fn=loader.position
+    )
+    got = []
+    positions = []
+    for batch in pf:
+        got.append(batch)
+        positions.append(pf.consumed_position)
+    assert len(got) == len(plain)
+    for a, b in zip(got, plain):
+        np.testing.assert_array_equal(a["x"], b["x"])
+    # position of consumed batch b is the resume point b+1
+    assert [p["batch_index"] for p in positions[:3]] == [1, 2, 3]
+    pf.close()
+
+
+def test_prefetch_runs_ahead_of_consumer():
+    loader = _loader()
+    pf = BatchPrefetcher(
+        iter(loader), lambda b: b, depth=3, position_fn=loader.position
+    )
+    next(pf)  # consume one
+    deadline = time.time() + 5.0
+    while loader._batch_index < 4 and time.time() < deadline:
+        time.sleep(0.01)  # producer should fill the depth-3 queue
+    assert loader._batch_index >= 4  # 1 consumed + 3 queued
+    assert pf.consumed_position["batch_index"] == 1  # consumed, not fetched
+    pf.close()
+
+
+def test_prefetch_propagates_errors():
+    def broken():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom in dataset")
+
+    pf = BatchPrefetcher(broken(), lambda b: b, depth=2)
+    next(pf)
+    with pytest.raises(RuntimeError, match="boom in dataset"):
+        next(pf)
+    pf.close()
+
+
+def test_prefetch_stage_fn_runs_in_producer():
+    seen = []
+    pf = BatchPrefetcher(
+        iter(_loader()), lambda b: (seen.append(1), b)[1], depth=2
+    )
+    first = next(pf)
+    assert "x" in first
+    assert len(seen) >= 1
+    pf.close()
+
+
+def test_state_dict_at_serializes_consumed_position():
+    loader = _loader()
+    pf = BatchPrefetcher(
+        iter(loader), lambda b: b, depth=3, position_fn=loader.position
+    )
+    next(pf)
+    next(pf)
+    state = loader.state_dict_at(pf.consumed_position)
+    pf.close()
+
+    resumed = _loader()
+    resumed.load_state_dict(state)
+    nxt = next(iter(resumed))
+    # consumed batches 0 and 1 → resume yields batch 2 (items 8..11)
+    np.testing.assert_array_equal(nxt["x"][:, 0], [8, 9, 10, 11])
+
+
+def test_close_unblocks_full_queue():
+    loader = _loader()
+    pf = BatchPrefetcher(iter(loader), lambda b: b, depth=1)
+    next(pf)
+    pf.close()
+    assert not pf._thread.is_alive()

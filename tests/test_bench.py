@@ -76,3 +76,15 @@ def test_bench_kernels_tiny_runs(devices):
     rows = [_json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
     benches = {r["bench"] for r in rows if "bench" in r}
     assert {"sdpa_fwd", "linear_ce_fwd", "rms_norm", "stochastic_round"} <= benches
+
+
+def test_bench_input_pipeline_tiny_runs(devices):
+    """run_bench_input_pipeline (VERDICT r3 item 4): all three variants
+    produce positive step times on the CPU rig (overlap itself is a
+    chip-side property; this guards the harness against loop refactors)."""
+    bench = _load_bench()
+    result = bench.run_bench_input_pipeline(tiny=True)
+    assert result["metric"] == "input_pipeline_step_ms"
+    for key in ("synthetic_ms", "sync_ms", "prefetch_ms"):
+        # None = benchtime.timeit deemed the case unmeasurable (RTT jitter)
+        assert result[key] is None or result[key] > 0

@@ -49,6 +49,13 @@ r["detail"]["variant"] = "ub2_bf16_params_stochastic_adamw"
 print(json.dumps(r))
 EOF
 
+echo "== input-pipeline overlap (synthetic vs sync vs prefetch)"
+python - <<'PYEOF' | tee -a bench_results/bench_sweep.jsonl
+import json
+import bench
+print(json.dumps(bench.run_bench_input_pipeline()))
+PYEOF
+
 echo "== kernel latency harness"
 python tools/bench_kernels.py | tee bench_results/kernels.jsonl
 
