@@ -35,7 +35,7 @@ __all__ = [
 
 class GPipeScheduleConfig(pydantic.BaseModel):
     kind: Literal["gpipe"] = "gpipe"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
 
 
 class InferenceScheduleConfig(pydantic.BaseModel):
@@ -45,13 +45,13 @@ class InferenceScheduleConfig(pydantic.BaseModel):
 
 class LoopedBFSScheduleConfig(pydantic.BaseModel):
     kind: Literal["looped_bfs"] = "looped_bfs"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
     stages_per_rank: int = 1
 
 
 class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
     kind: Literal["interleaved_1f1b"] = "interleaved_1f1b"
-    residual_policy: Literal["remat", "cache_full"] = "remat"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
     stages_per_rank: int = 1
 
 
@@ -60,22 +60,29 @@ class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
 # chip, zb1p/remat ran 30% slower than 1F1B (each dI and dW phase recomputes
 # the stage forward) while zb1p/cache_full tied it. remat remains available
 # for memory-bound real-PP runs where filling bubbles with W-compute pays.
+#
+# r4 adds "cache_acts" — the true zero-bubble split (dW at the W slot from
+# saved residuals, 1F1B FLOPs; see runtime/stage.py). The dependency-level
+# simulation (tools/pp_makespan.py, BASELINE.md r4 table) shows it strictly
+# dominating both other policies at every multi-rank config (−12.6% vs 1F1B
+# at pp=8/µB=8); it stays opt-in until the residual write+read tax between
+# the I and W jits is measured on chip (queued in run_tpu_benches.sh).
 
 
 class ZeroBubble1PScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_1p"] = "zero_bubble_1p"
-    residual_policy: Literal["remat", "cache_full"] = "cache_full"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
     stages_per_rank: int = 1
 
 
 class ZeroBubbleVScheduleConfig(pydantic.BaseModel):
     kind: Literal["zero_bubble_v"] = "zero_bubble_v"
-    residual_policy: Literal["remat", "cache_full"] = "cache_full"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
 
 
 class DualPipeVScheduleConfig(pydantic.BaseModel):
     kind: Literal["dual_pipe_v"] = "dual_pipe_v"
-    residual_policy: Literal["remat", "cache_full"] = "cache_full"
+    residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
 
 
 PipelineScheduleConfig = Annotated[

@@ -76,7 +76,7 @@ class _StepState:
     __slots__ = (
         "carries", "states", "inputs", "kwargs_d", "kwargs_h", "kwargs_next",
         "kwargs_staged", "cots", "grad_in", "fwd_out", "grads", "aux",
-        "outputs", "weight_done",
+        "outputs", "weight_done", "saved",
     )
 
     def __init__(self, num_microbatches: int):
@@ -96,6 +96,8 @@ class _StepState:
         self.outputs: list[PyTree | None] = [None] * num_microbatches
         # (stage, mb) whose weight grads were already produced at the I slot
         self.weight_done: set[tuple[int, int]] = set()
+        # cache_acts: (stage, mb) → backward residuals awaiting the W slot
+        self.saved: dict[tuple[int, int], Any] = {}
 
 
 class PipelineScheduleExecutor:
@@ -378,6 +380,21 @@ class PipelineScheduleExecutor:
     def _act_backward_input(self, st: _StepState, action: Action) -> None:
         s, mb = action.stage, action.microbatch
         stage = self.stages[s]
+        if stage.residual_policy == "cache_acts":
+            # true zero-bubble split: dI + residual capture now, dW at the
+            # deferred W slot from the captured residuals
+            cot = None if stage.info.is_last else st.cots.pop((s, mb), None)
+            state = st.states.get(mb) if stage.info.is_last else None
+            gc, aux, saved = stage.backward_input_acts(
+                st.inputs.pop((s, mb)), self._kwargs(st, s, mb), cot, state
+            )
+            self._drop_kwargs(st, s, mb)  # residuals replace kwargs reuse
+            st.saved[(s, mb)] = saved
+            if aux is not None:
+                st.aux.append(aux)
+            if gc is not None:
+                self._route_input_grad(st, s, mb, gc)
+            return
         if stage.residual_policy == "cache_full":
             # fused backward at the I slot: weight grads accumulate
             # now, the deferred BackwardWeight becomes a no-op
@@ -407,6 +424,10 @@ class PipelineScheduleExecutor:
     def _act_backward_weight(self, st: _StepState, action: Action) -> None:
         s, mb = action.stage, action.microbatch
         stage = self.stages[s]
+        if stage.residual_policy == "cache_acts":
+            gp = stage.backward_weight_acts(st.saved.pop((s, mb)))
+            self._add_grads(st, s, gp)
+            return
         if (s, mb) in st.weight_done:
             st.weight_done.discard((s, mb))
             return

@@ -23,6 +23,7 @@ from typing import Any, Protocol
 
 import flax.linen as nn
 import jax
+import jax.numpy as jnp
 
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.stage_info import PipelineStageInfo
@@ -104,8 +105,18 @@ class PipelineStageRuntime:
     #   accumulate immediately; the deferred BackwardWeight action becomes
     #   a no-op. Trades the zero-bubble property (the dW slot no longer
     #   holds compute to fill the bubble) for one forward less per mb.
+    # - "cache_acts": the TRUE zero-bubble split (arXiv 2401.10241
+    #   semantics, r4): the I slot runs one forward + ONLY the carry-
+    #   cotangent half of the backward and hands the backward's residuals
+    #   to the deferred W slot, which computes the weight grads from them —
+    #   same total FLOPs as a fused backward, with dW genuinely off the
+    #   inter-stage critical path. Implemented by closure-converting the
+    #   stage VJP into a pure jaxpr + residual arrays: the I-slot jit keeps
+    #   forward+dI (XLA dead-code-eliminates the dW half), the W-slot jit
+    #   keeps dW alone. Costs residual memory between the I and W actions
+    #   (what the ZB schedules' memory model budgets for).
     # The better default is workload-dependent — tools/bench_pp.py measures
-    # both; see BASELINE.md.
+    # all three; see BASELINE.md.
     residual_policy: str = "remat"
 
     def __post_init__(self) -> None:
@@ -132,6 +143,21 @@ class PipelineStageRuntime:
         )
         self._cast = jax.jit(
             lambda g: jax.tree.map(lambda x: x.astype(self.grad_dtype), g)
+        )
+        if self.residual_policy not in ("remat", "cache_full", "cache_acts"):
+            raise ValueError(
+                f"unknown residual_policy {self.residual_policy!r}"
+            )
+        # cache_acts split: VJP jaxprs recorded while tracing the I-slot
+        # jit, keyed by residual signature, replayed by the W-slot jit (the
+        # executor always runs I before W for a (stage, mb), so the first
+        # W trace for any signature finds its record)
+        self._acts_records = {}
+        self._bwd_input_acts = jax.jit(
+            scoped("bwd_dI_acts", self._bwd_input_acts_impl)
+        )
+        self._bwd_weight_acts = jax.jit(
+            scoped("bwd_dW_acts", self._bwd_weight_acts_impl)
         )
 
     # ---- forward ---------------------------------------------------------
@@ -234,6 +260,118 @@ class PipelineStageRuntime:
         )
         (gp,) = vjp(cot)
         return gp
+
+    # ---- backward (cache_acts: residual-cached true zero-bubble split) --
+
+    @staticmethod
+    def _acts_sig(saved):
+        """Shape/dtype signature of a residual payload — the key tying a
+        W-slot evaluation to the jaxpr its I slot traced (a retrace for
+        different shapes, e.g. a ragged last microbatch, records its own
+        entry instead of clobbering shared state)."""
+        consts, cot = saved
+        return (
+            tuple((tuple(x.shape), str(x.dtype)) for x in consts),
+            tuple(
+                (tuple(x.shape), str(x.dtype)) for x in jax.tree.leaves(cot)
+            ),
+        )
+
+    def _record_acts(self, vjp, cot, params):
+        """Trace the stage VJP once and file it under its residual
+        signature. Residual consts that are literally the parameter arrays
+        (the dI half's weight references) are NOT carried in ``saved`` —
+        the W slot rebuilds them from ``self.params``, so the payload holds
+        activations only, not a duplicate copy of the stage weights per
+        in-flight microbatch."""
+        closed, out_shape = jax.make_jaxpr(vjp, return_shape=True)(cot)
+        param_ids = {
+            id(leaf): i for i, leaf in enumerate(jax.tree.leaves(params))
+        }
+        param_slots = {}  # const position → param leaf index
+        saved_consts = []
+        for pos, const in enumerate(closed.consts):
+            j = param_ids.get(id(const))
+            if j is None:
+                saved_consts.append(const)
+            else:
+                param_slots[pos] = j
+        record = (
+            closed.jaxpr,
+            jax.tree.structure(out_shape),
+            len(closed.consts),
+            param_slots,
+        )
+        saved = (saved_consts, cot)
+        self._acts_records[self._acts_sig(saved)] = record
+        return saved
+
+    def _bwd_input_acts_impl(self, params, carry, kwargs, cot, state):
+        """I slot: forward + carry-cotangent half → (gc, aux, saved).
+
+        ``gc`` comes from a direct vjp call whose weight-grad outputs are
+        unused — XLA dead-code-eliminates the dW half from THIS jit. The
+        same vjp is traced into a jaxpr filed by residual signature; the
+        W slot replays it with only the dW outputs live."""
+        if self.info.is_last:
+            if self.info.is_first:
+                loss, vjp, (weight, metrics) = jax.vjp(
+                    lambda p: self._loss_of(p, carry, kwargs, state),
+                    params, has_aux=True,
+                )
+            else:
+                loss, vjp, (weight, metrics) = jax.vjp(
+                    lambda p, c: self._loss_of(p, c, kwargs, state),
+                    params, carry, has_aux=True,
+                )
+            seed = jnp.ones_like(loss)
+            saved = self._record_acts(vjp, seed, params)
+            gc = None if self.info.is_first else vjp(seed)[1]
+            return gc, (loss, weight, metrics), saved
+        if self.info.is_first:
+            _, vjp = jax.vjp(
+                lambda p: self.task.stage_forward(
+                    self.module, p, carry, kwargs
+                ),
+                params,
+            )
+            return None, None, self._record_acts(vjp, cot, params)
+        _, vjp = jax.vjp(
+            lambda p, c: self.task.stage_forward(self.module, p, c, kwargs),
+            params, carry,
+        )
+        saved = self._record_acts(vjp, cot, params)
+        gc = vjp(cot)[1]
+        return gc, None, saved
+
+    def _bwd_weight_acts_impl(self, params, saved):
+        """W slot: weight grads alone, from the I slot's residuals."""
+        record = self._acts_records.get(self._acts_sig(saved))
+        if record is None:  # pragma: no cover — executor ordering
+            raise RuntimeError(
+                "cache_acts weight backward before a matching input backward"
+            )
+        jaxpr, out_tree, n_consts, param_slots = record
+        consts_iter = iter(saved[0])
+        params_flat = jax.tree.leaves(params)
+        consts = [
+            params_flat[param_slots[pos]]
+            if pos in param_slots else next(consts_iter)
+            for pos in range(n_consts)
+        ]
+        out = jax.core.eval_jaxpr(
+            jaxpr, consts, *jax.tree.leaves(saved[1])
+        )
+        flat_out = jax.tree.unflatten(out_tree, out)
+        return flat_out[0]
+
+    def backward_input_acts(self, carry, kwargs, cot=None, state=None):
+        with self._scoped():
+            return self._bwd_input_acts(self.params, carry, kwargs, cot, state)
+
+    def backward_weight_acts(self, saved):
+        with self._scoped():
+            return self._bwd_weight_acts(self.params, saved)
 
     def backward_full(self, carry, kwargs, cot=None, state=None):
         with self._scoped():

@@ -50,10 +50,28 @@ def _shardwise_put(x: jax.Array, sharding) -> jax.Array:
 
 
 # Whether this runtime accepts a direct device_put between different
-# device sets (TPU/TFRT: yes; CPU multi-controller: no). Probed on the
-# first cross-set transfer and cached — the step path then branches
-# instead of raising and catching per transfer.
+# device sets (TPU/TFRT: yes; CPU multi-controller: no). Classified once:
+# when the first cross-set payload put raises ValueError, a tiny dedicated
+# probe REPLICATING the failure mode (an array on a source device moved
+# onto the destination sharding's device set) decides whether that was a
+# capability limit (→ shard-wise fallback forever) or a real error in the
+# payload itself (→ re-raised, never masked) (ADVICE r3).
 _cross_set_direct: bool | None = None
+
+
+def _probe_cross_set(src_device, dst_sharding) -> bool:
+    """Can this runtime device_put onto a different-device-set sharding?"""
+    import numpy as np
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    probe = jax.device_put(np.zeros((1,), np.float32), src_device)
+    replicated = NamedSharding(dst_sharding.mesh, PartitionSpec())
+    try:
+        jax.block_until_ready(jax.device_put(probe, replicated))
+    except ValueError:
+        return False
+    return True
 
 
 def put_compat(tree: PyTree, sharding) -> PyTree:
@@ -82,6 +100,13 @@ def put_compat(tree: PyTree, sharding) -> PyTree:
         try:
             out = jax.device_put(x, sharding)
         except ValueError:
+            if _probe_cross_set(
+                next(iter(src.addressable_devices)), sharding
+            ):
+                # runtime CAN do cross-set puts — the payload itself is
+                # broken; don't let the fallback mask its error
+                _cross_set_direct = True
+                raise
             _cross_set_direct = False
             return _shardwise_put(x, sharding)
         _cross_set_direct = True

@@ -172,7 +172,7 @@ def test_interleaved_1f1b(pp, v, m):
 
 @pytest.mark.parametrize("m", [4, 8])
 @pytest.mark.parametrize("pp", [2, 4])
-@pytest.mark.parametrize("residual_policy", ["remat", "cache_full"])
+@pytest.mark.parametrize("residual_policy", ["remat", "cache_full", "cache_acts"])
 def test_zb1p(pp, m, residual_policy):
     b = Interleaved1F1BProgramBuilder(pp, zero_bubble=True)
     assert_close(
@@ -189,7 +189,7 @@ def test_looped_bfs(pp, v, m):
 
 @pytest.mark.parametrize("m", [2, 4, 7])
 @pytest.mark.parametrize("pp", [2, 4])
-@pytest.mark.parametrize("residual_policy", ["remat", "cache_full"])
+@pytest.mark.parametrize("residual_policy", ["remat", "cache_full", "cache_acts"])
 def test_zero_bubble_v(pp, m, residual_policy):
     b = ZeroBubbleVProgramBuilder(pp)
     assert_close(
@@ -199,9 +199,12 @@ def test_zero_bubble_v(pp, m, residual_policy):
 
 @pytest.mark.parametrize("m", [2, 4, 7])
 @pytest.mark.parametrize("pp", [2, 4])
-def test_dual_pipe_v(pp, m):
+@pytest.mark.parametrize("residual_policy", ["remat", "cache_full", "cache_acts"])
+def test_dual_pipe_v(pp, m, residual_policy):
     b = DualPipeVProgramBuilder(pp)
-    assert_close(*run_schedule(b, m), b.num_stages)
+    assert_close(
+        *run_schedule(b, m, residual_policy=residual_policy), b.num_stages
+    )
 
 
 @pytest.mark.parametrize("pp", [1, 4])

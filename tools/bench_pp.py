@@ -25,7 +25,8 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
+def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype,
+                 pp=1):
     import jax
     import jax.numpy as jnp
 
@@ -50,7 +51,10 @@ def build_engine(schedule_cfg, *, cfg, seq_len, batch, microbatch, dtype):
             z = jnp.zeros((b, t), jnp.int32)
             return (z, z, z)
 
-    ctx = MeshParameters().build(jax.devices()[:1])
+    # pp=1: virtual stages share one device (no bubbles, measures dispatch
+    # overhead). pp>1: one device group per stage — real warmup/drain
+    # bubbles, the regime zero-bubble schedules exist for.
+    ctx = MeshParameters(pp=pp).build(jax.devices()[:pp])
     import optax
 
     engine = PipelineTrainEngine(
@@ -150,16 +154,43 @@ def main():
     ap.add_argument("--tiny", action="store_true", help="CPU smoke config")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument(
+        "--pp", type=int, default=1,
+        help="pipeline stages on SEPARATE devices (default 1 = virtual "
+        "stages on one device; --pp 4 on the 8-CPU rig measures real "
+        "warmup/drain bubbles per schedule — the zero-bubble regime)",
+    )
+    ap.add_argument(
+        "--microbatches", type=int, default=None,
+        help="override the microbatch COUNT (must divide the global batch)",
+    )
+    ap.add_argument(
+        "--only", default=None,
+        help="comma-separated schedule/policy filters, e.g. "
+        "'1f1b/remat,zb1p/cache_acts' (substring match on schedule alone "
+        "also works)",
+    )
+    ap.add_argument(
         "--profile", default=None, metavar="DIR",
         help="capture a jax.profiler trace per combination into DIR/<name> "
         "(inspect executor dispatch gaps / overlap in xprof)",
     )
     args = ap.parse_args()
 
-    if args.tiny:
-        # --tiny is the CPU smoke: force the platform programmatically —
-        # the container's sitecustomize registers the axon TPU backend at
-        # interpreter startup, so the JAX_PLATFORMS env var is ignored
+    if args.tiny or args.pp > 1:
+        # CPU rig: force the platform programmatically — the container's
+        # sitecustomize registers the axon TPU backend at interpreter
+        # startup, so the JAX_PLATFORMS env var is ignored. (--pp > 1 is
+        # CPU-only here: the tunnel exposes a single chip.) The virtual
+        # device count must be set before the backend initializes.
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(args.pp, 2)}"
+            ).strip()
+
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -174,7 +205,19 @@ def main():
         ZeroBubbleVScheduleConfig,
     )
 
-    if args.tiny:
+    if args.pp > 1:
+        # real-bubble rig: one device group per stage, enough layers for
+        # the V schedules' 2 stages/rank, microbatch count small enough
+        # that warmup/drain bubbles are a visible fraction of the step
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 1024),), hidden_size=256,
+            num_layers=2 * args.pp, num_heads=4, num_kv_heads=2,
+            head_dim=64, intermediate_size=1024, remat=False,
+        )
+        seq_len, batch, microbatch = 256, 16, 2
+        warmup, steps = 2, 5
+        dtype = jnp.float32
+    elif args.tiny:
         cfg = Qwen3DenseConfig(
             vocab_ranges=(("default", 256),), hidden_size=64, num_layers=2,
             num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
@@ -194,26 +237,52 @@ def main():
         dtype = jnp.bfloat16
     if args.steps:
         steps = args.steps
+    if args.microbatches:
+        if batch % args.microbatches:
+            raise SystemExit(
+                f"--microbatches {args.microbatches} does not divide the "
+                f"global batch {batch}"
+            )
+        microbatch = batch // args.microbatches
 
+    spr = 2 if args.pp == 1 else 1  # virtual stages only on the 1-device rig
     combos = [
         ("1f1b", "remat",
-         Interleaved1F1BScheduleConfig(stages_per_rank=2)),
+         Interleaved1F1BScheduleConfig(stages_per_rank=spr)),
         ("zb1p", "remat",
          ZeroBubble1PScheduleConfig(
-             stages_per_rank=2, residual_policy="remat")),
+             stages_per_rank=spr, residual_policy="remat")),
         ("zb1p", "cache_full",
          ZeroBubble1PScheduleConfig(
-             stages_per_rank=2, residual_policy="cache_full")),
-        # V-style schedules are fixed at 2 stages/rank — same virtual-stage
-        # rig; defaults (cache_full) per the measured policy
+             stages_per_rank=spr, residual_policy="cache_full")),
+        # the true zero-bubble split (r4): dW deferred at 1F1B FLOPs
+        ("zb1p", "cache_acts",
+         ZeroBubble1PScheduleConfig(
+             stages_per_rank=spr, residual_policy="cache_acts")),
+        # V-style schedules are fixed at 2 stages/rank
         ("zbv", "cache_full", ZeroBubbleVScheduleConfig()),
+        ("zbv", "cache_acts",
+         ZeroBubbleVScheduleConfig(residual_policy="cache_acts")),
         ("dualpipev", "cache_full", DualPipeVScheduleConfig()),
+        ("dualpipev", "cache_acts",
+         DualPipeVScheduleConfig(residual_policy="cache_acts")),
     ]
+    if args.only:
+        wanted = [w.strip() for w in args.only.split(",")]
+        combos = [
+            (n, p, s) for n, p, s in combos
+            if any(w == n or w == f"{n}/{p}" or w in n for w in wanted)
+        ]
+        if not combos:
+            raise SystemExit(
+                f"--only {args.only!r} matched nothing; valid: "
+                "gpipe 1f1b zb1p zbv dualpipev (optionally /<policy>)"
+            )
     results = []
     for name, policy, sched in combos:
         engine = build_engine(
             sched, cfg=cfg, seq_len=seq_len, batch=batch,
-            microbatch=microbatch, dtype=dtype,
+            microbatch=microbatch, dtype=dtype, pp=args.pp,
         )
         dt = measure(
             engine, batch=batch, microbatch=microbatch, seq_len=seq_len,

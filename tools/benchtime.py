@@ -22,7 +22,18 @@ def host_fetch_sync(out):
     import numpy as np
 
     leaf = jax.tree.leaves(out)[0]
-    np.asarray(jax.device_get(leaf if leaf.ndim == 0 else leaf.ravel()[0]))
+    if leaf.ndim == 0:
+        np.asarray(jax.device_get(leaf))
+        return
+    # the one-element slice is a traced op: scope the leaf's own mesh so an
+    # ambient mesh over a different device group can't clash (pp rigs place
+    # stage params on per-stage submeshes)
+    mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+    if mesh is not None and getattr(mesh, "devices", None) is not None:
+        with jax.set_mesh(mesh):
+            np.asarray(jax.device_get(leaf.ravel()[0]))
+    else:
+        np.asarray(jax.device_get(leaf.ravel()[0]))
 
 
 def measure_rtt(out, samples: int = 3) -> float:
