@@ -27,6 +27,7 @@ PEAK_FLOPS = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v4": 275e12,
 # Best previously recorded results (BASELINE.md measured rows).
 RECORDED_DENSE = {"v5 lite": 48163.0, "v5e": 48163.0}
 RECORDED_MOE = {"v5 lite": 25280.0, "v5e": 25280.0}
+RECORDED_HYBRID: dict[str, float] = {}  # no chip row yet (BASELINE cfg 5)
 
 
 def _flops_accounting(cfg, *, seq_len, active_param_count):
@@ -214,13 +215,19 @@ def run_bench(*, tiny: bool = False) -> dict:
     }
 
 
-def run_bench_moe(*, tiny: bool = False) -> dict:
+def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
     """Qwen3-MoE pretrain row — the BASELINE.json north-star metric.
 
     Single chip: local MoE path (no EP axes), auto SDPA (pallas flash on
     TPU), fused CCE, remat — target-config shape per the reference example
     (example/qwen3_moe/pretrain.json:57-80: 16 layers, 128 experts, top-8,
     hidden 768), sized to fit one chip's HBM.
+
+    ``hybrid=True`` benches the Qwen3-Next-style family instead (BASELINE
+    config 5): the same MoE stack with GatedDeltaNet on 3 of every 4
+    layers (3:1 GDN:attention), sigmoid attention output gates, partial
+    RoPE and zero-centered norms — the linear-attention hot path running
+    through ops/gated_delta.py's chunked WY form.
     """
     import os
 
@@ -251,11 +258,15 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
                 moment_dtype=jnp.bfloat16,
             )
 
+    # hybrid: GDN everywhere except every 4th layer (Qwen3-Next 3:1 ratio)
+    def gdn_layers(n_layers):
+        return tuple(i for i in range(n_layers) if i % 4 != 3)
+
     if tiny:
         cfg = Qwen3MoeConfig(
             vocab_ranges=(("default", 256),),
             hidden_size=64,
-            num_layers=2,
+            num_layers=2 if not hybrid else 4,
             num_heads=4,
             num_kv_heads=2,
             head_dim=16,
@@ -263,6 +274,16 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
             num_experts=8,
             num_experts_per_tok=2,
             remat=False,
+            **(
+                {
+                    "linear_attention_layers": gdn_layers(4),
+                    "use_output_gate": True,
+                    "rope_fraction": 0.25,
+                    "zero_centered_norms": True,
+                }
+                if hybrid
+                else {}
+            ),
         )
         seq_len, batch = 64, 4
         steps_warmup, steps_measure = 1, 2
@@ -285,6 +306,17 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
             remat=True,
             # tuning knob for on-chip sweeps, like the dense row's
             remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
+            **(
+                {
+                    # Qwen3-Next-style geometry on the north-star stack
+                    "linear_attention_layers": gdn_layers(16),
+                    "use_output_gate": True,
+                    "rope_fraction": 0.25,
+                    "zero_centered_norms": True,
+                }
+                if hybrid
+                else {}
+            ),
         )
         seq_len, batch = 2048, 8
         steps_warmup, steps_measure = 3, 10
@@ -359,7 +391,7 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
     tok_per_s = _measure(
         trainer, iter(Data().build()), warmup=steps_warmup,
         steps=steps_measure, batch=batch, seq_len=seq_len,
-        profile_tag=None if tiny else "moe",
+        profile_tag=None if tiny else ("hybrid" if hybrid else "moe"),
     )
 
     # active params: experts scaled by top_k/num_experts, everything else 1x
@@ -377,13 +409,30 @@ def run_bench_moe(*, tiny: bool = False) -> dict:
         - expert_params
         + expert_params * cfg.num_experts_per_tok / cfg.num_experts
     )
-    model_fpt, hw_fpt = _flops_accounting(
-        cfg, seq_len=seq_len, active_param_count=active
-    )
+    # hybrid: quadratic-attention FLOPs only on the attention layers; the
+    # GDN layers' chunked delta rule is O(T·chunk) — count it explicitly
+    n_attn_layers = cfg.num_layers - len(cfg.linear_attention_layers)
+    attn = 6 * n_attn_layers * cfg.num_heads * cfg.head_dim * seq_len
+    if cfg.linear_attention_layers:
+        # chunked WY form per token per GDN layer ≈ 3 (fwd+bwd) x 2 matmul
+        # sides x chunk x heads x (dk + dv) — see ops/gated_delta.py
+        chunk = 64
+        dk = cfg.gdn_head_qk_dim or cfg.head_dim
+        dv = cfg.gdn_head_v_dim or cfg.head_dim
+        hv = cfg.gdn_v_heads or cfg.num_heads
+        attn += (
+            6 * len(cfg.linear_attention_layers) * hv * chunk * (dk + dv)
+        )
+    model_fpt = 6 * active + attn
+    hw_fpt = (8 if cfg.remat else 6) * active + attn
     peak, kind = _peak()
-    recorded = next((v for k, v in RECORDED_MOE.items() if k in kind), None)
+    recorded_tbl = RECORDED_HYBRID if hybrid else RECORDED_MOE
+    recorded = next((v for k, v in recorded_tbl.items() if k in kind), None)
     return {
-        "metric": "qwen3_moe_tokens_per_sec_per_chip",
+        "metric": (
+            "qwen3_next_hybrid_tokens_per_sec_per_chip"
+            if hybrid else "qwen3_moe_tokens_per_sec_per_chip"
+        ),
         "value": round(tok_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tok_per_s / recorded, 4)
@@ -596,6 +645,19 @@ def main():
             "unit": moe["unit"],
             "vs_baseline": moe["vs_baseline"],
             **moe["detail"],
+        }
+    # BASELINE config 5: the hybrid (Qwen3-Next/GDN) family's first row
+    try:
+        hyb = run_bench_moe(hybrid=True)
+    except Exception as e:  # noqa: BLE001 — any chip-side failure
+        out["detail"]["hybrid_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    else:
+        out["detail"]["hybrid"] = {
+            "metric": hyb["metric"],
+            "value": hyb["value"],
+            "unit": hyb["unit"],
+            "vs_baseline": hyb["vs_baseline"],
+            **hyb["detail"],
         }
     print(json.dumps(out))
 

@@ -116,3 +116,13 @@ def test_bench_input_pipeline_tiny_runs(devices):
     for key in ("synthetic_ms", "sync_ms", "prefetch_ms"):
         # None = benchtime.timeit deemed the case unmeasurable (RTT jitter)
         assert result[key] is None or result[key] > 0
+
+
+def test_bench_hybrid_tiny_runs(devices):
+    """run_bench_moe(hybrid=True): the Qwen3-Next/GDN family's bench row
+    (BASELINE config 5) stays runnable on the CPU rig."""
+    bench = _load_bench()
+    result = bench.run_bench_moe(tiny=True, hybrid=True)
+    assert result["metric"] == "qwen3_next_hybrid_tokens_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["detail"]["mfu"] >= 0
