@@ -30,14 +30,36 @@ RECORDED_MOE = {"v5 lite": 25280.0, "v5e": 25280.0}
 RECORDED_HYBRID: dict[str, float] = {}  # no chip row yet (BASELINE cfg 5)
 
 
-def _flops_accounting(cfg, *, seq_len, active_param_count):
-    """(model_flops_per_token, hardware_flops_per_token)."""
+def _flops_accounting(cfg, *, seq_len, active_param_count,
+                      n_attn_layers=None, extra_attn_flops=0.0):
+    """(model_flops_per_token, hardware_flops_per_token).
+
+    ``n_attn_layers`` restricts the quadratic-attention term to that many
+    layers (hybrid stacks swap the rest for linear attention);
+    ``extra_attn_flops`` adds non-quadratic per-token sequence-mixing work
+    (e.g. the GDN chunked delta rule)."""
     n_params = active_param_count
+    layers = cfg.num_layers if n_attn_layers is None else n_attn_layers
     # causal attention: QK^T + PV fwd+bwd = 12 * L * H * D * T/2 per token
-    attn = 6 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq_len
+    attn = 6 * layers * cfg.num_heads * cfg.head_dim * seq_len
+    attn += extra_attn_flops
     model = 6 * n_params + attn
     hardware = (8 if cfg.remat else 6) * n_params + attn
     return model, hardware
+
+
+def _gdn_flops_per_token(cfg, chunk: int = 64) -> float:
+    """Chunked-WY gated-delta FLOPs per token across the GDN layers
+    (ops/gated_delta.py matmul inventory): per head per token the forward
+    costs ≈ 2·2·C·dk (k·kᵀ, q·kᵀ) + C·dv (triangular solve) + 2·C·dv
+    (attn·u) + 3·2·dk·dv (state read ×2 + state update); fwd+bwd ≈ 3×."""
+    if not cfg.linear_attention_layers:
+        return 0.0
+    dk = cfg.gdn_head_qk_dim or cfg.head_dim
+    dv = cfg.gdn_head_v_dim or cfg.head_dim
+    hv = cfg.gdn_v_heads or cfg.num_heads
+    per_head = 3 * (4 * chunk * dk + 3 * chunk * dv + 6 * dk * dv)
+    return len(cfg.linear_attention_layers) * hv * per_head
 
 
 def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len,
@@ -258,9 +280,21 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
                 moment_dtype=jnp.bfloat16,
             )
 
-    # hybrid: GDN everywhere except every 4th layer (Qwen3-Next 3:1 ratio)
-    def gdn_layers(n_layers):
-        return tuple(i for i in range(n_layers) if i % 4 != 3)
+    def hybrid_overrides(n_layers):
+        """Qwen3-Next-style geometry: GDN everywhere except every 4th
+        layer (3:1 ratio), gated attention, partial RoPE, zero-centered
+        norms — ONE definition so the tiny CI config and the benched chip
+        config can't drift apart."""
+        if not hybrid:
+            return {}
+        return {
+            "linear_attention_layers": tuple(
+                i for i in range(n_layers) if i % 4 != 3
+            ),
+            "use_output_gate": True,
+            "rope_fraction": 0.25,
+            "zero_centered_norms": True,
+        }
 
     if tiny:
         cfg = Qwen3MoeConfig(
@@ -274,16 +308,7 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
             num_experts=8,
             num_experts_per_tok=2,
             remat=False,
-            **(
-                {
-                    "linear_attention_layers": gdn_layers(4),
-                    "use_output_gate": True,
-                    "rope_fraction": 0.25,
-                    "zero_centered_norms": True,
-                }
-                if hybrid
-                else {}
-            ),
+            **hybrid_overrides(4),
         )
         seq_len, batch = 64, 4
         steps_warmup, steps_measure = 1, 2
@@ -306,17 +331,7 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
             remat=True,
             # tuning knob for on-chip sweeps, like the dense row's
             remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
-            **(
-                {
-                    # Qwen3-Next-style geometry on the north-star stack
-                    "linear_attention_layers": gdn_layers(16),
-                    "use_output_gate": True,
-                    "rope_fraction": 0.25,
-                    "zero_centered_norms": True,
-                }
-                if hybrid
-                else {}
-            ),
+            **hybrid_overrides(16),
         )
         seq_len, batch = 2048, 8
         steps_warmup, steps_measure = 3, 10
@@ -410,24 +425,22 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
         + expert_params * cfg.num_experts_per_tok / cfg.num_experts
     )
     # hybrid: quadratic-attention FLOPs only on the attention layers; the
-    # GDN layers' chunked delta rule is O(T·chunk) — count it explicitly
-    n_attn_layers = cfg.num_layers - len(cfg.linear_attention_layers)
-    attn = 6 * n_attn_layers * cfg.num_heads * cfg.head_dim * seq_len
-    if cfg.linear_attention_layers:
-        # chunked WY form per token per GDN layer ≈ 3 (fwd+bwd) x 2 matmul
-        # sides x chunk x heads x (dk + dv) — see ops/gated_delta.py
-        chunk = 64
-        dk = cfg.gdn_head_qk_dim or cfg.head_dim
-        dv = cfg.gdn_head_v_dim or cfg.head_dim
-        hv = cfg.gdn_v_heads or cfg.num_heads
-        attn += (
-            6 * len(cfg.linear_attention_layers) * hv * chunk * (dk + dv)
-        )
-    model_fpt = 6 * active + attn
-    hw_fpt = (8 if cfg.remat else 6) * active + attn
+    # GDN layers' chunked delta rule counted from its matmul inventory
+    model_fpt, hw_fpt = _flops_accounting(
+        cfg, seq_len=seq_len, active_param_count=active,
+        n_attn_layers=cfg.num_layers - len(cfg.linear_attention_layers),
+        extra_attn_flops=_gdn_flops_per_token(cfg),
+    )
     peak, kind = _peak()
     recorded_tbl = RECORDED_HYBRID if hybrid else RECORDED_MOE
     recorded = next((v for k, v in recorded_tbl.items() if k in kind), None)
+    if recorded is not None and not tiny:
+        vs_baseline = round(tok_per_s / recorded, 4)
+    else:
+        # no recorded row yet (or tiny CI config): report null rather
+        # than fabricating parity; the dense headline keeps the driver's
+        # numeric contract
+        vs_baseline = None if hybrid else 1.0
     return {
         "metric": (
             "qwen3_next_hybrid_tokens_per_sec_per_chip"
@@ -435,9 +448,7 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
         ),
         "value": round(tok_per_s, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tok_per_s / recorded, 4)
-        if (recorded is not None and not tiny)
-        else 1.0,
+        "vs_baseline": vs_baseline,
         "detail": {
             "mfu": round(tok_per_s * model_fpt / peak, 4),
             "hfu": round(tok_per_s * hw_fpt / peak, 4),
