@@ -603,43 +603,16 @@ def run_bench_input_pipeline(*, tiny: bool = False) -> dict:
     }
 
 
-def _require_backend(timeout_s: int = 600) -> None:
-    """Fail fast (exit 3) when the accelerator backend can't come up.
-
-    Through the axon tunnel a dead relay makes ``jax.devices()`` block
-    indefinitely (r3: >7 h outage observed); an un-killable hang is worse
-    for the driver than a clear error. The probe runs in a daemon thread
-    because the hang is inside the backend call itself.
-    """
+def main():
     import os
     import sys
-    import threading
 
-    result = {}
+    # tools/ sits next to this file; anchor the import so bench.py works
+    # when invoked from any cwd
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.benchtime import require_backend
 
-    def probe():
-        try:
-            import jax
-
-            result["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001 — reported then exit
-            result["error"] = repr(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" not in result:
-        print(
-            "bench: accelerator backend unavailable "
-            f"({result.get('error', f'jax.devices() hung >{timeout_s}s')})",
-            file=sys.stderr,
-            flush=True,
-        )
-        os._exit(3)
-
-
-def main():
-    _require_backend()
+    require_backend("bench")
     dense = run_bench()
     out = dict(dense)
     out["detail"] = dict(dense["detail"])

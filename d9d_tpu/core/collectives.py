@@ -114,10 +114,57 @@ def host_broadcast_object(obj: Any, root: int = 0) -> Any:
 def host_gather_variadic(
     arrays: Sequence[np.ndarray],
 ) -> list[np.ndarray]:
-    """Placeholder-compatible variadic gather: defers to allgather_object.
+    """Variadic-COUNT gather: each process contributes any number of
+    arrays; defers to allgather_object (pickle transport).
 
     Parity: reference gather_variadic_shape (dist_ops/tensor.py:113) which
     pre-exchanges shapes then isend/irecvs. On TPU hosts the payload runs
     over the DCN gRPC channel; shape exchange is folded into pickling.
+    For ONE large tensor per process, :func:`allgather_variadic` keeps the
+    payload on the device transport instead.
     """
     return [a for objs in host_allgather_object(list(arrays)) for a in objs]
+
+
+def allgather_variadic(x: "np.ndarray | jnp.ndarray") -> list[np.ndarray]:
+    """Tensor-level variadic-shape all-gather: every process contributes a
+    ``[n_i, ...]`` array whose leading dim differs; returns the per-process
+    arrays trimmed to their true lengths.
+
+    Parity: reference all_gather_variadic_shape
+    (d9d/core/dist_ops/tensor.py:85) — shape pre-exchange, pad to max,
+    one gather, trim. The padded gather rides
+    ``multihost_utils.process_allgather`` (a jitted device all_gather over
+    ICI/DCN), so large ragged eval outputs avoid the pickle channel of
+    :func:`host_allgather_object`. Trailing dims and dtype must agree
+    across processes.
+    """
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return [x]
+    from jax.experimental import multihost_utils
+
+    meta = host_allgather_object((x.shape, str(x.dtype)))
+    shapes = [m[0] for m in meta]
+    if any(s[1:] != x.shape[1:] or d != str(x.dtype) for s, d in meta):
+        raise ValueError(
+            f"allgather_variadic needs matching trailing dims and dtype; "
+            f"got {meta}"
+        )
+    # ship BYTES: process_allgather canonicalizes 64-bit dtypes to 32-bit
+    # under the default jax_enable_x64=False, which would silently truncate
+    # int64/float64 payloads — a uint8 view is dtype-exact for everything
+    payload = np.ascontiguousarray(x).view(np.uint8).reshape(-1)
+    max_bytes = max(
+        int(np.prod(s)) * x.dtype.itemsize for s in shapes
+    ) if shapes else 0
+    padded = np.zeros((max(max_bytes, 1),), np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    out = []
+    for i, s in enumerate(shapes):
+        n = int(np.prod(s)) * x.dtype.itemsize
+        out.append(
+            np.frombuffer(gathered[i, :n].tobytes(), dtype=x.dtype).reshape(s)
+        )
+    return out

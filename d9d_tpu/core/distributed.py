@@ -36,6 +36,7 @@ every process the same global mesh and each host feeds its local shard of
 the batch (the data loader shards by ``jax.process_index()``).
 """
 
+import contextlib
 import dataclasses
 import logging
 import os
@@ -202,3 +203,28 @@ def shutdown_distributed() -> None:
         jax.distributed.shutdown()
     _initialized = False
     _owns_runtime = False
+
+
+@contextlib.contextmanager
+def main_process_first(tag: str = "main_process_first"):
+    """Process 0 runs the body first; the rest wait, then run it.
+
+    Parity: reference ``main_process_first``
+    (d9d/core/dist_context/configured.py:162) — the rank-0-first pattern
+    for downloads/dataset materialization where one process should
+    populate a shared cache before the stampede. Single-process: plain
+    passthrough.
+    """
+    if jax.process_count() == 1:
+        yield
+        return
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == 0:
+        try:
+            yield
+        finally:
+            multihost_utils.sync_global_devices(tag + ":main_done")
+    else:
+        multihost_utils.sync_global_devices(tag + ":main_done")
+        yield

@@ -177,6 +177,14 @@ def grouped_swiglu_apply(
     reused across both projections, while parameters (and therefore
     checkpoints, HF mappers, PEFT and sharding plans) stay separate
     gate/up tensors.
+
+    Caveat (ADVICE r3): because ragged_dot is an opaque custom call, XLA
+    materializes the concatenated weight copy each forward (again in the
+    backward under remat) — one extra full-weight write+read per MoE layer
+    per microbatch. Measured a net win at the swept config (64E × i256);
+    re-check at flagship expert counts on the next chip window
+    (run_tpu_benches.sh) and pre-concatenate once per step outside the
+    microbatch path if it inverts.
     """
     x = permuted_x.astype(dtype)
     inter = gate_w.shape[-1]

@@ -38,6 +38,11 @@ class TokenSort(NamedTuple):
     group_sizes: Array
 
 
+# one-hot grouping wins below this M·E (int32 [M, E] ≈ 64 MB here); above,
+# its HBM traffic inverts the r3 sweep's verdict and argsort takes over
+_ONE_HOT_GROUPING_LIMIT = 16 * 1024 * 1024
+
+
 def stable_expert_order(
     flat_ids: Array, num_experts: int
 ) -> tuple[Array, Array, Array]:
@@ -51,8 +56,23 @@ def stable_expert_order(
     (log² passes); a log-depth cumsum over the [M, E] one-hot plus one
     scatter is much cheaper at MoE shapes, and the MoE layer runs this per
     layer per microbatch.
+
+    The one-hot costs O(M·E) HBM traffic (recomputed again under remat):
+    a win at swept shapes (M≤128k, E≤64: ≤33 MB) but inverting for very
+    large M·E (ADVICE r3: E=256, M=131k → 134 MB ×2 per MoE layer per
+    microbatch pressures HBM), so past a threshold this falls back to the
+    stable argsort instead.
     """
     m = flat_ids.shape[0]
+    if m * num_experts > _ONE_HOT_GROUPING_LIMIT:
+        sort_idx = jnp.argsort(flat_ids, stable=True).astype(jnp.int32)
+        dest = (
+            jnp.zeros((m,), jnp.int32)
+            .at[sort_idx]
+            .set(jnp.arange(m, dtype=jnp.int32), unique_indices=True)
+        )
+        group_sizes = jnp.bincount(flat_ids, length=num_experts)
+        return sort_idx, dest, group_sizes.astype(jnp.int32)
     one_hot = (
         flat_ids[:, None] == jnp.arange(num_experts, dtype=flat_ids.dtype)
     ).astype(jnp.int32)

@@ -1,0 +1,119 @@
+"""Variadic tensor gather + main_process_first across real processes
+(VERDICT r3 Missing #4/#5).
+
+Single-process degenerate paths run in the quick tier; the 2-process leg
+(device-transport gather of different-length arrays, rank-0-first
+ordering) runs in the e2e tier through the same bootstrap the training
+e2e tests use.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from d9d_tpu.core.collectives import allgather_variadic
+from d9d_tpu.core.distributed import main_process_first
+
+
+def test_allgather_variadic_single_process():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = allgather_variadic(x)
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0], x)
+
+
+def test_main_process_first_single_process():
+    ran = []
+    with main_process_first():
+        ran.append(True)
+    assert ran == [True]
+
+
+_CHILD = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from d9d_tpu.core import init_distributed
+
+assert init_distributed()
+import numpy as np
+from d9d_tpu.core.collectives import allgather_variadic
+from d9d_tpu.core.distributed import main_process_first
+
+pid = jax.process_index()
+
+# different leading dims per process; values encode the source
+n = 2 + 3 * pid
+x = np.full((n, 4), pid, np.float32)
+out = allgather_variadic(x)
+assert [a.shape[0] for a in out] == [2, 5], [a.shape for a in out]
+for i, a in enumerate(out):
+    assert (a == i).all()
+
+# int64 payloads must survive bit-exact (process_allgather would
+# canonicalize them to int32 under the default x64=off — the byte
+# transport avoids that)
+big = np.array([2**40 + pid, 7], np.int64)[: 1 + pid]
+out64 = allgather_variadic(big)
+assert [a.dtype for a in out64] == [np.int64, np.int64]
+assert out64[0].tolist() == [2**40]
+assert out64[1].tolist() == [2**40 + 1, 7]
+
+# main_process_first: process 0's body must complete before process 1's
+import time
+marker = os.environ["TEST_MARKER_DIR"] + f"/done_{pid}"
+with main_process_first():
+    if pid == 0:
+        time.sleep(1.0)  # make any ordering violation visible
+        open(marker, "w").write("ok")
+    else:
+        assert os.path.exists(
+            os.environ["TEST_MARKER_DIR"] + "/done_0"
+        ), "process 1 entered before process 0 finished"
+print("RESULT ok", pid)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.e2e
+def test_two_process_variadic_gather_and_main_first(tmp_path):
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    port = _free_port()
+    root = pathlib.Path(__file__).resolve().parent.parent.parent
+    procs = []
+    for pid in range(2):
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(root),
+            "D9D_COORDINATOR": f"localhost:{port}",
+            "D9D_NUM_PROCESSES": "2",
+            "D9D_PROCESS_ID": str(pid),
+            "TEST_MARKER_DIR": str(tmp_path),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(child)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err[-3000:]}"
+        results += [l for l in out.splitlines() if l.startswith("RESULT")]
+    assert sorted(results) == ["RESULT ok 0", "RESULT ok 1"]

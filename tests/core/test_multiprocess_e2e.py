@@ -24,6 +24,8 @@ import subprocess
 import sys
 
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 
 
 _CHILD = """

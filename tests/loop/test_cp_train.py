@@ -6,6 +6,9 @@ and reach the same parameters as a single-device eager run — proving the
 ring attention + cp batch sharding + grad flow are jointly correct (the
 reference has no CP to compare against; the oracle is the unsharded run).
 """
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
 
 import jax
 import jax.numpy as jnp

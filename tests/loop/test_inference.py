@@ -1,4 +1,7 @@
 """Inference loop tests (reference: loop/run/inference.py mirror)."""
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
 
 import jax
 import jax.numpy as jnp

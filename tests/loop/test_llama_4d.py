@@ -1,5 +1,8 @@
 """Llama-class (no qk-norm) dense model under the 4D layout (PP x FSDP x TP
 + remat) — BASELINE.md target config 4 shrunk to the 8-device CPU mesh."""
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
 
 import jax
 import jax.numpy as jnp

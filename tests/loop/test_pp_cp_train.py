@@ -4,6 +4,9 @@ Completes the composition matrix (PPxEP and PPxFSDPxTPxEP live in
 test_pp_ep_train.py; CP alone in test_cp_train.py): sequence-parallel
 ring attention must work when each pipeline stage runs it on its own
 submesh."""
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
 
 import jax
 import jax.numpy as jnp

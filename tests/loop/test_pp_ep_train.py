@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core import MeshParameters

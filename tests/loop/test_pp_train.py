@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 
 from d9d_tpu.core import MeshParameters
 from d9d_tpu.loop import (

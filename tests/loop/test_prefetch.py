@@ -13,6 +13,7 @@ import time
 import numpy as np
 import pytest
 
+
 from d9d_tpu.loop.components.data_loader import StatefulDataLoader
 from d9d_tpu.loop.components.prefetch import BatchPrefetcher
 

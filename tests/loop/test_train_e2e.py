@@ -8,6 +8,8 @@ weighted-loss semantics.
 import jax
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 
 from d9d_tpu.core import MeshParameters
 from d9d_tpu.loop import (

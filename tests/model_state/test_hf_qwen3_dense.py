@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 
 from d9d_tpu.model_state import save_params, load_params, write_model_state_local, identity_mapper_from_names
 from d9d_tpu.model_state.io.reader import read_model_state

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
 
 from d9d_tpu.model_state import (
     identity_mapper_from_names,

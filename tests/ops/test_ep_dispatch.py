@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
+
 from jax.sharding import Mesh, PartitionSpec as P
 
 from d9d_tpu.ops.ep_dispatch import ep_buffer_rows, ep_dispatch_compute_combine

@@ -292,3 +292,18 @@ class TestStableExpertOrder:
         idx, _, sizes = stable_expert_order(flat, 8)
         np.testing.assert_array_equal(idx, np.arange(7))
         assert int(sizes[3]) == 7 and int(sizes.sum()) == 7
+
+
+def test_stable_expert_order_argsort_fallback_matches(monkeypatch):
+    """Above the M*E threshold the grouping falls back to a stable argsort
+    (ADVICE r3: the one-hot's O(M*E) HBM traffic inverts at large expert
+    counts); both paths must produce identical permutations."""
+    import d9d_tpu.ops.moe as moe_ops
+
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 13, 2048).astype(np.int32))
+    fast = moe_ops.stable_expert_order(ids, 13)
+    monkeypatch.setattr(moe_ops, "_ONE_HOT_GROUPING_LIMIT", 0)
+    slow = moe_ops.stable_expert_order(ids, 13)
+    for a, b in zip(fast, slow):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
+
 
 from d9d_tpu.ops.attention.eager import eager_sdpa
 from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa

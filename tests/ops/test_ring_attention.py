@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core import MeshParameters

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: long fp32-tracking sweep
+
 
 from d9d_tpu.ops.stochastic import (
     stochastic_round_to_bf16,

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: LoRA trainer e2e
+
 
 from d9d_tpu.peft import (
     FullTune,

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
+
 
 from d9d_tpu.pipelining import (
     PipelineScheduleExecutor,

@@ -5,6 +5,9 @@ when the loop was refactored (VERDICT round 1, Weak #1). This test runs
 the actual benchmark harness (tiny config) so any API drift fails CI
 instead of the driver.
 """
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
 
 import importlib.util
 import pathlib

@@ -1,4 +1,7 @@
 """Dev tools must keep working (same rationale as test_bench.py)."""
+import pytest
+
+pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
 
 import pathlib
 import subprocess

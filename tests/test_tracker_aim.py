@@ -1,0 +1,98 @@
+"""Aim tracker smoke coverage WITHOUT aim installed (VERDICT r3 item 9).
+
+aim is an optional dependency that cannot be installed in this
+environment, so the AimTrackerRun code path is exercised against a stub
+``aim`` module implementing the two symbols it touches (``Run``,
+``Distribution``). This catches import-time and signature rot in
+tracker/providers.py's aim branch; the JSONL tracker remains the blessed
+default (its tests run the real thing).
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _StubRun:
+    def __init__(self, run_hash=None, repo=None, experiment=None):
+        self.hash = run_hash or "stub-hash-1"
+        self.repo = repo
+        self.experiment = experiment
+        self.tracked = []
+        self.items = {}
+        self.closed = False
+
+    def track(self, value, name=None, step=None, context=None):
+        self.tracked.append((name, value, step, context))
+
+    def __setitem__(self, k, v):
+        self.items[k] = v
+
+    def close(self):
+        self.closed = True
+
+
+class _StubDistribution:
+    def __init__(self, hist=None, bin_range=None):
+        self.hist = hist
+        self.bin_range = bin_range
+
+
+@pytest.fixture()
+def stub_aim(monkeypatch):
+    mod = types.ModuleType("aim")
+    mod.Run = _StubRun
+    mod.Distribution = _StubDistribution
+    monkeypatch.setitem(sys.modules, "aim", mod)
+    return mod
+
+
+def test_aim_run_full_protocol(stub_aim):
+    from d9d_tpu.tracker.providers import AimTrackerRun
+
+    run = AimTrackerRun(repo=None, experiment="exp")
+    run.track_scalar("loss", 1.5, step=3, context={"subset": "train"})
+    run.track_histogram(
+        "hist", np.array([1, 2, 3]), np.array([0.0, 1.0, 2.0, 3.0]), step=3
+    )
+    run.track_hparams({"lr": 1e-4})
+    assert run._run.tracked[0][0] == "loss"
+    assert isinstance(run._run.tracked[1][1], _StubDistribution)
+    assert run._run.items["lr"] == 1e-4
+
+    state = run.state_dict()
+    assert state["run_hash"] == "stub-hash-1"
+    # resuming onto a different hash reopens the original run
+    run.load_state_dict({"run_hash": "other-hash"})
+    assert run._run.hash == "other-hash"
+    run.close()
+    assert run._run.closed
+
+
+def test_build_tracker_aim_with_stub(stub_aim):
+    from d9d_tpu.tracker.providers import AimTracker, build_tracker
+
+    tracker = build_tracker("aim")
+    assert isinstance(tracker, AimTracker)
+    run = tracker.new_run("myrun")
+    run.track_scalar("x", 2.0, step=0)
+    run.close()
+
+
+def test_build_tracker_aim_without_aim(monkeypatch):
+    import builtins
+
+    from d9d_tpu.tracker.providers import NullTracker, build_tracker
+
+    real_import = builtins.__import__
+
+    def no_aim(name, *a, **kw):
+        if name == "aim":
+            raise ImportError("aim not installed")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.delitem(sys.modules, "aim", raising=False)
+    monkeypatch.setattr(builtins, "__import__", no_aim)
+    assert isinstance(build_tracker("aim"), NullTracker)
