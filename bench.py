@@ -154,6 +154,9 @@ def run_bench(*, tiny: bool = False) -> dict:
             remat=True,
             # tuning knob for on-chip sweeps (BASELINE.md methodology)
             remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
+            # r4 MFU lever: q/k/v as one matmul (single chip: no TP axis
+            # to reshard). A/B with D9D_BENCH_FUSED_QKV=0.
+            fused_qkv=os.environ.get("D9D_BENCH_FUSED_QKV", "1") == "1",
         )
         # batch knob for on-chip sweeps: more rows per step amortize
         # per-kernel overheads if HBM allows (full remat leaves plenty)
@@ -331,6 +334,8 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
             remat=True,
             # tuning knob for on-chip sweeps, like the dense row's
             remat_policy=os.environ.get("D9D_BENCH_REMAT_POLICY", "full"),
+            # r4 MFU lever, as in the dense row
+            fused_qkv=os.environ.get("D9D_BENCH_FUSED_QKV", "1") == "1",
             **hybrid_overrides(16),
         )
         seq_len, batch = 2048, 8
@@ -358,12 +363,10 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
                 # of every weight (2.7G of fp32 reads per pass)
                 param_dtype=jnp.float32 if microbatch <= 1 or tiny
                 else jnp.bfloat16,
-                # at microbatch 1 the CCE input is only 2048 tokens: one
-                # big chunk beats the global 512 default (which wins at
-                # n=16384; r3: 25.3k vs 24.5k tok/s for this config).
-                # Larger microbatches keep the swept-shape default — the
-                # smaller live logit slab is also what lets them fit.
-                ce_chunk_size=2048 if microbatch <= 1 else 512,
+                # "auto" (the r4 default) encodes the r3 sweep: one
+                # chunk at n<=2048 (the µBS=1 win: 25.3k vs 24.5k tok/s),
+                # 512 beyond — no per-config pin needed anymore
+                ce_chunk_size="auto",
             )
 
         def build_plan(self, c):

@@ -25,6 +25,9 @@ class Qwen3DenseConfig:
     window_size: int | None = None
     use_sinks: bool = False
     use_output_gate: bool = False
+    # single matmul for q/k/v (runtime kernel concat; see
+    # nn/attention.py fused_qkv — leave off for TP plans)
+    fused_qkv: bool = False
     remat: bool = True
     # "full" recomputes everything in backward (minimum memory, ~8N HFU);
     # "dots_no_batch" saves matmul outputs with no batch dims (XLA's

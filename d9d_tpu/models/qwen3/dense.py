@@ -117,6 +117,7 @@ class Qwen3DenseBackbone(nn.Module):
                 window_size=cfg.window_size,
                 use_sinks=cfg.use_sinks,
                 use_output_gate=cfg.use_output_gate,
+                fused_qkv=cfg.fused_qkv,
                 norm_eps=cfg.norm_eps,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
@@ -140,7 +141,7 @@ class Qwen3DenseCausalLM(nn.Module):
     config: Qwen3DenseConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
-    ce_chunk_size: int = 512
+    ce_chunk_size: "int | str" = "auto"
     act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

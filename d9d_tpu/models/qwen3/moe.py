@@ -66,6 +66,8 @@ class Qwen3MoeConfig:
     # zero-centered RMSNorm weights (scale = 1 + w) on every norm except
     # the GDN gated output norm
     use_output_gate: bool = False
+    # single matmul for q/k/v (see nn/attention.py fused_qkv)
+    fused_qkv: bool = False
     rope_fraction: float = 1.0
     zero_centered_norms: bool = False
     # mesh axes carrying expert parallelism; None = local experts
@@ -229,6 +231,7 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 qk_norm=cfg.qk_norm,
                 qk_norm_zero_centered=zc,
                 use_output_gate=cfg.use_output_gate,
+                fused_qkv=cfg.fused_qkv,
                 rope_fraction=cfg.rope_fraction,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
@@ -343,7 +346,7 @@ class Qwen3MoeCausalLM(nn.Module):
     config: Qwen3MoeConfig
     sdpa: SdpaBackend
     stage: PipelineStageInfo = PipelineStageInfo()
-    ce_chunk_size: int = 512
+    ce_chunk_size: "int | str" = "auto"
     act_sharding: Optional[NamedSharding] = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

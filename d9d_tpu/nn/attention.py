@@ -19,6 +19,28 @@ from d9d_tpu.nn.sdpa.protocol import SdpaBackend
 from d9d_tpu.ops import RopeStyle, apply_rope
 
 
+class _ProjKernel(nn.Module):
+    """Declare a Dense-compatible kernel (``<name>/kernel``, shape
+    ``[in, features]``, lecun-normal, logical axes) and return it raw —
+    lets the fused-QKV path own the matmul while the parameter pytree
+    stays identical to three ``nn.Dense`` modules."""
+
+    features: int
+    axes: tuple
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, in_features: int) -> Array:
+        return self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), self.axes
+            ),
+            (in_features, self.features),
+            self.param_dtype,
+        )
+
+
 class GroupedQueryAttention(nn.Module):
     hidden_size: int
     num_heads: int
@@ -35,6 +57,13 @@ class GroupedQueryAttention(nn.Module):
     use_output_gate: bool = False
     window_size: int | None = None
     softmax_scale: float | None = None
+    # One matmul for q/k/v over a runtime kernel concat (the activation
+    # rows stream from HBM once instead of three times; same math, same
+    # parameter pytree — q_proj/k_proj/v_proj kernels stay separate for
+    # checkpoints/HF/PEFT/plans). Off by default: under tensor parallelism
+    # the concat crosses the tp-sharded head dim and XLA must reshard the
+    # kernels; single-chip benches enable it (D9D_BENCH_FUSED_QKV).
+    fused_qkv: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -63,9 +92,48 @@ class GroupedQueryAttention(nn.Module):
                 ),
             )
 
-        q = proj(h * d, "q_proj", (la.EMBED, la.HEADS))(x).reshape(b, t, h, d)
-        k = proj(hkv * d, "k_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
-        v = proj(hkv * d, "v_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
+        if self.fused_qkv:
+            # enforce the documented TP constraint: the runtime kernel
+            # concat crosses the tp-sharded head dim, so XLA would reshard
+            # the kernels every step — fail loudly instead of silently
+            # regressing (tp in the ambient mesh is how the plans shard
+            # HEADS/KV_HEADS)
+            from jax.sharding import get_abstract_mesh
+
+            mesh = get_abstract_mesh()
+            if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:
+                raise ValueError(
+                    "fused_qkv=True under a tp>1 mesh would reshard the "
+                    "q/k/v kernels every step; use fused_qkv=False with "
+                    "tensor parallelism"
+                )
+            in_f = x.shape[-1]
+
+            def kernel(features, name, axes):
+                # identical param path ("<name>/kernel"), shape and init
+                # stream as nn.Dense, so checkpoints and plans are
+                # indistinguishable from the unfused layout
+                return _ProjKernel(
+                    features=features, axes=axes,
+                    param_dtype=self.param_dtype, name=name,
+                )(in_f)
+
+            w = jnp.concatenate(
+                [
+                    kernel(h * d, "q_proj", (la.EMBED, la.HEADS)),
+                    kernel(hkv * d, "k_proj", (la.EMBED, la.KV_HEADS)),
+                    kernel(hkv * d, "v_proj", (la.EMBED, la.KV_HEADS)),
+                ],
+                axis=-1,
+            ).astype(self.dtype)
+            qkv = x.astype(self.dtype) @ w
+            q = qkv[..., : h * d].reshape(b, t, h, d)
+            k = qkv[..., h * d : (h + hkv) * d].reshape(b, t, hkv, d)
+            v = qkv[..., (h + hkv) * d :].reshape(b, t, hkv, d)
+        else:
+            q = proj(h * d, "q_proj", (la.EMBED, la.HEADS))(x).reshape(b, t, h, d)
+            k = proj(hkv * d, "k_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
+            v = proj(hkv * d, "v_proj", (la.EMBED, la.KV_HEADS))(x).reshape(b, t, hkv, d)
 
         if self.qk_norm:
             q = RMSNorm(d, eps=self.qk_norm_eps, name="q_norm",

@@ -26,6 +26,7 @@ class DecoderLayer(nn.Module):
     window_size: int | None = None
     use_sinks: bool = False
     use_output_gate: bool = False
+    fused_qkv: bool = False
     norm_eps: float = 1e-6
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
@@ -45,6 +46,7 @@ class DecoderLayer(nn.Module):
             window_size=self.window_size,
             use_sinks=self.use_sinks,
             use_output_gate=self.use_output_gate,
+            fused_qkv=self.fused_qkv,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="self_attn",

@@ -25,7 +25,7 @@ class LanguageModellingHead(nn.Module):
 
     vocab_ranges: tuple[tuple[str, int], ...]
     hidden_size: int
-    ce_chunk_size: int = 512
+    ce_chunk_size: "int | str" = "auto"
     logit_softcap: float | None = None
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32

@@ -59,12 +59,20 @@ def _chunk_loss(
     return jnp.where(labels == LM_IGNORE_INDEX, 0.0, loss)
 
 
+# auto chunking: a single chunk wins up to this many tokens (r3 sweep) —
+# but only while the live logit slab stays within the swept budget
+# (2048 tokens × 32768 vocab ≈ 268 MB fp32); larger n·V keeps chunking,
+# which is the whole point of CCE (never hold [N, V])
+_AUTO_SINGLE_CHUNK_MAX = 2048
+_AUTO_SINGLE_CHUNK_MAX_LOGITS = 2048 * 32_768
+
+
 def linear_cross_entropy(
     hidden: Array,
     weight: Array,
     labels: Array,
     *,
-    chunk_size: int = 512,
+    chunk_size: "int | str" = "auto",
     logit_softcap: float | None = None,
     matmul_dtype: str | None = None,
 ) -> Array:
@@ -79,13 +87,25 @@ def linear_cross_entropy(
     path, anything else stays exact fp32 — so fp32 callers never lose
     precision silently.
 
-    ``chunk_size=512`` follows the r3 on-chip sweep (tools/bench_kernels.py,
-    BASELINE.md): at n=16384 d=1024 v=32768 it beat 2048/8192 by ~20% fwd
-    and a few % fwd+bwd, while also holding the smallest live logit slab.
+    ``chunk_size`` follows the r3 on-chip sweeps (tools/bench_kernels.py,
+    BASELINE.md): at n=16384 d=1024 v=32768 chunk 512 beat 2048/8192 by
+    ~20% fwd while holding the smallest live logit slab, but at n=2048 a
+    SINGLE chunk beat 512 (25.3k vs 24.5k tok/s end-to-end, the µBS=1 MoE
+    row). ``"auto"`` (default) encodes that sweep: one chunk up to n=2048
+    AND a logit slab no bigger than the swept 2048×32768, 512 beyond —
+    pass an int to pin it.
     """
     if matmul_dtype is None:
         matmul_dtype = "bf16" if hidden.dtype == jnp.bfloat16 else "fp32"
     n, d = hidden.shape
+    if chunk_size == "auto":
+        v = weight.shape[0]
+        single = (
+            n <= _AUTO_SINGLE_CHUNK_MAX
+            and n * v <= _AUTO_SINGLE_CHUNK_MAX_LOGITS
+        )
+        chunk_size = n if single else 512
+    chunk_size = int(chunk_size)
     weight_t = weight.T  # [D, V]
 
     if n <= chunk_size:
