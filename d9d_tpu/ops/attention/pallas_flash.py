@@ -59,6 +59,10 @@ class _FlashConfig:
     # global offsets are device-dependent (traced), so they cannot live in
     # this static config.
     has_positions: bool = False
+    # One-pass backward (dq+dk+dv from a single logit recompute) instead
+    # of the two-kernel split — see _bwd_fused_kernel. Applied when the
+    # dq state fits VMEM (_fused_bwd_fits); sweep via D9D_TPU_FLASH_BWD.
+    fused_bwd: bool = False
 
 
 def _mask_block(s, cfg: _FlashConfig, iq, ik, q_seg, k_seg, qoff=None, koff=None):
@@ -262,16 +266,105 @@ def _bwd_dkv_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(*refs, cfg: _FlashConfig, n_q_blocks: int):
+    """One-pass backward: dq, dk and dv from a single logit recompute.
+
+    Same grid as the dkv kernel — (b, hkv, kv-block, g·q-block) — but the
+    [bq, bkv] logit block, its mask and the ds term are computed ONCE per
+    (q, kv) pair instead of once in each of the two split kernels (~20%
+    of the backward's matmul work saved, plus q/k/v/do read once). The
+    price: dq accumulates across the kv grid dim in a full-[g·Tq, d]
+    fp32 VMEM scratch and the dq output block stays resident per
+    (b, hkv), so this variant is gated on those fitting VMEM
+    (_fused_bwd_fits)."""
+    qoff, koff, refs = _read_offsets(cfg, refs)
+    if cfg.has_segments:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref = refs[:8]
+        dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc = refs[8:]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
+    ik, inner = pl.program_id(2), pl.program_id(3)
+    n_kv = pl.num_programs(2)
+    n_inner = pl.num_programs(3)
+    iq = inner % n_q_blocks
+    ig = inner // n_q_blocks
+
+    @pl.when(jnp.logical_and(ik == 0, inner == 0))
+    def _init_dq():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(inner == 0)
+    def _init_dkv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(jnp.logical_not(_skip_block(cfg, iq, ik, qoff, koff)))
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        q_seg, k_seg = _read_segs(cfg, qseg_ref, kseg_ref)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * cfg.scale
+        s = _mask_block(s, cfg, iq, ik, q_seg, k_seg, qoff, koff)
+        p = jnp.exp(s - lse)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * cfg.scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        row0 = (ig * n_q_blocks + iq) * cfg.block_q
+        rows = pl.ds(row0, cfg.block_q)
+        dq_acc[rows, :] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(inner == n_inner - 1)
+    def _finalize_kv():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+    @pl.when(jnp.logical_and(ik == n_kv - 1, inner == n_inner - 1))
+    def _finalize_q():
+        g = n_inner // n_q_blocks
+        tq = n_q_blocks * cfg.block_q
+        dq_ref[0, :, :, :] = (
+            dq_acc[:].reshape(g, tq, dq_ref.shape[-1]).astype(dq_ref.dtype)
+        )
+
+
 def _pad_len(n: int, block: int) -> int:
     return (-n) % block
 
 
-def _compiler_params(cfg: _FlashConfig):
+# VMEM budget for the fused backward's resident dq state (fp32 scratch +
+# the revisited output block), leaving room for the streamed q/k/v/do
+# blocks in a ~16 MB VMEM
+_FUSED_BWD_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _fused_bwd_fits(g: int, tq: int, d: int, out_itemsize: int) -> bool:
+    return g * tq * d * (4 + out_itemsize) <= _FUSED_BWD_VMEM_BUDGET
+
+
+def _compiler_params(cfg: _FlashConfig, *, seq_kv: bool = False):
     if cfg.interpret:
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
-    )
+    dims = ("parallel", "parallel",
+            "arbitrary" if seq_kv else "parallel", "arbitrary")
+    return pltpu.CompilerParams(dimension_semantics=dims)
 
 
 def _seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k):
@@ -408,6 +501,59 @@ def _bwd_call(cfg: _FlashConfig, q, k, v, do, lse, delta, offsets, q_seg, kv_seg
     lsep, deltap = col(lse, pad_q), col(delta, pad_q)
     segs = _seg_buffers(cfg, q_seg, kv_seg, pad_q, pad_k)
 
+    # grid: (b, hkv, kv-block, g·q-block) — q heads and q blocks share the
+    # inner sequential dim so dk/dv accumulate across both
+    q_gather = pl.BlockSpec(
+        (1, 1, cfg.block_q, d),
+        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
+    )
+    col_gather = pl.BlockSpec(
+        (1, 1, cfg.block_q, 1),
+        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
+    )
+    kv_self = pl.BlockSpec((1, 1, cfg.block_kv, d),
+                           lambda bi, hi, ki, t_: (bi, hi, ki, 0))
+    seg_specs_kv = _seg_specs(
+        cfg,
+        lambda bi, hi, ki, t_, n=n_q: (bi, t_ % n, 0),
+        lambda bi, hi, ki, t_: (bi, 0, ki),
+    )
+
+    if cfg.fused_bwd and _fused_bwd_fits(g, tq, d, q.dtype.itemsize):
+        # dq block (1, g, tq, d) at a fixed index per (b, hkv): stays
+        # resident across the whole kv×q sweep while the scratch
+        # accumulates, written once at the last step
+        dq_out = pl.BlockSpec(
+            (1, g, tq, d), lambda bi, hi, ki, t_: (bi, hi, 0, 0)
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, cfg=cfg, n_q_blocks=n_q),
+            grid=(b, hkv, n_kv, g * n_q),
+            in_specs=[
+                *offs_specs,
+                q_gather, kv_self, kv_self, q_gather, col_gather,
+                col_gather, *seg_specs_kv,
+            ],
+            out_specs=[dq_out, kv_self, kv_self],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+                jax.ShapeDtypeStruct((b, hkv, tk, d), k.dtype),
+                jax.ShapeDtypeStruct((b, hkv, tk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((g * tq, d), jnp.float32),
+                pltpu.VMEM((cfg.block_kv, d), jnp.float32),
+                pltpu.VMEM((cfg.block_kv, d), jnp.float32),
+            ],
+            compiler_params=_compiler_params(cfg, seq_kv=True),
+            interpret=cfg.interpret,
+        )(*offs_bufs, qp, kp, vp, dop, lsep, deltap, *segs)
+        dq = jnp.transpose(dq[:, :, :t], (0, 2, 1, 3))
+        dk = jnp.transpose(dk[:, :, :s], (0, 2, 1, 3))
+        dv = jnp.transpose(dv[:, :, :s], (0, 2, 1, 3))
+        return dq, dk, dv
+
+
     q_like = pl.BlockSpec((1, 1, cfg.block_q, d),
                           lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_like = pl.BlockSpec((1, 1, cfg.block_kv, d),
@@ -434,30 +580,13 @@ def _bwd_call(cfg: _FlashConfig, q, k, v, do, lse, delta, offsets, q_seg, kv_seg
         interpret=cfg.interpret,
     )(*offs_bufs, qp, kp, vp, dop, lsep, deltap, *segs)
 
-    # grid: (b, hkv, kv-block, g·q-block) — q heads and q blocks share the
-    # inner sequential dim so dk/dv accumulate across both
-    q_gather = pl.BlockSpec(
-        (1, 1, cfg.block_q, d),
-        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
-    )
-    col_gather = pl.BlockSpec(
-        (1, 1, cfg.block_q, 1),
-        lambda bi, hi, ki, t_, n=n_q, g=g: (bi, hi * g + t_ // n, t_ % n, 0),
-    )
-    kv_self = pl.BlockSpec((1, 1, cfg.block_kv, d),
-                           lambda bi, hi, ki, t_: (bi, hi, ki, 0))
-
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, cfg=cfg, n_q_blocks=n_q),
         grid=(b, hkv, n_kv, g * n_q),
         in_specs=[
             *offs_specs,
             q_gather, kv_self, kv_self, q_gather, col_gather, col_gather,
-            *_seg_specs(
-                cfg,
-                lambda bi, hi, ki, t_, n=n_q: (bi, t_ % n, 0),
-                lambda bi, hi, ki, t_: (bi, 0, ki),
-            ),
+            *seg_specs_kv,
         ],
         out_specs=[kv_self, kv_self],
         out_shape=[
@@ -573,6 +702,7 @@ def flash_attention_block(
     block_q: int = 1024,
     block_kv: int = 512,
     interpret: bool | None = None,
+    fused_bwd: bool | None = None,
 ) -> tuple[Array, Array]:
     """One flash-attention block at arbitrary global offsets → ``(o, lse)``.
 
@@ -594,6 +724,10 @@ def flash_attention_block(
     t, s, d = q.shape[1], k.shape[1], q.shape[-1]
     if (q_segments is None) != (kv_segments is None):
         raise ValueError("q_segments and kv_segments must be provided together")
+    if fused_bwd is None:
+        import os
+
+        fused_bwd = os.environ.get("D9D_TPU_FLASH_BWD", "split") == "fused"
     cfg = _FlashConfig(
         causal=causal,
         scale=softmax_scale if softmax_scale is not None else d**-0.5,
@@ -606,6 +740,7 @@ def flash_attention_block(
         interpret=(jax.default_backend() != "tpu"
                    if interpret is None else interpret),
         has_positions=True,
+        fused_bwd=fused_bwd,
     )
     offsets = jnp.stack(
         [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
@@ -613,7 +748,11 @@ def flash_attention_block(
     return _flash_ol(cfg, q, k, v, offsets, q_segments, kv_segments)
 
 
-def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
+def make_pallas_flash_sdpa(
+    block_q: int = 1024,
+    block_kv: int = 512,
+    fused_bwd: bool | None = None,
+):
     """Build an SdpaBackend backed by the Pallas flash kernel.
 
     Default block sizes follow the r3 on-chip sweep (tools/bench_kernels.py,
@@ -621,7 +760,17 @@ def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
     d=64, t=4096 d=128) over 512x512 and the smaller tilings; blocks are
     clamped to the padded sequence length below, so small inputs are
     unaffected.
+
+    ``fused_bwd`` selects the one-pass backward (dq+dk+dv from a single
+    logit recompute, ~20% fewer backward matmul FLOPs at the cost of a
+    resident dq VMEM state — see :func:`_bwd_fused_kernel`). ``None``
+    reads ``D9D_TPU_FLASH_BWD`` (``fused``/``split``); default split, the
+    r3-measured configuration, until the fused variant is swept on chip.
     """
+    if fused_bwd is None:
+        import os
+
+        fused_bwd = os.environ.get("D9D_TPU_FLASH_BWD", "split") == "fused"
 
     def sdpa(
         q: Array,
@@ -660,6 +809,7 @@ def make_pallas_flash_sdpa(block_q: int = 1024, block_kv: int = 512):
             block_kv=_clamp_block(block_kv, t),
             seq_len=t,
             interpret=jax.default_backend() != "tpu",
+            fused_bwd=fused_bwd,
         )
         sinks_arr = (
             sinks if sinks is not None else jnp.zeros((q.shape[2],), jnp.float32)

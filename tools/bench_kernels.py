@@ -85,6 +85,10 @@ def bench_sdpa(tiny):
         from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
 
         providers["pallas_flash"] = make_pallas_flash_sdpa()
+        # r4: one-pass backward (dq+dk+dv from a single logit recompute)
+        providers["pallas_flash_fused_bwd"] = make_pallas_flash_sdpa(
+            fused_bwd=True
+        )
         # block-size sweep around the adopted 1024x512 default (r3); the
         # biggest tilings stay within VMEM: fp32 scores 2048x1024 = 8 MB
         for bq, bkv in ((512, 512), (256, 512), (512, 256), (1024, 512),
@@ -100,6 +104,22 @@ def bench_sdpa(tiny):
         v = jax.random.normal(kv, (b, t, hkv, d), jnp.bfloat16)
         cfg = f"b{b}_t{t}_h{hq}:{hkv}_d{d}"
         for name, sdpa in providers.items():
+            if name == "pallas_flash_fused_bwd":
+                # the fused backward silently falls back to the split
+                # kernels when its dq VMEM state doesn't fit — mark the
+                # row instead of recording a meaningless duplicate
+                from d9d_tpu.ops.attention.pallas_flash import (
+                    _fused_bwd_fits,
+                )
+
+                if not _fused_bwd_fits(hq // hkv, t, d, 2):
+                    print(json.dumps(
+                        {"bench": "sdpa_fwd_bwd", "provider": name,
+                         "config": cfg,
+                         "error": "fused dq state exceeds VMEM budget; "
+                                  "would run the split kernels"}
+                    ), flush=True)
+                    continue
             fwd = jax.jit(lambda q, k, v, f=sdpa: f(q, k, v, causal=True))
             emit_timed("sdpa_fwd", name, cfg, fwd, q, k, v)
 
