@@ -3,11 +3,16 @@
 Reference: d9d/loop/component/data_loader_factory.py:102 — torchdata's
 worker-backed ``StatefulDataLoader`` keeps batch N+1's host work off the
 step path. TPU equivalent (VERDICT r3 item 4): a producer thread runs the
-whole host input pipeline — raw fetch from the loader, task
-``prepare_batch`` (numpy), and device staging (``device_put`` is
-thread-safe and async) — ``depth`` batches ahead of the consuming train
-loop, so step N's compute overlaps step N+1's input processing and
-host→device copy.
+host input pipeline — raw fetch from the loader and task
+``prepare_batch`` (numpy), plus device staging whenever that is
+collective-free — ``depth`` batches ahead of the consuming train loop,
+so step N's compute overlaps step N+1's input processing. Single-process
+runs stage in the producer too (async ``device_put``); multi-process
+runs MUST stage on the consumer thread via ``finish_fn`` — ``device_put``
+onto a multi-process sharding performs a cross-process consistency
+collective, and producer-thread collectives interleave differently per
+process against the main thread's step collectives (observed deadlock on
+the 2-process rig).
 
 Exact resume stays exact: the producer snapshots the loader's *position*
 right after each fetch (the loader advances before yielding, so the
@@ -47,11 +52,22 @@ class BatchPrefetcher:
         *,
         depth: int = 2,
         position_fn: Callable[[], Any] | None = None,
+        finish_fn: Callable[[PyTree], PyTree] | None = None,
     ):
+        """``stage_fn`` runs in the producer thread; ``finish_fn`` (if
+        given) runs on the CONSUMER thread at ``__next__``. Multi-process
+        trainers must keep ``device_put`` onto multi-process shardings in
+        ``finish_fn``: jax turns it into a cross-process consistency
+        collective (``multihost_utils.assert_equal``), and collectives
+        issued from a producer thread interleave differently per process
+        against the main thread's step collectives — a deadlock observed
+        on the 2-process e2e rig. Host-only work (tokenize/pack/reshape)
+        stays safely in the producer."""
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
         self._iter = data_iter
         self._stage_fn = stage_fn
+        self._finish_fn = finish_fn
         self._position_fn = position_fn
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -101,6 +117,11 @@ class BatchPrefetcher:
         kind, payload, pos = item
         if kind == "error":
             raise payload
+        if self._finish_fn is not None:
+            payload = self._finish_fn(payload)
+        # only after the batch is fully materialized for the consumer —
+        # a finish_fn failure must not mark the batch consumed (exact
+        # resume would skip it)
         self.consumed_position = pos
         return payload
 

@@ -313,14 +313,24 @@ class Trainer:
                 )
                 use_prefetch = False
             if use_prefetch:
-                # producer thread runs fetch + prepare + device staging
-                # prefetch_batches ahead; must start AFTER _try_resume so
-                # it iterates from the restored loader position
+                # producer thread runs fetch + prepare (+ device staging
+                # when that is collective-free) prefetch_batches ahead;
+                # must start AFTER _try_resume so it iterates from the
+                # restored loader position. Multi-process non-PP staging
+                # device_puts onto multi-process shardings — a hidden
+                # collective — so it moves to the consumer thread
+                # (finish_fn); PP staging is host-only and stays in the
+                # producer either way.
+                if self.pp_engine is None and jax.process_count() > 1:
+                    produce, finish = self.task.prepare_batch, self._stage
+                else:
+                    produce, finish = self._stage_batch, None
                 self._prefetcher = BatchPrefetcher(
                     data_iter,
-                    self._stage_batch,
+                    produce,
                     depth=self.config.prefetch_batches,
                     position_fn=getattr(self.data_loader, "position", None),
+                    finish_fn=finish,
                 )
             with self.timeout, self.gc:
                 while not self.stepper.finished:

@@ -23,6 +23,9 @@ class SdpaPallasFlashConfig(pydantic.BaseModel):
     type: Literal["pallas_flash"] = "pallas_flash"
     block_q: int = 1024
     block_kv: int = 512
+    # one-pass backward (see ops/attention/pallas_flash._bwd_fused_kernel);
+    # None = env D9D_TPU_FLASH_BWD ("fused"/"split"), default split
+    fused_bwd: bool | None = None
 
 
 class SdpaRingConfig(pydantic.BaseModel):

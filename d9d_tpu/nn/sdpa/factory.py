@@ -51,7 +51,8 @@ def build_sdpa_backend(config: SdpaBackendConfig | None = None) -> SdpaBackend:
         from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
 
         return make_pallas_flash_sdpa(
-            block_q=config.block_q, block_kv=config.block_kv
+            block_q=config.block_q, block_kv=config.block_kv,
+            fused_bwd=config.fused_bwd,
         )
     if isinstance(config, SdpaRingConfig):
         from d9d_tpu.core.mesh import resolve_ambient_mesh

@@ -359,6 +359,25 @@ def _fused_bwd_fits(g: int, tq: int, d: int, out_itemsize: int) -> bool:
     return g * tq * d * (4 + out_itemsize) <= _FUSED_BWD_VMEM_BUDGET
 
 
+def fused_bwd_applies(
+    *, t: int, num_heads: int, num_kv_heads: int, head_dim: int,
+    itemsize: int, block_q: int = 1024,
+) -> bool:
+    """Would ``fused_bwd=True`` actually take the one-pass kernel for this
+    shape? The SAME predicate _bwd_call gates on (padded sequence, real
+    itemsize) — benches use it to mark rows where the silent fallback to
+    the split kernels would otherwise fake an A/B datapoint."""
+    block = _clamp_block(block_q, t)
+    tq = t + _pad_len(t, block)
+    return _fused_bwd_fits(num_heads // num_kv_heads, tq, head_dim, itemsize)
+
+
+def _env_fused_bwd() -> bool:
+    import os
+
+    return os.environ.get("D9D_TPU_FLASH_BWD", "split") == "fused"
+
+
 def _compiler_params(cfg: _FlashConfig, *, seq_kv: bool = False):
     if cfg.interpret:
         return None
@@ -725,9 +744,7 @@ def flash_attention_block(
     if (q_segments is None) != (kv_segments is None):
         raise ValueError("q_segments and kv_segments must be provided together")
     if fused_bwd is None:
-        import os
-
-        fused_bwd = os.environ.get("D9D_TPU_FLASH_BWD", "split") == "fused"
+        fused_bwd = _env_fused_bwd()
     cfg = _FlashConfig(
         causal=causal,
         scale=softmax_scale if softmax_scale is not None else d**-0.5,
@@ -768,9 +785,7 @@ def make_pallas_flash_sdpa(
     r3-measured configuration, until the fused variant is swept on chip.
     """
     if fused_bwd is None:
-        import os
-
-        fused_bwd = os.environ.get("D9D_TPU_FLASH_BWD", "split") == "fused"
+        fused_bwd = _env_fused_bwd()
 
     def sdpa(
         q: Array,

@@ -112,3 +112,26 @@ def test_close_unblocks_full_queue():
     next(pf)
     pf.close()
     assert not pf._thread.is_alive()
+
+
+def test_finish_fn_runs_on_consumer_thread():
+    """Multi-process trainers stage on the consumer side (device_put onto
+    multi-process shardings is a hidden collective — deadlocks when issued
+    from the producer thread against main-thread step collectives)."""
+    import threading
+
+    consumer = threading.get_ident()
+    producer_threads = []
+    finish_threads = []
+
+    pf = BatchPrefetcher(
+        iter(_loader()),
+        lambda b: (producer_threads.append(threading.get_ident()), b)[1],
+        depth=2,
+        finish_fn=lambda b: (finish_threads.append(threading.get_ident()), b)[1],
+    )
+    next(pf)
+    next(pf)
+    pf.close()
+    assert all(t != consumer for t in producer_threads)
+    assert all(t == consumer for t in finish_threads)

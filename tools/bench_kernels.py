@@ -109,10 +109,13 @@ def bench_sdpa(tiny):
                 # kernels when its dq VMEM state doesn't fit — mark the
                 # row instead of recording a meaningless duplicate
                 from d9d_tpu.ops.attention.pallas_flash import (
-                    _fused_bwd_fits,
+                    fused_bwd_applies,
                 )
 
-                if not _fused_bwd_fits(hq // hkv, t, d, 2):
+                if not fused_bwd_applies(
+                    t=t, num_heads=hq, num_kv_heads=hkv, head_dim=d,
+                    itemsize=q.dtype.itemsize,
+                ):
                     print(json.dumps(
                         {"bench": "sdpa_fwd_bwd", "provider": name,
                          "config": cfg,

@@ -33,6 +33,24 @@ print(json.dumps(r))
 EOF
 done
 
+echo "== dense A/B: fused QKV off (default run above has it on)"
+D9D_BENCH_FUSED_QKV=0 python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+import json
+import bench
+r = bench.run_bench()
+r["detail"]["variant"] = "fused_qkv_off"
+print(json.dumps(r))
+EOF
+
+echo "== dense A/B: fused one-pass flash backward"
+D9D_TPU_FLASH_BWD=fused python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+import json
+import bench
+r = bench.run_bench()
+r["detail"]["variant"] = "flash_bwd_fused"
+print(json.dumps(r))
+EOF
+
 echo "== MoE sweep: save_expensive remat at ub1; ub2 bf16-params variant"
 D9D_BENCH_REMAT_POLICY=save_expensive python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
 import json, os
@@ -61,5 +79,12 @@ python tools/bench_kernels.py | tee bench_results/kernels.jsonl
 
 echo "== pipeline schedule microbench"
 python tools/bench_pp.py | tee bench_results/pp.jsonl
+
+echo "== schedule-economics makespan sim (device-free, for the record)"
+: > bench_results/makespan.jsonl
+for args in "--pp 4 --microbatches 8" "--pp 4 --microbatches 16" \
+            "--pp 8 --microbatches 8"; do
+  python tools/pp_makespan.py $args | tee -a bench_results/makespan.jsonl
+done
 
 echo "done — see bench_results/"
