@@ -88,6 +88,9 @@ class ModelConfig(pydantic.BaseModel):
     # hybrid GDN:attention stacks (Qwen3-Next style) — e.g. [0, 1, 2] puts
     # linear attention on those layers; [] keeps pure attention
     linear_attention_layers: list[int] = []
+    # q/k/v as one matmul (r4 single-chip MFU lever; must stay off when
+    # the mesh has tp>1 — the model raises if violated)
+    fused_qkv: bool = False
 
 
 class DataConfig(pydantic.BaseModel):
@@ -210,6 +213,7 @@ class MoEProvider(ModelProvider):
                 num_experts=c.num_experts,
                 num_experts_per_tok=c.num_experts_per_tok,
                 remat=c.remat,
+                fused_qkv=c.fused_qkv,
                 linear_attention_layers=tuple(c.linear_attention_layers),
                 ep_axes=self.ctx.ep_shard_axes,
                 # ride the residual layout through the EP dispatch (no
