@@ -37,3 +37,18 @@ def _fixed_seed():
     random.seed(0)
     np.random.seed(0)
     yield
+
+
+def load_repo_module(name, relpath):
+    """Load a repo-root script (bench.py, tools/*.py) by path — shared by
+    the harness tests so the spec/exec boilerplate lives once."""
+    import importlib.util
+    import pathlib
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(name, root / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod

@@ -15,12 +15,9 @@ import sys
 
 
 def _load_bench():
-    root = pathlib.Path(__file__).resolve().parent.parent
-    spec = importlib.util.spec_from_file_location("bench", root / "bench.py")
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules["bench"] = mod
-    spec.loader.exec_module(mod)
-    return mod
+    from tests.conftest import load_repo_module
+
+    return load_repo_module("bench", "bench.py")
 
 
 def test_bench_tiny_runs(devices):

@@ -31,3 +31,4 @@ def test_trace_summary_runs(tmp_path, devices):
     assert out.returncode == 0, out.stderr[-1500:]
     assert "total timed op time" in out.stdout
     assert "category" in out.stdout
+

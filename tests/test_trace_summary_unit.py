@@ -1,0 +1,34 @@
+"""Quick-tier unit coverage for the trace_summary attribution helpers
+(no jax, no subprocess — pure parsing)."""
+
+
+def test_trace_summary_attribution_helpers():
+    """summarize_host_regions collapses stage/microbatch suffixes;
+    scope_of finds this repo's named-scope paths through JAX's jit()
+    prefixes (r4 trace-attribution tables)."""
+    from tests.conftest import load_repo_module
+
+    ts = load_repo_module("trace_summary", "tools/trace_summary.py")
+
+    events = [
+        {"name": "pp.bwd.s0.mb3", "dur": 100},
+        {"name": "pp.bwd.s1.mb0", "dur": 50},
+        {"name": "pp.fwd.s1.mb2", "dur": 10},
+        {"name": "pp_opt.update", "dur": 7},
+        {"name": "loop.batch_staging", "dur": 5},
+        {"name": "unrelated", "dur": 99},
+        {"name": "pp.bwd.s0.mb1", "dur": 0},  # zero-dur dropped
+    ]
+    regions = ts.summarize_host_regions(events)
+    assert regions["pp.bwd"] == (150, 2)
+    assert regions["pp.fwd"] == (10, 1)
+    assert regions["pp_opt.update"] == (7, 1)
+    assert regions["loop.batch_staging"] == (5, 1)
+    assert "unrelated" not in regions
+
+    assert ts.scope_of({"name": "jit(wrapped)/pp_s0/fwd/dot_general"}) == "pp_s0/fwd"
+    assert ts.scope_of(
+        {"name": "fusion.3", "args": {"long_name": "jit(f)/ep/dispatch_a2a/x"}}
+    ) == "ep/dispatch_a2a"
+    assert ts.scope_of({"name": "jit(step)/train/optimizer/add"}) == "train/optimizer"
+    assert ts.scope_of({"name": "copy.1"}) is None
