@@ -213,10 +213,16 @@ def test_pp_checkpoint_resume_bitwise(devices, tmp_path):
     )
 
 
-def test_pp_lora_trains_adapters_only(devices):
+@pytest.mark.parametrize("pipeline", [
+    None,
+    {"kind": "zero_bubble_1p", "residual_policy": "cache_acts"},
+], ids=["default", "zb1p-cache_acts"])
+def test_pp_lora_trains_adapters_only(devices, pipeline):
     """PEFT × PP (VERDICT r2 item 8): pp=2 LoRA training leaves every
     stage's base params bit-identical, trains only adapters, and
-    merged_params folds the delta in."""
+    merged_params folds the delta in — under the default schedule AND the
+    r4 cache_acts split (base params ride the recorded VJP's residual
+    consts; adapters are the differentiated leaves)."""
     from d9d_tpu.peft import LoRA
 
     ctx = MeshParameters(pp=2, dp_shard=2).build(devices[:4])
@@ -229,6 +235,7 @@ def test_pp_lora_trains_adapters_only(devices):
             total_steps=STEPS,
             log_every=1,
             learning_rate=1e-2,
+            pipeline=pipeline,
         ),
         model_provider=Provider(fsdp=True),
         dataset_provider=Data(),
