@@ -606,6 +606,42 @@ def run_bench_input_pipeline(*, tiny: bool = False) -> dict:
     }
 
 
+# rows finished before a watchdog fire; the watchdog folds them into its
+# error line so a wedge mid-MoE still delivers the dense number
+_partial_results: dict = {}
+
+
+def _arm_watchdog(seconds: float):
+    """Hard wall-clock limit on the whole bench run.
+
+    require_backend only covers ``jax.devices()`` hanging; round 4 hit the
+    other wedge — the backend comes up, the first compiled step is
+    dispatched, and the tunnel never delivers the result (the host fetch
+    polls forever; 48 min observed with zero tunnel traffic). A bench that
+    hangs is worse for the driver than a bench that reports the outage, so
+    a daemon thread prints an honest JSON error line (carrying any rows
+    that DID finish) and exits 4 when the budget runs out. Disable with
+    D9D_BENCH_WATCHDOG_S=0.
+    """
+    import os
+    import threading
+
+    def fire():
+        out = {
+            "error": f"bench watchdog: no result within {seconds:.0f}s "
+                     "(tunnel wedged mid-step?)",
+        }
+        if _partial_results:
+            out["partial"] = _partial_results
+        print(json.dumps(out), flush=True)
+        os._exit(4)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     import os
     import sys
@@ -615,10 +651,22 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tools.benchtime import require_backend
 
+    tiny = "--tiny" in sys.argv[1:]
+    watchdog_s = float(os.environ.get("D9D_BENCH_WATCHDOG_S", "2700"))
+    if watchdog_s > 0:
+        _arm_watchdog(watchdog_s)
     require_backend("bench")
+    if tiny:
+        # liveness ladder rung: a 2-layer model, 3 steps — proves
+        # compile+execute round-trips through the tunnel before the full
+        # legs commit their multi-minute compiles to it
+        out = run_bench(tiny=True)
+        print(json.dumps(out))
+        return
     dense = run_bench()
     out = dict(dense)
     out["detail"] = dict(dense["detail"])
+    _partial_results["dense"] = dense
     # The dense headline must survive an MoE failure (an OOM here ate the
     # whole round-3 capture once) — record the error instead of dying.
     try:
@@ -633,6 +681,7 @@ def main():
             "vs_baseline": moe["vs_baseline"],
             **moe["detail"],
         }
+        _partial_results["moe"] = out["detail"]["moe"]
     # BASELINE config 5: the hybrid (Qwen3-Next/GDN) family's first row
     try:
         hyb = run_bench_moe(hybrid=True)

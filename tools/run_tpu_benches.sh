@@ -4,27 +4,97 @@
 # time) and tees results into bench_results/. Fill BASELINE.md from these.
 # Designed to be resumable: each leg appends to its own file, so re-running
 # after a tunnel drop only repeats the unfinished leg (comment out done legs).
+#
+# Round-4 hardening: the tunnel wedged mid-leg (backend up, first step's
+# result never delivered — 48 min of nothing), so every leg now runs under
+# a hard `timeout` and the script opens with a liveness ladder
+# (probe → tiny bench) before committing the window to the full legs.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # tools/*.py import d9d_tpu; sys.path[0] is tools/, so the repo root must
 # be on PYTHONPATH explicitly
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 mkdir -p bench_results
+
+# per-leg wall-clock budgets (seconds); a wedged leg is killed and the
+# script moves on so one bad leg can't eat the whole tunnel window
+LEG_TIMEOUT="${D9D_BENCH_LEG_TIMEOUT:-2400}"
+# bench.py's in-process watchdog must fire BEFORE the shell timeout kills
+# the leg, or the partial-results JSON (e.g. a finished dense row when the
+# MoE stage wedges) is lost to a bare SIGKILL; floor it so a short
+# operator-set LEG_TIMEOUT can't silently disable it (bench treats <=0 as
+# off)
+_wd=$((LEG_TIMEOUT - 300)); [[ $_wd -lt 120 ]] && _wd=$((LEG_TIMEOUT * 3 / 4))
+export D9D_BENCH_WATCHDOG_S="${D9D_BENCH_WATCHDOG_S:-$_wd}"
+# one definition of "tunnel alive" shared with tools/tunnel_watch.sh
+PROBE_TIMEOUT="${D9D_PROBE_TIMEOUT:-120}"
+run_leg() {  # run_leg <name> <outfile> <cmd...>
+  local name="$1" outfile="$2"; shift 2
+  echo "== $name"
+  timeout -k 30 "$LEG_TIMEOUT" "$@" | tee -a "$outfile"
+  local rc=${PIPESTATUS[0]}
+  if [[ $rc -ne 0 ]]; then
+    echo "{\"leg\": \"$name\", \"error\": \"rc=$rc (124=timeout)\"}" \
+      | tee -a bench_results/failures.jsonl
+  fi
+  return 0
+}
+
 # fresh files per invocation so stale rows can't mix into BASELINE.md;
 # when resuming after a tunnel drop (commented-out finished legs), set
 # D9D_BENCH_RESUME=1 to keep the already-captured rows
 if [[ "${D9D_BENCH_RESUME:-0}" != "1" ]]; then
   : > bench_results/bench.jsonl
   : > bench_results/bench_sweep.jsonl
+  : > bench_results/failures.jsonl
+  : > bench_results/kernels.jsonl
+  : > bench_results/pp.jsonl
 fi
 
-echo "== bench.py default (dense full-remat + MoE ub1): the headline row"
-python bench.py | tee -a bench_results/bench.jsonl
+echo "== liveness ladder: probe"
+if ! timeout $((PROBE_TIMEOUT + 20)) python tools/tpu_probe.py \
+    --timeout "$PROBE_TIMEOUT"; then
+  echo "tunnel dead at probe; aborting (exit 3)"; exit 3
+fi
+echo "== liveness ladder: tiny bench (2-layer, 3 steps)"
+# tiny gets its own, shorter watchdog so it still fires inside the 900s
+# shell budget
+if ! timeout -k 30 900 env D9D_BENCH_WATCHDOG_S=600 \
+    python bench.py --tiny > bench_results/tiny.json; then
+  echo "tiny bench failed/wedged; aborting before the big legs (exit 4)"
+  cat bench_results/tiny.json 2>/dev/null
+  exit 4
+fi
+cat bench_results/tiny.json
+
+# leg order = value-per-tunnel-minute: the default leg carries the whole
+# BENCH_r04 headline (dense+MoE+hybrid in one process), then the MoE
+# north-star sweep (round 4's #1 item), then dense sweeps/ABs
+run_leg "bench.py default (dense full-remat + MoE ub1 + hybrid)" \
+  bench_results/bench.jsonl python bench.py
+
+D9D_BENCH_REMAT_POLICY=save_expensive run_leg "MoE save_expensive ub1" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["remat_policy"] = "save_expensive"
+print(json.dumps(r))
+EOF
+
+D9D_BENCH_MOE_UB=2 run_leg "MoE ub2 bf16-params stochastic adamw" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub2_bf16_params_stochastic_adamw"
+print(json.dumps(r))
+EOF
 
 echo "== dense remat-policy sweep"
 for pol in dots_no_batch save_expensive; do
-  echo "-- remat_policy=$pol"
-  D9D_BENCH_REMAT_POLICY=$pol python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+  D9D_BENCH_REMAT_POLICY=$pol run_leg "dense remat_policy=$pol" \
+    bench_results/bench_sweep.jsonl python - <<'EOF'
 import json, os
 import bench
 r = bench.run_bench()
@@ -33,8 +103,8 @@ print(json.dumps(r))
 EOF
 done
 
-echo "== dense A/B: fused QKV off (default run above has it on)"
-D9D_BENCH_FUSED_QKV=0 python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+D9D_BENCH_FUSED_QKV=0 run_leg "dense A/B: fused QKV off" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
 import json
 import bench
 r = bench.run_bench()
@@ -42,8 +112,8 @@ r["detail"]["variant"] = "fused_qkv_off"
 print(json.dumps(r))
 EOF
 
-echo "== dense A/B: fused one-pass flash backward"
-D9D_TPU_FLASH_BWD=fused python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
+D9D_TPU_FLASH_BWD=fused run_leg "dense A/B: fused one-pass flash backward" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
 import json
 import bench
 r = bench.run_bench()
@@ -51,34 +121,22 @@ r["detail"]["variant"] = "flash_bwd_fused"
 print(json.dumps(r))
 EOF
 
-echo "== MoE sweep: save_expensive remat at ub1; ub2 bf16-params variant"
-D9D_BENCH_REMAT_POLICY=save_expensive python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
-import json, os
-import bench
-r = bench.run_bench_moe()
-r["detail"]["remat_policy"] = "save_expensive"
-print(json.dumps(r))
-EOF
-D9D_BENCH_MOE_UB=2 python - <<'EOF' | tee -a bench_results/bench_sweep.jsonl
-import json
-import bench
-r = bench.run_bench_moe()
-r["detail"]["variant"] = "ub2_bf16_params_stochastic_adamw"
-print(json.dumps(r))
-EOF
-
-echo "== input-pipeline overlap (synthetic vs sync vs prefetch)"
-python - <<'PYEOF' | tee -a bench_results/bench_sweep.jsonl
+run_leg "input-pipeline overlap (synthetic vs sync vs prefetch)" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
 import json
 import bench
 print(json.dumps(bench.run_bench_input_pipeline()))
 PYEOF
 
-echo "== kernel latency harness"
-python tools/bench_kernels.py | tee bench_results/kernels.jsonl
+# single-run files: truncate unconditionally (resume mode re-running these
+# legs should overwrite, matching the pre-run_leg `tee` semantics)
+: > bench_results/kernels.jsonl
+run_leg "kernel latency harness" bench_results/kernels.jsonl \
+  python tools/bench_kernels.py
 
-echo "== pipeline schedule microbench"
-python tools/bench_pp.py | tee bench_results/pp.jsonl
+: > bench_results/pp.jsonl
+run_leg "pipeline schedule microbench" bench_results/pp.jsonl \
+  python tools/bench_pp.py
 
 echo "== schedule-economics makespan sim (device-free, for the record)"
 : > bench_results/makespan.jsonl

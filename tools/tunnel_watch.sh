@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Poll the axon tunnel; at the first healthy probe, run the bench queue;
+# exit once the queue gets past its liveness ladder (rc != 3/4), else keep
+# polling for the next window. Detach with:
+#   nohup bash tools/tunnel_watch.sh > bench_results/watch.log 2>&1 &
+# The tunnel dies and recovers on its own schedule (r3: one 90-min window
+# all round; r4 session 1: none; session 2: ~1 min), so an unattended
+# watcher is the only way not to waste a window that opens mid-task.
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+mkdir -p bench_results
+interval="${1:-300}"
+# same "tunnel alive" definition as run_tpu_benches.sh's opening ladder
+PROBE_TIMEOUT="${D9D_PROBE_TIMEOUT:-120}"
+while true; do
+  ts="$(date -Is)"
+  if out="$(timeout $((PROBE_TIMEOUT + 20)) python tools/tpu_probe.py \
+      --timeout "$PROBE_TIMEOUT" 2>/dev/null)"; then
+    echo "{\"ts\": \"$ts\", \"probe\": $out}" >> bench_results/probe_log.jsonl
+    echo "{\"ts\": \"$ts\", \"event\": \"alive -> bench queue\"}" \
+      >> bench_results/probe_log.jsonl
+    bash tools/run_tpu_benches.sh >> bench_results/run.log 2>&1
+    rc=$?
+    echo "{\"ts\": \"$(date -Is)\", \"event\": \"bench queue done\", \"rc\": $rc}" \
+      >> bench_results/probe_log.jsonl
+    # rc 3/4 = the window closed before the ladder cleared (tunnel windows
+    # can be ~1 min) — keep polling for the next one instead of giving up
+    if [[ $rc -ne 3 && $rc -ne 4 ]]; then
+      exit $rc
+    fi
+  fi
+  echo "{\"ts\": \"$ts\", \"probe\": {\"alive\": false}}" \
+    >> bench_results/probe_log.jsonl
+  sleep "$interval"
+done
