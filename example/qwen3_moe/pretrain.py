@@ -53,6 +53,7 @@ from d9d_tpu.loop.auto import (
 )
 from d9d_tpu.loop.control.providers import OptimizerProvider
 from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+from d9d_tpu.nn.moe import SharedExpertParameters
 from d9d_tpu.nn.sdpa import build_sdpa_backend
 from d9d_tpu.parallel import fsdp_ep_plan
 from d9d_tpu.tracker import build_tracker
@@ -91,6 +92,20 @@ class ModelConfig(pydantic.BaseModel):
     # q/k/v as one matmul (r4 single-chip MFU lever; must stay off when
     # the mesh has tp>1 — the model raises if violated)
     fused_qkv: bool = False
+    # Qwen3-Next-style attention/norm features (example/qwen3_next uses
+    # these; defaults match the plain Qwen3-MoE family)
+    use_output_gate: bool = False
+    rope_fraction: float = 1.0
+    zero_centered_norms: bool = False
+    # GDN geometry; 0 = derive from the attention dims
+    gdn_qk_heads: int = 0
+    gdn_v_heads: int = 0
+    gdn_head_qk_dim: int = 0
+    gdn_head_v_dim: int = 0
+    gdn_conv_size: int = 4
+    # always-on gated shared expert (0 = none)
+    shared_expert_intermediate_size: int = 0
+    shared_expert_gate: bool = True
 
 
 class DataConfig(pydantic.BaseModel):
@@ -215,6 +230,20 @@ class MoEProvider(ModelProvider):
                 remat=c.remat,
                 fused_qkv=c.fused_qkv,
                 linear_attention_layers=tuple(c.linear_attention_layers),
+                use_output_gate=c.use_output_gate,
+                rope_fraction=c.rope_fraction,
+                zero_centered_norms=c.zero_centered_norms,
+                gdn_qk_heads=c.gdn_qk_heads,
+                gdn_v_heads=c.gdn_v_heads,
+                gdn_head_qk_dim=c.gdn_head_qk_dim,
+                gdn_head_v_dim=c.gdn_head_v_dim,
+                gdn_conv_size=c.gdn_conv_size,
+                shared_expert=SharedExpertParameters(
+                    intermediate_size=c.shared_expert_intermediate_size,
+                    enable_gate=c.shared_expert_gate,
+                )
+                if c.shared_expert_intermediate_size > 0
+                else None,
                 ep_axes=self.ctx.ep_shard_axes,
                 # ride the residual layout through the EP dispatch (no
                 # boundary reshard; see MoELayer.token_axes)

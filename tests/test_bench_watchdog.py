@@ -1,0 +1,70 @@
+"""bench.py's wall-clock watchdog (round-4 tunnel-wedge hardening).
+
+Quick tier: the watchdog path never touches a jax backend — it exists
+precisely for the case where the backend accepted a program and went
+silent, so it must work (and be tested) without one.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_child(code: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={"PYTHONPATH": str(ROOT), "PATH": "/usr/bin:/bin"},
+    )
+
+
+def test_watchdog_fires_with_partial_results():
+    """A wedge after the dense row finished must still deliver that row:
+    exit 4 with one JSON line carrying error + partial."""
+    child = _run_child(
+        "import sys, time\n"
+        f"sys.path.insert(0, {str(ROOT)!r})\n"
+        "import bench\n"
+        "bench._partial_results['dense'] = {'value': 123.0}\n"
+        "bench._arm_watchdog(0.5)\n"
+        "time.sleep(30)\n"
+    )
+    assert child.returncode == 4, (child.returncode, child.stderr[-500:])
+    out = json.loads(child.stdout.strip())
+    assert "watchdog" in out["error"]
+    assert out["partial"]["dense"]["value"] == 123.0
+
+
+def test_watchdog_fires_empty():
+    """No rows finished: the error line must not carry a partial key
+    (the driver should see an unambiguous no-data outage record)."""
+    child = _run_child(
+        "import sys, time\n"
+        f"sys.path.insert(0, {str(ROOT)!r})\n"
+        "import bench\n"
+        "bench._arm_watchdog(0.5)\n"
+        "time.sleep(30)\n"
+    )
+    assert child.returncode == 4
+    out = json.loads(child.stdout.strip())
+    assert "watchdog" in out["error"]
+    assert "partial" not in out
+
+
+def test_watchdog_cancellable():
+    """A finished bench must be able to outlive its armed watchdog: the
+    timer is a daemon and cancel() prevents the exit-4 path."""
+    child = _run_child(
+        "import sys, time\n"
+        f"sys.path.insert(0, {str(ROOT)!r})\n"
+        "import bench\n"
+        "t = bench._arm_watchdog(0.5)\n"
+        "t.cancel()\n"
+        "time.sleep(1.0)\n"
+        "print('survived')\n"
+    )
+    assert child.returncode == 0, child.stderr[-500:]
+    assert "survived" in child.stdout

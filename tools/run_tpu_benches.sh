@@ -21,10 +21,21 @@ mkdir -p bench_results
 LEG_TIMEOUT="${D9D_BENCH_LEG_TIMEOUT:-2400}"
 # bench.py's in-process watchdog must fire BEFORE the shell timeout kills
 # the leg, or the partial-results JSON (e.g. a finished dense row when the
-# MoE stage wedges) is lost to a bare SIGKILL; floor it so a short
-# operator-set LEG_TIMEOUT can't silently disable it (bench treats <=0 as
-# off)
+# MoE stage wedges) is lost to a bare SIGKILL. Enforce the ordering even
+# against explicit overrides, and refuse the no-limit combination (a
+# wedge would then hang forever — the round-4 failure this file exists to
+# contain).
+if [[ "$LEG_TIMEOUT" -le 0 ]]; then
+  echo "D9D_BENCH_LEG_TIMEOUT must be positive (a wedged leg would hang forever)" >&2
+  exit 2
+fi
 _wd=$((LEG_TIMEOUT - 300)); [[ $_wd -lt 120 ]] && _wd=$((LEG_TIMEOUT * 3 / 4))
+if [[ -n "${D9D_BENCH_WATCHDOG_S:-}" ]] \
+    && [[ "${D9D_BENCH_WATCHDOG_S%.*}" -ge "$LEG_TIMEOUT" ]]; then
+  echo "D9D_BENCH_WATCHDOG_S=${D9D_BENCH_WATCHDOG_S} >= leg timeout" \
+       "${LEG_TIMEOUT}s; lowering to ${_wd}s so the watchdog fires first" >&2
+  D9D_BENCH_WATCHDOG_S=""
+fi
 export D9D_BENCH_WATCHDOG_S="${D9D_BENCH_WATCHDOG_S:-$_wd}"
 # one definition of "tunnel alive" shared with tools/tunnel_watch.sh
 PROBE_TIMEOUT="${D9D_PROBE_TIMEOUT:-120}"

@@ -29,8 +29,9 @@ while true; do
     if [[ $rc -ne 3 && $rc -ne 4 ]]; then
       exit $rc
     fi
+  else
+    echo "{\"ts\": \"$ts\", \"probe\": {\"alive\": false}}" \
+      >> bench_results/probe_log.jsonl
   fi
-  echo "{\"ts\": \"$ts\", \"probe\": {\"alive\": false}}" \
-    >> bench_results/probe_log.jsonl
   sleep "$interval"
 done
