@@ -1,0 +1,41 @@
+"""tools/roofline.py: the analytic attribution must stay runnable and
+keep telling the story BASELINE.md cites (quick tier — pure numpy-free
+arithmetic, no jax backend)."""
+import json
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _rows():
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "roofline.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    return {r["scenario"]: r for r in map(json.loads, out.stdout.splitlines())}
+
+
+def test_roofline_scenarios():
+    rows = _rows()
+    dense = rows["dense_256m"]
+    # calibration anchor: within +-25% of the measured dense row (48,127)
+    assert 0.75 * 48127 < dense["predicted_tokens_per_sec"] < 1.25 * 48127
+
+    moe1 = rows["qwen3_moe_ub1_fp32"]
+    # the ceiling explanation: ub1/fp32 lands in the measured row's band
+    assert 0.8 * 25280 < moe1["predicted_tokens_per_sec"] < 1.4 * 25280
+    # ...and the top component is the HBM-bound expert gate+up matmul
+    top_name, top = next(iter(moe1["top_components"].items()))
+    assert top_name == "moe.experts_gate_up"
+    assert top["bound"] == "hbm"
+
+    # the queued recovery levers must rank correctly: ub2+bf16 > ub1,
+    # ub4+bf16 > ub2, and ub4 clears the VERDICT 0.25-MFU target
+    moe2 = rows["qwen3_moe_ub2_bf16"]
+    moe4 = rows["qwen3_moe_ub4_bf16"]
+    assert (moe2["predicted_mfu"] > moe1["predicted_mfu"])
+    assert (moe4["predicted_mfu"] > moe2["predicted_mfu"])
+    assert moe4["predicted_mfu"] >= 0.25
