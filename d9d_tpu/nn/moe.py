@@ -39,6 +39,7 @@ from d9d_tpu.ops.moe import (
     sort_tokens_by_expert,
     unpermute_combine,
 )
+from d9d_tpu.ops.moe_pallas import fused_moe_ffn_apply, moe_ffn_backend
 from d9d_tpu.ops.swiglu import silu_mul
 
 
@@ -348,6 +349,19 @@ class MoELayer(nn.Module):
         self, x: Array, topk_ids: Array, topk_probs: Array
     ) -> Array:
         sort = sort_tokens_by_expert(topk_ids, self.num_grouped_experts)
+        if moe_ffn_backend() == "pallas":
+            # one fused Pallas kernel over the group-aligned layout: the
+            # [M, 2*inter]/[M, inter] intermediates and the gate+up weight
+            # concat never touch HBM (ops/moe_pallas.py; backward runs
+            # the XLA chain below via custom_vjp — identical math)
+            return fused_moe_ffn_apply(
+                x, topk_probs, sort,
+                self.grouped_experts.gate_weight,
+                self.grouped_experts.up_weight,
+                self.grouped_experts.down_weight,
+                self.dtype,
+                num_experts=self.num_grouped_experts,
+            )
         permuted_x, permuted_probs = permute_tokens(x, topk_probs, sort)
         y = self.grouped_experts(permuted_x, permuted_probs, sort.group_sizes)
         return unpermute_combine(y, sort, x.shape[0]).astype(x.dtype)

@@ -1,0 +1,319 @@
+"""Fused expert-FFN Pallas kernel over a group-aligned tile layout.
+
+Replaces the local MoE compute chain
+``grouped_matmul(gate+up) -> silu_mul -> grouped_matmul(down) -> *probs``
+(ops/moe.py + nn/moe.py grouped_swiglu_apply; reference analogue:
+nv-grouped-gemm + Triton permute/silu kernels, d9d/kernel/gmm/function.py,
+d9d/kernel/moe/) with ONE Pallas kernel per layer call.
+
+Why (tools/roofline.py attribution of the 0.136-MFU north star): the XLA
+chain round-trips ``[M, 2*inter]`` gate+up activations and ``[M, inter]``
+hidden through HBM between the grouped matmuls, and the fused gate+up
+single-ragged_dot trick additionally materializes a runtime
+``[E, in, 2*inter]`` weight concat every call (ADVICE r3). At the bench
+geometry that is ~150 MB of avoidable HBM traffic per layer pass. This
+kernel keeps those intermediates in VMEM: each grid step loads one
+``[block_m, h]`` activation tile plus its expert's three weight blocks,
+runs gate/up/down matmuls + silu + prob-scale on-chip, and writes only
+the ``[block_m, h]`` output tile.
+
+The enabling layout trick is GROUP ALIGNMENT: expert groups are padded to
+``block_m`` multiples so every tile belongs to exactly one expert — no
+boundary tiles spanning two experts, so the kernel needs no multi-pass
+accumulation (the hard part of megablocks-style GMMs). The pad rows are
+zeros and cost only their matmul FLOPs, which the roofline shows are not
+the binding resource at MoE shapes (the step is HBM-bound). Consecutive
+tiles of the same expert reuse the already-fetched weight blocks (Pallas
+skips re-DMA when the mapped block index repeats, and tiles are
+expert-sorted by construction).
+
+Backward: ``fused_moe_ffn`` is a custom_vjp whose bwd re-runs the
+reference XLA path under ``jax.vjp`` — exact gradients, same cost as
+today's remat backward, zero extra residual memory (saved tensors are the
+function's own inputs). The fused kernel accelerates the forward AND the
+remat recompute (jax.checkpoint replays the custom fwd).
+
+Enable via ``D9D_TPU_MOE_FFN=pallas`` (default ``xla``); falls back to
+the XLA path when shapes don't meet the TPU tiling constraints.
+"""
+
+import functools
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.ops.moe import TokenSort, grouped_matmul
+from d9d_tpu.ops.swiglu import silu_mul
+
+LANES = 128
+
+
+class AlignedMeta(NamedTuple):
+    """Group-aligned layout descriptors (all int32, traced).
+
+    dest_aligned: [M] aligned row for each (token, k) pair i (the combine
+        gather indices; ``dest_aligned[i] = aligned_pos[sort.dest[i]]``).
+    pair_src: [m_pad] owning pair of each aligned row (-1 for pad rows) —
+        the gather map that fills the aligned activation buffer.
+    gid: [T] owning expert of each block_m tile (pad tiles clamp to E-1).
+    m_pad: static aligned buffer length (upper bound, block_m multiple).
+    """
+
+    dest_aligned: Array
+    pair_src: Array
+    gid: Array
+    m_pad: int
+
+
+def aligned_metadata(
+    sort: TokenSort, num_experts: int, block_m: int
+) -> AlignedMeta:
+    """Static-shape aligned layout from a TokenSort (all jnp, O(M + E))."""
+    m = sort.sort_idx.shape[0]
+    # every group pads by < block_m, so this static bound always fits
+    m_pad = (-(-m // block_m) + num_experts) * block_m
+    gs = sort.group_sizes
+    padded = ((gs + block_m - 1) // block_m) * block_m
+    ends = jnp.cumsum(gs)
+    aligned_starts = jnp.concatenate(
+        [jnp.zeros((1,), gs.dtype), jnp.cumsum(padded)[:-1]]
+    )
+    rows = jnp.arange(m, dtype=jnp.int32)
+    expert_of_row = jnp.searchsorted(ends, rows, side="right").astype(
+        jnp.int32
+    )
+    starts = ends - gs
+    rank = rows - starts[expert_of_row].astype(jnp.int32)
+    aligned_pos = aligned_starts[expert_of_row].astype(jnp.int32) + rank
+    dest_aligned = jnp.take(aligned_pos, sort.dest, axis=0)
+    pair_src = (
+        jnp.full((m_pad,), -1, jnp.int32)
+        .at[dest_aligned]
+        .set(jnp.arange(m, dtype=jnp.int32), unique_indices=True,
+             mode="drop")
+    )
+    n_tiles = m_pad // block_m
+    tile_starts = jnp.arange(n_tiles, dtype=jnp.int32) * block_m
+    gid = jnp.minimum(
+        jnp.searchsorted(jnp.cumsum(padded), tile_starts, side="right"),
+        num_experts - 1,
+    ).astype(jnp.int32)
+    return AlignedMeta(
+        dest_aligned=dest_aligned,
+        pair_src=pair_src,
+        gid=gid,
+        m_pad=m_pad,
+    )
+
+
+def _ffn_kernel(gid_ref, a_ref, probs_ref, wg_ref, wu_ref, wd_ref, out_ref):
+    """One aligned tile: out = (silu(A Wg) * (A Wu)) Wd * probs."""
+    a = a_ref[...]
+    g = jnp.dot(a, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(a, wu_ref[0], preferred_element_type=jnp.float32)
+    hidden = (jax.nn.silu(g) * u).astype(a.dtype)
+    y = jnp.dot(hidden, wd_ref[0], preferred_element_type=jnp.float32)
+    out_ref[...] = (y * probs_ref[...]).astype(out_ref.dtype)
+
+
+def _tpu_shapes_ok(h: int, inter: int, block_m: int) -> bool:
+    return h % LANES == 0 and inter % LANES == 0 and block_m % 8 == 0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "interpret")
+)
+def _fused_ffn_call(
+    aligned_x: Array,
+    aligned_probs: Array,
+    gid: Array,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    *,
+    block_m: int,
+    interpret: bool,
+) -> Array:
+    m_pad, h = aligned_x.shape
+    inter = gate_w.shape[-1]
+    n_tiles = m_pad // block_m
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # gid rides SMEM, available to index maps
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((block_m, h), lambda t, gid_ref: (t, 0)),
+            pl.BlockSpec((block_m, 1), lambda t, gid_ref: (t, 0)),
+            pl.BlockSpec((1, h, inter), lambda t, gid_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, h, inter), lambda t, gid_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, inter, h), lambda t, gid_ref: (gid_ref[t], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, h), lambda t, gid_ref: (t, 0)),
+    )
+    return pl.pallas_call(
+        _ffn_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, h), aligned_x.dtype),
+        interpret=interpret,
+    )(gid, aligned_x, aligned_probs, gate_w, up_w, down_w)
+
+
+def _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype):
+    """The existing XLA path (permute -> grouped matmuls -> combine);
+    single source of truth for the custom_vjp backward AND the fallback."""
+    from d9d_tpu.ops.moe import permute_tokens, unpermute_combine
+
+    permuted_x, permuted_probs = permute_tokens(x, probs, sort)
+    xx = permuted_x.astype(dtype)
+    inter = gate_w.shape[-1]
+    gate_up = jnp.concatenate(
+        [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
+    )
+    h_gu = grouped_matmul(xx, gate_up, sort.group_sizes)
+    hidden = silu_mul(h_gu[..., :inter], h_gu[..., inter:])
+    y = grouped_matmul(hidden, down_w.astype(dtype), sort.group_sizes)
+    y = y * permuted_probs[:, None].astype(dtype)
+    return unpermute_combine(y, sort, x.shape[0]).astype(x.dtype)
+
+
+def _zero_cotangent(x):
+    import numpy as np
+
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.zeros_like(x)
+    return np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def fused_moe_ffn(
+    x: Array,
+    probs: Array,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    sort_idx: Array,
+    dest: Array,
+    token_idx: Array,
+    group_sizes: Array,
+    num_experts: int,
+    block_m: int,
+    interpret: bool,
+) -> Array:
+    """[N, D] tokens + routing -> combined [N, D] expert outputs.
+
+    The TokenSort is passed as four flat arrays (custom_vjp cannot take a
+    NamedTuple across the nondiff boundary); int arrays get float0
+    cotangents like pallas_flash's segment ids.
+    """
+    out, _ = _fused_fwd(
+        x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
+        group_sizes, num_experts, block_m, interpret,
+    )
+    return out
+
+
+def _fused_fwd(
+    x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
+    group_sizes, num_experts, block_m, interpret,
+):
+    sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
+    meta = aligned_metadata(sort, num_experts, block_m)
+    n, h = x.shape
+    k = dest.shape[0] // n
+    dtype = gate_w.dtype  # caller pre-casts weights to the compute dtype
+    # ONE gather fills the aligned activation buffer (pair i owns token
+    # i // k); pad rows read token 0 and are zeroed by the mask. Traffic
+    # = today's sorted-layout gather PLUS the pad rows (m_pad - m zero
+    # rows written and re-read) — the static worst case pads every group
+    # by block_m, so keep E*block_m small against M (the block_m
+    # eligibility/sweep choices encode this).
+    valid = (meta.pair_src >= 0)[:, None]
+    token_src = jnp.maximum(meta.pair_src, 0) // k
+    aligned_x = jnp.where(
+        valid, jnp.take(x, token_src, axis=0), 0
+    ).astype(dtype)
+    aligned_probs = jnp.where(
+        valid,
+        jnp.take(probs.reshape(-1), jnp.maximum(meta.pair_src, 0))[:, None],
+        0,
+    ).astype(jnp.float32)
+    y_aligned = _fused_ffn_call(
+        aligned_x, aligned_probs, meta.gid,
+        gate_w, up_w, down_w,
+        block_m=block_m, interpret=interpret,
+    )
+    # combine: collision-free gather by pair then K-sum (ops/moe.py
+    # combine_pairs formulation, over the aligned layout)
+    pair_y = jnp.take(y_aligned, meta.dest_aligned, axis=0)
+    out = pair_y.reshape(n, k, h).sum(axis=1).astype(x.dtype)
+    residuals = (x, probs, gate_w, up_w, down_w, sort_idx, dest,
+                 token_idx, group_sizes)
+    return out, residuals
+
+
+def _fused_bwd(num_experts, block_m, interpret, residuals, d_out):
+    (x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
+     group_sizes) = residuals
+    sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
+    dtype = gate_w.dtype
+
+    def ref(x_, probs_, g_, u_, d_):
+        return _reference_apply(x_, probs_, sort, g_, u_, d_, dtype)
+
+    _, vjp = jax.vjp(ref, x, probs, gate_w, up_w, down_w)
+    dx, dprobs, dg, du, dd = vjp(d_out)
+    return (
+        dx, dprobs, dg, du, dd,
+        _zero_cotangent(sort_idx), _zero_cotangent(dest),
+        _zero_cotangent(token_idx), _zero_cotangent(group_sizes),
+    )
+
+
+fused_moe_ffn.defvjp(_fused_fwd, _fused_bwd)
+
+
+def moe_ffn_backend() -> str:
+    """'pallas' or 'xla' — env-selected like the SDPA backend family."""
+    return os.environ.get("D9D_TPU_MOE_FFN", "xla")
+
+
+def fused_moe_ffn_apply(
+    x: Array,
+    probs: Array,
+    sort: TokenSort,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    dtype,
+    *,
+    num_experts: int,
+    block_m: int | None = None,
+    interpret: bool | None = None,
+) -> Array:
+    """Entry point for nn/moe.py: fused kernel when eligible, else the
+    reference XLA chain (identical math either way)."""
+    h = x.shape[-1]
+    inter = gate_w.shape[-1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_m is None:
+        block_m = int(os.environ.get("D9D_TPU_MOE_FFN_BLOCK_M", "128"))
+    if not interpret and not _tpu_shapes_ok(h, inter, block_m):
+        return _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype)
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = fused_moe_ffn(
+        x, probs,
+        gate_w.astype(dtype), up_w.astype(dtype), down_w.astype(dtype),
+        sort.sort_idx, sort.dest, sort.token_idx, sort.group_sizes,
+        num_experts, block_m, interpret,
+    )
+    # same checkpoint name the XLA chain's grouped dots carry, so the
+    # save_expensive remat policy keeps its meaning under this backend
+    # (saves the [N, h] layer output — smaller than the XLA chain's
+    # [M, 2*inter] — and skips the fused-forward recompute in backward)
+    return checkpoint_name(out, "moe_grouped_dot")

@@ -310,6 +310,66 @@ def bench_ring_blocks(tiny):
             emit_timed("ring_cp_blocks_fwd_bwd", name, cfg, bwd, q, ks, vs)
 
 
+def bench_moe_ffn(tiny):
+    """XLA grouped chain vs the fused aligned-layout Pallas kernel
+    (ops/moe_pallas.py) at the north-star MoE geometry — fwd and
+    fwd+bwd (the bwd is shared, so fwd is where the A/B decides)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from d9d_tpu.ops.moe import sort_tokens_by_expert
+    from d9d_tpu.ops.moe_pallas import _reference_apply, fused_moe_ffn_apply
+
+    if tiny:
+        n, h, inter, e, k = 96, 64, 32, 8, 2
+        block_ms = [16]
+    else:
+        # bench geometry (bench.py run_bench_moe): h768 i256 E64 top-8,
+        # one microbatch of 2048 tokens. block_m tops out at 128 here:
+        # the aligned layout's static pad is E*block_m rows, so larger
+        # blocks mostly measure padding at M = n*k = 16384
+        n, h, inter, e, k = 2048, 768, 256, 64, 8
+        block_ms = [64, 128]
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, h), jnp.bfloat16)
+    wg = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.bfloat16)
+    wu = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.bfloat16)
+    wd = jnp.asarray(rng.randn(e, inter, h) * 0.1, jnp.bfloat16)
+    ids = jnp.asarray(
+        np.stack([rng.choice(e, size=k, replace=False) for _ in range(n)]),
+        jnp.int32,
+    )
+    probs = jnp.asarray(rng.rand(n, k).astype(np.float32))
+
+    def xla_chain(x, probs, ids, wg, wu, wd):
+        # the production chain itself (moe_pallas keeps it as the single
+        # source of truth for its own fallback + custom_vjp backward)
+        sort = sort_tokens_by_expert(ids, e)
+        return _reference_apply(x, probs, sort, wg, wu, wd, jnp.bfloat16)
+
+    variants = {"xla_chain": jax.jit(xla_chain)}
+    for bm in block_ms:
+        variants[f"pallas_fused_bm{bm}"] = jax.jit(
+            lambda x, probs, ids, wg, wu, wd, bm=bm: fused_moe_ffn_apply(
+                x, probs, sort_tokens_by_expert(ids, e), wg, wu, wd,
+                jnp.bfloat16, num_experts=e, block_m=bm,
+            )
+        )
+    cfg = f"n{n}_h{h}_i{inter}_e{e}_k{k}"
+    for name, fn in variants.items():
+        emit_timed("moe_ffn_fwd", name, cfg, fn, x, probs, ids, wg, wu, wd)
+        grad = jax.jit(
+            jax.grad(
+                lambda x, probs, wg, wu, wd, f=fn: jnp.sum(
+                    f(x, probs, ids, wg, wu, wd).astype(jnp.float32)
+                ),
+                argnums=(0, 2, 3, 4),
+            )
+        )
+        emit_timed("moe_ffn_fwd_bwd", name, cfg, grad, x, probs, wg, wu, wd)
+
+
 def bench_stochastic(tiny):
     import jax
     import jax.numpy as jnp
@@ -336,7 +396,7 @@ def main():
     ap.add_argument(
         "--only",
         choices=["sdpa", "linear_ce", "elementwise", "gated_delta",
-                 "ring", "stochastic"],
+                 "ring", "stochastic", "moe_ffn"],
         default=None,
     )
     args = ap.parse_args()
@@ -357,6 +417,7 @@ def main():
         "gated_delta": bench_gated_delta,
         "ring": bench_ring_blocks,
         "stochastic": bench_stochastic,
+        "moe_ffn": bench_moe_ffn,
     }
     for name, fn in benches.items():
         if args.only is None or args.only == name:

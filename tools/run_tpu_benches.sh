@@ -96,6 +96,17 @@ r["detail"]["remat_policy"] = "save_expensive"
 print(json.dumps(r))
 EOF
 
+# A/B: fused aligned-layout Pallas expert FFN (ops/moe_pallas.py) — keeps
+# the [M,2i]/[M,i] intermediates and the gate+up weight concat out of HBM
+D9D_TPU_MOE_FFN=pallas run_leg "MoE ub1 + pallas fused expert FFN" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub1_pallas_fused_ffn"
+print(json.dumps(r))
+EOF
+
 # µBS sweep with bf16 master weights + stochastic AdamW (any ub>1).
 # tools/roofline.py predicts ub2 -> MFU 0.235 and ub4 -> 0.272 (clears
 # the 0.25 target) IF ub4 fits HBM — a leg that OOMs records the failure
@@ -112,6 +123,37 @@ r["detail"]["variant"] = (
 print(json.dumps(r))
 EOF
 done
+
+# best-combo candidate: bigger tiles AND no recompute of the permute +
+# grouped dots (HBM-marginal: ~16.1G estimated vs 15.75G — cheap to try,
+# the OOM is reported per leg)
+D9D_BENCH_MOE_UB=2 D9D_BENCH_REMAT_POLICY=save_expensive \
+  run_leg "MoE ub2 bf16 + save_expensive" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub2_bf16_save_expensive"
+print(json.dumps(r))
+EOF
+
+# trace-backed attribution (VERDICT r3 item 1/3): re-run the MoE row with
+# jax.profiler capture (AFTER its timing, bench._measure traces a separate
+# pass) and summarize device time by category + named scopes; the capture
+# rides the roofline's analytic table as its measured cross-check
+D9D_BENCH_PROFILE_DIR=bench_results/traces \
+  run_leg "MoE profiled pass (trace capture)" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "profiled_trace_pass"
+print(json.dumps(r))
+EOF
+if [[ -d bench_results/traces/moe ]]; then
+  python tools/trace_summary.py bench_results/traces/moe \
+    | tee bench_results/trace_summary_moe.txt
+fi
 
 echo "== dense remat-policy sweep"
 for pol in dots_no_batch save_expensive; do
