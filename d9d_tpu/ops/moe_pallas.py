@@ -35,6 +35,14 @@ remat recompute (jax.checkpoint replays the custom fwd).
 
 Enable via ``D9D_TPU_MOE_FFN=pallas`` (default ``xla``); falls back to
 the XLA path when shapes don't meet the TPU tiling constraints.
+
+Scope: the LOCAL MoE path only. The EP flow's per-shard ``expert_fn``
+receives rows the dispatch all-to-all already delivered in expert-sorted
+(but unaligned) order; re-aligning them for this kernel would cost a
+``[rows, h]`` scatter + gather pair (~2·M·h·2 B) that cancels what the
+fusion saves (~M·(2·inter+inter)·2·2 B — equal at h = 3·inter, the
+Qwen3-MoE ratio). The local path wins only because the aligned gather
+REPLACES the permute gather it already had to do.
 """
 
 import functools

@@ -223,27 +223,47 @@ def dense_scenario():
     return "dense_256m", inv.report(tokens, model_fpt)
 
 
-def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True):
+def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True,
+                 hybrid=False):
+    """Qwen3-MoE north-star geometry; ``hybrid=True`` swaps 12 of the 16
+    attention layers for GatedDeltaNet (bench.py run_bench_moe(hybrid=
+    True) — BASELINE config 5)."""
     h, layers, heads, kvh, hd = 768, 16, 12, 4, 64
     inter, n_experts, topk, vocab = 256, 64, 8, 32768
     seq, batch = 2048, 8
+    chunk = 64
     n = ub * seq
     microbatches = batch // ub
     dtype_b = 2
     passes = 4
+    n_attn = 4 if hybrid else layers
+    n_gdn = layers - n_attn
     expert_params = layers * n_experts * 3 * h * inter
+    attn_layer_params = h * (heads * hd + 2 * kvh * hd) + heads * hd * h
+    # GDN block (nn/linear_attention.py): qkv_proj + conv + decay/b gates
+    # + output gate g_proj + o_proj (+ per-head norm, negligible)
+    gdn_dim = kvh * hd * 2 + heads * hd
+    gdn_layer_params = (
+        h * gdn_dim + gdn_dim * 4
+        + 2 * h * heads + h * heads * hd + heads * hd * h
+    )
     dense_params = (
         vocab * h
-        + layers * (h * (heads * hd + 2 * kvh * hd) + heads * hd * h
-                    + h * n_experts + 2 * h)
+        + n_attn * attn_layer_params
+        + n_gdn * gdn_layer_params
+        + layers * (h * n_experts + 2 * h)
         + h * vocab + h
     )
     params = expert_params + dense_params
     inv = Inventory()
     for _ in range(microbatches):
-        for _ in range(layers):
+        for _ in range(n_attn):
             _attention_layer(inv, n, h, heads, kvh, hd, seq, dtype_b, passes,
                              param_dtype_b)
+        for _ in range(n_gdn):
+            _gdn_layer(inv, n, h, kvh, heads, hd, hd, dtype_b, passes,
+                       param_dtype_b, chunk)
+        for _ in range(layers):
             _moe_layer(inv, n, h, inter, n_experts, topk, dtype_b, passes,
                        param_dtype_b, fused_gate_up, sortfree)
         _norms_rope(inv, n, h, layers, dtype_b, passes)
@@ -254,14 +274,46 @@ def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True):
     _grad_accum(inv, params, microbatches)
     tokens = batch * seq
     active = dense_params + expert_params * topk / n_experts
-    attn_f = 6 * layers * heads * hd * seq
-    model_fpt = 6 * active + attn_f
-    name = f"qwen3_moe_ub{ub}_{'fp32' if param_dtype_b == 4 else 'bf16'}"
+    attn_f = 6 * n_attn * heads * hd * seq
+    # bench.py _gdn_flops_per_token convention (fwd+bwd ~ 3x)
+    gdn_f = 3 * n_gdn * heads * (
+        4 * chunk * hd + 3 * chunk * hd + 6 * hd * hd
+    )
+    model_fpt = 6 * active + attn_f + gdn_f
+    base = "hybrid" if hybrid else "qwen3_moe"
+    name = f"{base}_ub{ub}_{'fp32' if param_dtype_b == 4 else 'bf16'}"
     if not fused_gate_up:
         name += "_unfused_gate_up"
     if not sortfree:
         name += "_argsort"
     return name, inv.report(tokens, model_fpt)
+
+
+def _gdn_layer(inv, n, h, qk_heads, v_heads, dk, dv, dtype_b, passes,
+               param_dtype_b, chunk=64):
+    """One GatedDeltaNet layer (nn/linear_attention.py): projections +
+    causal conv + chunked WY delta rule. The WY matmuls run in fp32
+    (ops/gated_delta.py), i.e. at roughly half the bf16 MXU rate — the
+    model charges their FLOPs x2 to reflect it."""
+    proj_in = h * (qk_heads * dk * 2 + v_heads * dv * 2 + 2 * v_heads)
+    proj_out = v_heads * dv * h
+    inv.add(
+        "gdn.proj",
+        flops=passes * 2 * n * (proj_in + proj_out),
+        bytes_=passes * param_dtype_b * (proj_in + proj_out)
+        + passes * dtype_b * n * (h * 2 + qk_heads * dk * 2
+                                  + v_heads * dv * 2),
+    )
+    conv_ch = qk_heads * dk * 2 + v_heads * dv
+    inv.add("gdn.conv", bytes_=passes * dtype_b * n * conv_ch * 2)
+    # chunked delta rule per head per token (bench.py _gdn_flops_per_token
+    # inventory), fp32 -> x2 FLOPs-equivalent on the bf16 roofline
+    per_tok = v_heads * (4 * chunk * dk + 3 * chunk * dv + 6 * dk * dv)
+    inv.add(
+        "gdn.delta_rule",
+        flops=passes * 2 * n * per_tok,
+        bytes_=passes * 4 * n * (qk_heads * dk * 2 + v_heads * dv * 2),
+    )
 
 
 def main():
@@ -275,6 +327,8 @@ def main():
         moe_scenario(ub=2, param_dtype_b=2),
         moe_scenario(ub=4, param_dtype_b=2),
         moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=False),
+        moe_scenario(ub=1, param_dtype_b=4, hybrid=True),
+        moe_scenario(ub=2, param_dtype_b=2, hybrid=True),
     ]
     for name, rep in scenarios:
         comps = rep.pop("components")

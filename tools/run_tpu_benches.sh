@@ -185,6 +185,18 @@ r["detail"]["variant"] = "flash_bwd_fused"
 print(json.dumps(r))
 EOF
 
+# dense batch scaling: full remat leaves HBM headroom; more rows per step
+# amortize per-kernel overheads (the dense MXU-eff lever left after the
+# fusion A/Bs — roofline pegs dense as MXU-bound)
+D9D_BENCH_BATCH=16 run_leg "dense batch=16" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench()
+r["detail"]["variant"] = "batch16"
+print(json.dumps(r))
+EOF
+
 run_leg "input-pipeline overlap (synthetic vs sync vs prefetch)" \
   bench_results/bench_sweep.jsonl python - <<'PYEOF'
 import json
