@@ -31,16 +31,18 @@ class StateCheckpointer:
         *,
         save_every_steps: int | None = None,
         num_to_keep: int | None = 3,
+        async_save: bool = True,
     ):
         self.directory = Path(directory).absolute()
         self.save_every_steps = save_every_steps
+        self.async_save = async_save
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=num_to_keep,
                 step_prefix="save",
                 create=True,
-                enable_async_checkpointing=False,
+                enable_async_checkpointing=async_save,
             ),
             item_names=(_ARRAYS, _META),
         )
@@ -67,6 +69,16 @@ class StateCheckpointer:
                 }
             ),
         )
+        # async mode: orbax has already snapshotted the device arrays to
+        # host (so the train step's donated buffers can't race the save);
+        # the disk write continues in the background and the next save /
+        # restore / close waits on it internally. Sync mode keeps the old
+        # barrier for callers that need the files on disk on return.
+        if not self.async_save:
+            self._mgr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight background save hits disk."""
         self._mgr.wait_until_finished()
 
     # -- load ----------------------------------------------------------

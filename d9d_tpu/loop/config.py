@@ -30,6 +30,10 @@ class TrainerConfig(pydantic.BaseModel):
     checkpoint_dir: str | None = None
     checkpoint_every_steps: int | None = None
     checkpoints_to_keep: int | None = 3
+    # async save: orbax snapshots device arrays to host synchronously
+    # (safe against the train step's donated buffers) and writes to disk
+    # in the background, keeping checkpoint IO off the step path
+    checkpoint_async: bool = True
     resume: bool = True
 
     # profiling (reference component/job_profiler.py:13)

@@ -149,6 +149,7 @@ class Trainer:
                 config.checkpoint_dir,
                 save_every_steps=config.checkpoint_every_steps,
                 num_to_keep=config.checkpoints_to_keep,
+                async_save=config.checkpoint_async,
             )
             if config.checkpoint_dir is not None
             else None
@@ -256,6 +257,12 @@ class Trainer:
             return
         with self.events.bounded(ev.EVENT_CHECKPOINT, trainer=self, step=step):
             self.checkpointer.save(step, self._job_arrays(), self._job_meta())
+            if last:
+                # intermediate saves overlap training (async write-back);
+                # the FINAL one must be durable when train() returns — the
+                # process may exit right after, and auto-resume contracts
+                # on the last step's checkpoint existing
+                self.checkpointer.wait_until_finished()
 
     def _try_resume(self) -> None:
         if self.checkpointer is None or not self.config.resume:

@@ -73,7 +73,8 @@ class _Loader(DatasetProvider):
         )
 
 
-def _make_trainer(tmp_path, total_steps, tracker=None, ckpt_every=2):
+def _make_trainer(tmp_path, total_steps, tracker=None, ckpt_every=2,
+                  ckpt_async=True):
     ctx = MeshParameters(dp_shard=4).build(jax.devices()[:4])
     return Trainer(
         ctx=ctx,
@@ -85,6 +86,7 @@ def _make_trainer(tmp_path, total_steps, tracker=None, ckpt_every=2):
             log_every=1,
             checkpoint_dir=str(tmp_path / "ckpt"),
             checkpoint_every_steps=ckpt_every,
+            checkpoint_async=ckpt_async,
             gc_every_steps=None,
         ),
         model_provider=_Provider(),
@@ -120,6 +122,30 @@ class TestCheckpointResume:
         _leaves_equal(
             jax.tree.leaves(t_b.opt_state), jax.tree.leaves(t_full.opt_state)
         )
+
+    def test_async_save_bitwise_matches_sync(self, tmp_path, devices):
+        """Async (default) checkpoints must hold exactly the state the
+        sync barrier would have written: train two identical runs, one
+        per mode, and compare the restored trees bit for bit. Also
+        proves the donated train-step buffers can't race the background
+        write (orbax snapshots to host before save() returns)."""
+        t_async = _make_trainer(tmp_path / "a", 4, ckpt_async=True)
+        t_async.train()
+        t_sync = _make_trainer(tmp_path / "s", 4, ckpt_async=False)
+        t_sync.train()
+
+        r_async = _make_trainer(tmp_path / "a", 4, ckpt_async=True)
+        r_sync = _make_trainer(tmp_path / "s", 4, ckpt_async=False)
+        got_a = r_async.checkpointer.restore(r_async._job_arrays())
+        got_s = r_sync.checkpointer.restore(r_sync._job_arrays())
+        assert got_a is not None and got_s is not None
+        step_a, arrays_a, meta_a = got_a
+        step_s, arrays_s, meta_s = got_s
+        assert step_a == step_s == 4
+        _leaves_equal(arrays_a, arrays_s)
+        assert meta_a["data_loader"] == meta_s["data_loader"]
+        for t in (t_async, t_sync, r_async, r_sync):
+            t.close()
 
     def test_rotation_keeps_latest(self, tmp_path, devices):
         t = _make_trainer(tmp_path, 8, ckpt_every=1)
