@@ -84,6 +84,12 @@ class StateCheckpointer:
     # -- load ----------------------------------------------------------
 
     def latest_step(self) -> int | None:
+        # orbax registers a step the moment its async save DISPATCHES, and
+        # neither latest_step nor restore waits on the background write
+        # (verified against orbax 0.11 source) — barrier here so callers
+        # never see (or race) a step whose directory is still a tmp path
+        if self.async_save:
+            self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def restore(
@@ -92,6 +98,8 @@ class StateCheckpointer:
         """Restore (step, arrays, meta); arrays land with the shardings of
         ``abstract_arrays`` (pass the live state — jax.eval_shape-style
         ShapeDtypeStructs with shardings also work)."""
+        if self.async_save:
+            self._mgr.wait_until_finished()  # see latest_step
         step = step if step is not None else self.latest_step()
         if step is None:
             return None

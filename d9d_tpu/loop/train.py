@@ -409,6 +409,12 @@ class Trainer:
             self.profiler.close()
             if self.run is not None:
                 self.run.close()
+            if self.checkpointer is not None:
+                # a step raising must not strand an in-flight async save as
+                # an unfinalized tmp dir — the crashed job's restart resumes
+                # from this checkpoint (the old sync default was durable at
+                # every save; keep that property on the exception path)
+                self.checkpointer.wait_until_finished()
         return history
 
     def close(self) -> None:

@@ -1,0 +1,6 @@
+"""Model families: Qwen3 (dense / MoE / Next-hybrid — reference parity)
+and Llama-3 (beyond-reference, BASELINE config 4)."""
+
+from d9d_tpu.models import llama, qwen3
+
+__all__ = ["llama", "qwen3"]

@@ -4,6 +4,7 @@ from d9d_tpu.ops.rms_norm import rms_norm
 from d9d_tpu.ops.rope import (
     RopeScaling,
     RopeScalingLinear,
+    RopeScalingLlama3,
     RopeScalingNone,
     RopeScalingNtk,
     RopeScalingYarn,
@@ -21,6 +22,7 @@ __all__ = [
     "rms_norm",
     "RopeScaling",
     "RopeScalingLinear",
+    "RopeScalingLlama3",
     "RopeScalingNone",
     "RopeScalingNtk",
     "RopeScalingYarn",

@@ -3,7 +3,7 @@
 TPU-native equivalent of the reference RoPE stack
 (d9d/module/block/positional/rope.py:22,76,187 and rope_scaling.py:36-120):
 two layout styles (HALF = GPT-NeoX rotate-half, INTERLEAVED = GPT-J pairs),
-four scaling laws (none / linear / NTK-aware / YaRN). Everything here is a
+five scaling laws (none / linear / NTK-aware / YaRN / llama3). Everything here is a
 pure function of static config + a positions array, so it jits and shards
 trivially (positions can be sharded over the cp axes).
 """
@@ -53,7 +53,34 @@ class RopeScalingYarn:
     attention_factor: float | None = None
 
 
-RopeScaling = RopeScalingNone | RopeScalingLinear | RopeScalingNtk | RopeScalingYarn
+@dataclasses.dataclass(frozen=True)
+class RopeScalingLlama3:
+    """Llama-3.1 piecewise scaling (HF ``rope_type="llama3"``): wavelengths
+    longer than the original context are interpolated by ``factor``,
+    shorter than ``original_max_position / high_freq_factor`` are kept,
+    and the band between is linearly blended. Beyond-reference scaling law
+    (the reference ships none/linear/ntk/yarn only) — needed by the
+    Llama-3.1 family presets (models/llama)."""
+
+    factor: float
+    original_max_position: int
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+
+    def __post_init__(self):
+        if self.low_freq_factor >= self.high_freq_factor:
+            raise ValueError(
+                f"llama3 rope scaling needs low_freq_factor "
+                f"({self.low_freq_factor}) < high_freq_factor "
+                f"({self.high_freq_factor}) — the blend-band denominator "
+                f"is their difference"
+            )
+
+
+RopeScaling = (
+    RopeScalingNone | RopeScalingLinear | RopeScalingNtk | RopeScalingYarn
+    | RopeScalingLlama3
+)
 
 
 def _yarn_correction_dim(num_rotations: float, dim: int, theta: float, max_pos: int) -> float:
@@ -104,6 +131,20 @@ def compute_rope_frequencies(
             scale = scaling.attention_factor
         else:
             scale = 0.1 * math.log(scaling.factor) + 1.0
+    elif isinstance(scaling, RopeScalingLlama3):
+        # HF modeling_rope_utils._compute_llama3_parameters semantics
+        wavelen = 2 * math.pi / inv_freq
+        low_wl = scaling.original_max_position / scaling.low_freq_factor
+        high_wl = scaling.original_max_position / scaling.high_freq_factor
+        smooth = (
+            scaling.original_max_position / wavelen - scaling.low_freq_factor
+        ) / (scaling.high_freq_factor - scaling.low_freq_factor)
+        blended = (1 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wl,
+            inv_freq / scaling.factor,
+            jnp.where(wavelen < high_wl, inv_freq, blended),
+        )
     else:
         raise TypeError(f"unknown rope scaling: {scaling!r}")
     return inv_freq, scale

@@ -1,0 +1,171 @@
+"""HF parity for the Llama-3 family (models/llama): logits vs a
+transformers LlamaForCausalLM through the shared dense mapper
+(qk_norm=False path), plus the llama3 rope-scaling law vs HF's
+implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+pytestmark = pytest.mark.e2e  # slow tier: full HF-roundtrip flows
+
+
+from d9d_tpu.model_state import (
+    identity_mapper_from_names,
+    load_params,
+    save_params,
+    write_model_state_local,
+)
+from d9d_tpu.model_state.io.reader import read_model_state
+from d9d_tpu.models.llama import (
+    LlamaCausalLM,
+    llama3_tiny,
+    llama_from_hf_mapper,
+    llama_to_hf_mapper,
+)
+from d9d_tpu.ops.attention.eager import eager_sdpa
+
+transformers = pytest.importorskip("transformers")
+
+VOCAB = 128
+
+
+def _hf_model(rope_scaling=None):
+    torch = pytest.importorskip("torch")
+    cfg = transformers.LlamaConfig(
+        vocab_size=VOCAB,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=64,
+        rope_theta=500_000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=False,
+        mlp_bias=False,
+        tie_word_embeddings=False,
+        rope_scaling=rope_scaling,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _save_hf_state(model, tmp_path):
+    state = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    write_model_state_local(
+        tmp_path, identity_mapper_from_names(state.keys()), iter(state.items())
+    )
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours(tmp_path_factory):
+    pytest.importorskip("torch")
+    tmp_path = tmp_path_factory.mktemp("hf_llama_ckpt")
+    hf = _hf_model()
+    _save_hf_state(hf, tmp_path)
+
+    cfg = llama3_tiny(VOCAB)
+    cfg = __import__("dataclasses").replace(
+        cfg, intermediate_size=128, norm_eps=1e-6
+    )
+    model = LlamaCausalLM(config=cfg, sdpa=eager_sdpa, dtype=jnp.float32)
+    b, t = 2, 16
+    tokens = jnp.zeros((b, t), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens, positions, tokens)
+    )
+    import flax.linen as nn
+
+    template = nn.unbox(template)
+    params = load_params(
+        tmp_path, template, mapper=llama_from_hf_mapper(cfg)
+    )
+    return hf, model, params, cfg, tmp_path
+
+
+def test_logits_match_hf(hf_and_ours):
+    torch = pytest.importorskip("torch")
+    hf, model, params, cfg, _ = hf_and_ours
+    rng = np.random.default_rng(0)
+    tokens_np = rng.integers(0, VOCAB, size=(2, 16))
+
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens_np)).logits.numpy()
+
+    positions = np.broadcast_to(np.arange(16), (2, 16)).astype(np.int32)
+    ours = model.apply(
+        params,
+        jnp.asarray(tokens_np, jnp.int32),
+        jnp.asarray(positions),
+        method=model.logits,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
+    torch = pytest.importorskip("torch")
+    hf, model, params, cfg, _ = hf_and_ours
+    save_params(tmp_path, params, mapper=llama_to_hf_mapper(cfg))
+
+    hf_state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    exported = dict(
+        read_model_state(
+            tmp_path, identity_mapper_from_names(hf_state.keys())
+        )
+    )
+    assert set(exported) == set(hf_state)
+    for k in hf_state:
+        np.testing.assert_allclose(
+            exported[k], hf_state[k], rtol=1e-6, atol=1e-6, err_msg=k
+        )
+
+
+def test_llama3_rope_scaling_matches_hf():
+    """RopeScalingLlama3 inv_freq == HF's _compute_llama3_parameters."""
+    torch = pytest.importorskip("torch")
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from d9d_tpu.ops import RopeScalingLlama3, compute_rope_frequencies
+
+    head_dim = 32
+    theta = 500_000.0
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=128,
+        num_attention_heads=4,
+        head_dim=head_dim,
+        rope_theta=theta,
+        max_position_embeddings=4096,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "original_max_position_embeddings": 512,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+        },
+    )
+    hf_inv_freq, hf_scale = ROPE_INIT_FUNCTIONS["llama3"](
+        hf_cfg, device="cpu"
+    )
+    ours, scale = compute_rope_frequencies(
+        head_dim,
+        theta,
+        RopeScalingLlama3(
+            factor=8.0,
+            original_max_position=512,
+            low_freq_factor=1.0,
+            high_freq_factor=4.0,
+        ),
+    )
+    assert scale == hf_scale == 1.0
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_inv_freq.numpy(), rtol=1e-6, atol=1e-9
+    )
