@@ -14,6 +14,7 @@ from d9d_tpu.loop.control.providers import (
 )
 from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.loop.event import EventBus
+from d9d_tpu.loop.generate import generate
 from d9d_tpu.loop.inference import (
     Inference,
     InferenceTask,
@@ -56,4 +57,5 @@ __all__ = [
     "SequenceClassificationTask",
     "Trainer",
     "build_train_step",
+    "generate",
 ]

@@ -1,0 +1,110 @@
+"""Autoregressive generation over KV-cache decode models.
+
+Beyond-reference capability (the reference's ``Inference`` is forward-only
+batch scoring — d9d/loop/inference.py; it has no sampling loop): build a
+model with ``decode_max_length = prompt_len + max_new_tokens`` and this
+module runs prefill + a ``lax.scan`` decode loop as ONE jitted program —
+no host round-trip per token, XLA-friendly static shapes throughout.
+
+The cache rides flax's ``"cache"`` collection (written by
+``GroupedQueryAttention._decode_attend`` / the GDN decode state), so the
+loop is model-agnostic: anything exposing a ``logits`` method and the
+cache collection decodes here (Qwen3 dense, MoE, the GDN hybrid, Llama).
+
+Sampling: ``temperature=0`` is greedy argmax; otherwise
+``jax.random.categorical`` over ``logits / temperature``. ``eos_id``
+freezes finished rows (they keep emitting ``eos_id`` so shapes stay
+static).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from d9d_tpu.core.types import Array
+
+
+def generate(
+    model,
+    params: Any,
+    prompt_ids: Array,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_id: int | None = None,
+) -> Array:
+    """``prompt_ids [B, P]`` int32 → generated ids ``[B, max_new_tokens]``.
+
+    ``model`` must be built with ``decode_max_length >= P + max_new_tokens``
+    (its KV caches are that static length). The whole prefill + decode
+    scan jits as one program; call under ``jax.jit`` for repeat use —
+    retracing only happens when shapes change.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    dml = getattr(model, "decode_max_length", 0)
+    b, p = prompt_ids.shape
+    # the final sampled token is returned, never fed back, so the cache
+    # holds at most p + max_new_tokens - 1 positions
+    if dml < p + max_new_tokens - 1:
+        raise ValueError(
+            f"model.decode_max_length={dml} < prompt {p} + "
+            f"max_new_tokens {max_new_tokens} - 1"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # prefill: run the whole prompt once, writing every layer's cache;
+    # only the last position's logits are needed, so use the
+    # head-on-one-row method when the model provides it
+    positions = jnp.broadcast_to(
+        jnp.arange(p, dtype=jnp.int32), (b, p)
+    )
+    prefill_method = getattr(model, "logits_last", None) or model.logits
+    logits, state = model.apply(
+        {"params": params},
+        prompt_ids.astype(jnp.int32),
+        positions,
+        method=prefill_method,
+        mutable=["cache"],
+    )
+    key, sub = jax.random.split(rng)
+    token = sample(logits[:, -1], sub)
+    done = (
+        token == eos_id if eos_id is not None
+        else jnp.zeros((b,), jnp.bool_)
+    )
+
+    def step(carry, _):
+        cache, tok, pos, key, dn = carry
+        key, sub = jax.random.split(key)
+        logits_t, new_cache = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            jnp.full((b, 1), pos, jnp.int32),
+            method=model.logits,
+            mutable=["cache"],
+        )
+        nxt = sample(logits_t[:, -1], sub)
+        if eos_id is not None:
+            nxt = jnp.where(dn, eos_id, nxt)
+            dn = dn | (nxt == eos_id)
+        return (new_cache["cache"], nxt, pos + 1, key, dn), nxt
+
+    if max_new_tokens == 1:
+        return token[:, None]
+    carry = (state["cache"], token, jnp.int32(p), key, done)
+    _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+    # prefill sampled the first generated token; each scan step sampled
+    # the next one
+    return jnp.concatenate(
+        [token[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+    )

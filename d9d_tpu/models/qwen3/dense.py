@@ -58,6 +58,8 @@ def _remat_policy(name: str):
 class Qwen3DenseBackbone(nn.Module):
     config: Qwen3DenseConfig
     sdpa: SdpaBackend
+    # KV-cache decode mode (loop/generate.py): 0 = training/eval path
+    decode_max_length: int = 0
     stage: PipelineStageInfo = PipelineStageInfo()
     # residual-stream [B, T, E] sharding pin: anchors SPMD propagation at
     # every layer boundary so activation layouts can't drift into fused
@@ -98,7 +100,9 @@ class Qwen3DenseBackbone(nn.Module):
         cos, sin = make_rope_cos_sin(positions, inv_freq, att_scale)
 
         layer_cls = DecoderLayer
-        if cfg.remat:
+        # remat is a backward-pass tool; decode is forward-only and its
+        # mutable cache variables don't compose with nn.remat
+        if cfg.remat and self.decode_max_length == 0:
             layer_cls = nn.remat(
                 DecoderLayer,
                 prevent_cse=False,
@@ -119,6 +123,7 @@ class Qwen3DenseBackbone(nn.Module):
                 use_output_gate=cfg.use_output_gate,
                 fused_qkv=cfg.fused_qkv,
                 norm_eps=cfg.norm_eps,
+                decode_max_length=self.decode_max_length,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"layers_{gid}",
@@ -143,6 +148,8 @@ class Qwen3DenseCausalLM(nn.Module):
     stage: PipelineStageInfo = PipelineStageInfo()
     ce_chunk_size: "int | str" = "auto"
     act_sharding: Optional[NamedSharding] = None
+    # KV-cache decode mode (loop/generate.py): 0 = training/eval path
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -152,6 +159,7 @@ class Qwen3DenseCausalLM(nn.Module):
             sdpa=self.sdpa,
             stage=self.stage,
             act_sharding=self.act_sharding,
+            decode_max_length=self.decode_max_length,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -183,6 +191,18 @@ class Qwen3DenseCausalLM(nn.Module):
         if not self.stage.is_last:
             return h
         return self.lm_head.logits(h)
+
+    def logits_last(
+        self, x: Array, positions: Array, mask: Optional[Array] = None
+    ) -> Array:
+        """Logits for the LAST position only ``[B, 1, V]`` — the prefill
+        fast path (loop/generate.py): the backbone runs over the full
+        prompt (writing caches in decode mode) but the LM head matmul
+        covers one row instead of P."""
+        h = self.model(x, positions, mask)
+        if not self.stage.is_last:
+            return h
+        return self.lm_head.logits(h[:, -1:])
 
 
 class Qwen3DenseForClassification(nn.Module):

@@ -184,6 +184,8 @@ class Qwen3MoeDecoderLayer(nn.Module):
     config: Qwen3MoeConfig
     sdpa: SdpaBackend
     layer_idx: int
+    # KV-cache / GDN-state decode mode (loop/generate.py); 0 = training
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -217,6 +219,7 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 head_v_dim=cfg.gdn_head_v_dim or cfg.head_dim,
                 conv_size=cfg.gdn_conv_size,
                 norm_eps=cfg.norm_eps,
+                decode=self.decode_max_length > 0,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="linear_attn",
@@ -233,6 +236,7 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 use_output_gate=cfg.use_output_gate,
                 fused_qkv=cfg.fused_qkv,
                 rope_fraction=cfg.rope_fraction,
+                decode_max_length=self.decode_max_length,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="self_attn",
@@ -274,6 +278,8 @@ class Qwen3MoeBackbone(nn.Module):
     stage: PipelineStageInfo = PipelineStageInfo()
     # residual-stream [B, T, E] sharding pin — see Qwen3DenseBackbone
     act_sharding: Optional[NamedSharding] = None
+    # KV-cache / GDN-state decode mode (loop/generate.py); 0 = training
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -312,7 +318,9 @@ class Qwen3MoeBackbone(nn.Module):
         cos, sin = make_rope_cos_sin(positions, inv_freq, att_scale)
 
         layer_cls = Qwen3MoeDecoderLayer
-        if cfg.remat:
+        # remat is a backward-pass tool; decode is forward-only and its
+        # mutable cache variables don't compose with nn.remat
+        if cfg.remat and self.decode_max_length == 0:
             from d9d_tpu.models.qwen3.dense import _remat_policy
 
             layer_cls = nn.remat(
@@ -326,6 +334,7 @@ class Qwen3MoeBackbone(nn.Module):
                 config=cfg,
                 sdpa=self.sdpa,
                 layer_idx=gid,
+                decode_max_length=self.decode_max_length,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"layers_{gid}",
@@ -348,6 +357,8 @@ class Qwen3MoeCausalLM(nn.Module):
     stage: PipelineStageInfo = PipelineStageInfo()
     ce_chunk_size: "int | str" = "auto"
     act_sharding: Optional[NamedSharding] = None
+    # KV-cache / GDN-state decode mode (loop/generate.py); 0 = training
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -357,6 +368,7 @@ class Qwen3MoeCausalLM(nn.Module):
             sdpa=self.sdpa,
             stage=self.stage,
             act_sharding=self.act_sharding,
+            decode_max_length=self.decode_max_length,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -393,6 +405,19 @@ class Qwen3MoeCausalLM(nn.Module):
         if not self.stage.is_last:
             return h
         return self.lm_head.logits(h)
+
+    def logits_last(
+        self,
+        x: Array,
+        positions: Array,
+        mask: Optional[Array] = None,
+        padding_mask: Optional[Array] = None,
+    ) -> Array:
+        """Last-position logits ``[B, 1, V]`` — see the dense twin."""
+        h = self.model(x, positions, mask, padding_mask)
+        if not self.stage.is_last:
+            return h
+        return self.lm_head.logits(h[:, -1:])
 
 
 class Qwen3MoeForClassification(nn.Module):

@@ -64,6 +64,12 @@ class GroupedQueryAttention(nn.Module):
     # the concat crosses the tp-sharded head dim and XLA must reshard the
     # kernels; single-chip benches enable it (D9D_BENCH_FUSED_QKV).
     fused_qkv: bool = False
+    # Autoregressive decode mode (loop/generate.py), on when > 0:
+    # maintains KV-cache variables in the "cache" collection
+    # (cached_key/cached_value of this static length + a write index) and
+    # attends new tokens against the cache. 0 keeps the training path
+    # byte-identical.
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -177,16 +183,19 @@ class GroupedQueryAttention(nn.Module):
                 self.param_dtype,
             )
 
-        attn = self.sdpa(
-            q,
-            k,
-            v,
-            causal=True,
-            softmax_scale=self.softmax_scale,
-            window_size=self.window_size,
-            sinks=sinks,
-            mask=mask,
-        )
+        if self.decode_max_length > 0:
+            attn = self._decode_attend(q, k, v, sinks, mask, b, t)
+        else:
+            attn = self.sdpa(
+                q,
+                k,
+                v,
+                causal=True,
+                softmax_scale=self.softmax_scale,
+                window_size=self.window_size,
+                sinks=sinks,
+                mask=mask,
+            )
         # named so the "save_expensive" remat policy can keep the flash
         # kernel's output instead of re-running it in the backward pass
         attn = checkpoint_name(attn, "sdpa_out")
@@ -196,6 +205,64 @@ class GroupedQueryAttention(nn.Module):
             gate = proj(h * d, "gate_proj", (la.EMBED, la.HEADS))(x)
             out = out * nn.sigmoid(gate)
         return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)
+
+    def _decode_attend(self, q, k, v, sinks, mask, b, t):
+        """KV-cache attention: write the new k/v at the cache index, then
+        attend against the full static-length cache with a validity+causal
+        mask (the eager oracle handles cross-length attention + sinks +
+        window; decode throughput is cache-bandwidth-bound, so the eager
+        path is the right backend here — no flash tiling to win).
+
+        Capacity contract: callers must never feed more than
+        ``decode_max_length`` total tokens — the write index is traced, so
+        this module cannot check it; past the end, ``dynamic_update_slice``
+        clamps and outputs silently degrade (loop/generate.py enforces the
+        bound statically up front).
+        """
+        from jax import lax
+
+        from d9d_tpu.ops.attention.eager import eager_sdpa
+
+        if mask is not None:
+            raise NotImplementedError(
+                "explicit attention masks are not supported in decode mode "
+                "(the cache layout can't express a caller mask built for "
+                "the prompt length); decode unpadded prompts"
+            )
+        s_max, hkv, d = self.decode_max_length, self.num_kv_heads, self.head_dim
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, s_max, hkv, d), self.dtype),
+        )
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, s_max, hkv, d), self.dtype),
+        )
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        start = idx.value
+        ck.value = lax.dynamic_update_slice(
+            ck.value, k.astype(self.dtype), (0, start, 0, 0)
+        )
+        cv.value = lax.dynamic_update_slice(
+            cv.value, v.astype(self.dtype), (0, start, 0, 0)
+        )
+        idx.value = start + t
+        # query i sits at absolute position start + i; valid keys are the
+        # written prefix, causally up to the query's own position
+        q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
+        k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+        dec_mask = k_pos <= q_abs  # [t, S_max]
+        if self.window_size is not None:
+            dec_mask &= k_pos > q_abs - self.window_size
+        return eager_sdpa(
+            q, ck.value, cv.value,
+            causal=False,
+            softmax_scale=self.softmax_scale,
+            sinks=sinks,
+            mask=dec_mask[None, None],
+        )
 
 
 class LowRankProjection(nn.Module):

@@ -28,6 +28,8 @@ class DecoderLayer(nn.Module):
     use_output_gate: bool = False
     fused_qkv: bool = False
     norm_eps: float = 1e-6
+    # KV-cache decode mode when > 0 (see GroupedQueryAttention)
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -47,6 +49,7 @@ class DecoderLayer(nn.Module):
             use_sinks=self.use_sinks,
             use_output_gate=self.use_output_gate,
             fused_qkv=self.fused_qkv,
+            decode_max_length=self.decode_max_length,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="self_attn",

@@ -19,7 +19,10 @@ import jax.numpy as jnp
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn import logical_axes as la
 from d9d_tpu.nn.norm import RMSNorm
-from d9d_tpu.ops.gated_delta import gated_delta_rule_chunked
+from d9d_tpu.ops.gated_delta import (
+    gated_delta_rule_chunked,
+    gated_delta_rule_recurrent,
+)
 from d9d_tpu.ops.swiglu import silu_mul
 
 
@@ -172,6 +175,11 @@ class GatedDeltaNet(nn.Module):
     decay_gate: DecayGateKind = DecayGateKind.mamba
     use_qk_l2norm: bool = True
     chunk_size: int = 64
+    # Autoregressive decode (loop/generate.py): carries the recurrent
+    # delta-rule state [B, Hv, Dk, Dv] and the conv's (K-1)-token input
+    # tail in the "cache" collection — this is the linear-attention decode
+    # advantage: O(1) state per token instead of a growing KV cache.
+    decode: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -201,12 +209,30 @@ class GatedDeltaNet(nn.Module):
             )
 
         qkv = proj(q_dim + k_dim + v_dim, "qkv_proj", (la.EMBED, la.HEADS))(x)
-        qkv = CausalShortConv1d(
+        conv = CausalShortConv1d(
             channels=q_dim + k_dim + v_dim,
             kernel_size=self.conv_size,
             name="qkv_conv1d",
             param_dtype=self.param_dtype,
-        )(qkv)
+        )
+        if self.decode and self.conv_size > 1:
+            # prepend the true previous K-1 pre-conv inputs (zeros on the
+            # first call = the left pad the full path uses), conv over the
+            # joined window, keep the new t outputs
+            tail_len = self.conv_size - 1
+            tail = self.variable(
+                "cache", "conv_tail",
+                lambda: jnp.zeros(
+                    (b, tail_len, q_dim + k_dim + v_dim), self.dtype
+                ),
+            )
+            joined = jnp.concatenate(
+                [tail.value, qkv.astype(self.dtype)], axis=1
+            )
+            tail.value = joined[:, -tail_len:]
+            qkv = conv(joined)[:, -t:]
+        else:
+            qkv = conv(qkv)
         q, k, v = jnp.split(qkv, [q_dim, q_dim + k_dim], axis=-1)
         q = q.reshape(b, t, hqk, dqk)
         k = k.reshape(b, t, hqk, dqk)
@@ -228,11 +254,31 @@ class GatedDeltaNet(nn.Module):
             proj(hv, "b_proj", (la.EMBED, la.HEADS))(x).astype(jnp.float32)
         )
 
-        out, _ = gated_delta_rule_chunked(
-            q, k, v, g, beta,
-            use_qk_l2norm=self.use_qk_l2norm,
-            chunk_size=self.chunk_size,
-        )
+        if self.decode:
+            state = self.variable(
+                "cache", "delta_state",
+                lambda: jnp.zeros((b, hv, dqk, dv), jnp.float32),
+            )
+            if t == 1:
+                out, s_final = gated_delta_rule_recurrent(
+                    q, k, v, g, beta,
+                    use_qk_l2norm=self.use_qk_l2norm,
+                    initial_state=state.value,
+                )
+            else:  # prefill: chunked WY form, threading the state
+                out, s_final = gated_delta_rule_chunked(
+                    q, k, v, g, beta,
+                    use_qk_l2norm=self.use_qk_l2norm,
+                    chunk_size=self.chunk_size,
+                    initial_state=state.value,
+                )
+            state.value = s_final
+        else:
+            out, _ = gated_delta_rule_chunked(
+                q, k, v, g, beta,
+                use_qk_l2norm=self.use_qk_l2norm,
+                chunk_size=self.chunk_size,
+            )
 
         out = RMSNorm(dv, eps=self.norm_eps, name="out_norm",
                       param_dtype=self.param_dtype)(out.astype(self.dtype))
