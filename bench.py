@@ -606,6 +606,87 @@ def run_bench_input_pipeline(*, tiny: bool = False) -> dict:
     }
 
 
+def run_bench_generate(*, tiny: bool = False) -> dict:
+    """Autoregressive decode throughput (loop/generate.py) on the dense
+    headline geometry: batch rows decode greedily from a KV cache; the
+    metric is generated tokens/sec/chip (decode is HBM-bound — each token
+    re-reads the weights — so this row tracks effective weight-stream
+    bandwidth, not MXU)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from d9d_tpu.loop.generate import generate
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+    from d9d_tpu.nn.sdpa import build_sdpa_backend
+    from tools.benchtime import host_fetch_sync, measure_rtt
+
+    if tiny:
+        cfg = Qwen3DenseConfig.tiny()
+        batch, prompt, gen = 2, 8, 8
+        dtype = jnp.float32
+    else:
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 32_768),),
+            hidden_size=1024,
+            num_layers=12,
+            num_heads=16,
+            num_kv_heads=8,
+            head_dim=64,
+            intermediate_size=4096,
+            remat=False,
+        )
+        batch, prompt, gen = 8, 128, 256
+        dtype = jnp.bfloat16
+    model = Qwen3DenseCausalLM(
+        config=cfg, sdpa=build_sdpa_backend(), dtype=dtype,
+        decode_max_length=prompt + gen,
+    )
+    z = jnp.zeros((batch, prompt), jnp.int32)
+    pos = jnp.broadcast_to(
+        jnp.arange(prompt, dtype=jnp.int32), (batch, prompt)
+    )
+    params = model.init(jax.random.PRNGKey(0), z, pos, z)["params"]
+    rng = np.random.RandomState(0)
+    prompt_ids = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (batch, prompt)), jnp.int32
+    )
+
+    run = jax.jit(
+        lambda prm, p_ids: generate(model, prm, p_ids, max_new_tokens=gen)
+    )
+    out = run(params, prompt_ids)  # compile + warmup
+    host_fetch_sync(out)
+    rtt = measure_rtt(out)
+    reps = 1 if tiny else 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run(params, prompt_ids)
+    host_fetch_sync(out)
+    dt = time.perf_counter() - t0 - rtt
+    if dt <= 0:  # RTT jitter swamped the signal (benchtime.timeit rule)
+        return {
+            "metric": "dense_lm_decode_tokens_per_sec_per_chip",
+            "value": -1.0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "detail": {"error": "unmeasurable: fetch-RTT jitter"},
+        }
+    tok_s = reps * batch * gen / dt
+    return {
+        "metric": "dense_lm_decode_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,  # first recorded decode row
+        "detail": {
+            "batch": batch,
+            "prompt": prompt,
+            "new_tokens": gen,
+            "device": jax.devices()[0].device_kind,
+        },
+    }
+
+
 # rows finished before a watchdog fire; the watchdog folds them into its
 # error line so a wedge mid-MoE still delivers the dense number
 _partial_results: dict = {}

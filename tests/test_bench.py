@@ -120,6 +120,16 @@ def test_bench_input_pipeline_tiny_runs(devices):
         assert result[key] is None or result[key] > 0
 
 
+def test_bench_generate_tiny_runs(devices):
+    """run_bench_generate: the decode-throughput row stays runnable on
+    the CPU rig (guards generate + decode models against refactors)."""
+    bench = _load_bench()
+    result = bench.run_bench_generate(tiny=True)
+    assert result["metric"] == "dense_lm_decode_tokens_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["detail"]["new_tokens"] == 8
+
+
 def test_bench_hybrid_tiny_runs(devices):
     """run_bench_moe(hybrid=True): the Qwen3-Next/GDN family's bench row
     (BASELINE config 5) stays runnable on the CPU rig."""

@@ -204,6 +204,13 @@ import bench
 print(json.dumps(bench.run_bench_input_pipeline()))
 PYEOF
 
+run_leg "decode throughput (KV-cache generation, dense geometry)" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
+import json
+import bench
+print(json.dumps(bench.run_bench_generate()))
+PYEOF
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
