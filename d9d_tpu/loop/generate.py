@@ -2,21 +2,31 @@
 
 Beyond-reference capability (the reference's ``Inference`` is forward-only
 batch scoring — d9d/loop/inference.py; it has no sampling loop): build a
-model with ``decode_max_length = prompt_len + max_new_tokens`` and this
-module runs prefill + a ``lax.scan`` decode loop as ONE jitted program —
-no host round-trip per token, XLA-friendly static shapes throughout.
+model with ``decode_max_length >= prompt_len + max_new_tokens - 1`` and
+this module runs prefill + a ``lax.scan`` decode loop as ONE jitted
+program — no host round-trip per token, XLA-friendly static shapes
+throughout.
 
 The cache rides flax's ``"cache"`` collection (written by
 ``GroupedQueryAttention._decode_attend`` / the GDN decode state), so the
 loop is model-agnostic: anything exposing a ``logits`` method and the
 cache collection decodes here (Qwen3 dense, MoE, the GDN hybrid, Llama).
 
+Ragged batches are LEFT-padded: pass ``prompt_lengths [B]`` and rows
+shorter than the padded width get per-row rope positions
+(``0..L-1`` right-aligned) plus a key-validity mask over their pad slots
+(cache-slot order equals time order per row, so causality stays
+slot-based — see ``GroupedQueryAttention._decode_attend``). GDN layers
+receive the matching ``padding_mask`` when the model accepts one.
+
 Sampling: ``temperature=0`` is greedy argmax; otherwise
-``jax.random.categorical`` over ``logits / temperature``. ``eos_id``
-freezes finished rows (they keep emitting ``eos_id`` so shapes stay
-static).
+``jax.random.categorical`` over ``logits / temperature``, optionally
+truncated to the smallest set of tokens with cumulative probability
+``top_p`` (nucleus sampling). ``eos_id`` freezes finished rows (they keep
+emitting ``eos_id`` so shapes stay static).
 """
 
+import inspect
 from typing import Any, Optional
 
 import jax
@@ -25,25 +35,44 @@ import jax.numpy as jnp
 from d9d_tpu.core.types import Array
 
 
+def _nucleus_filter(logits: Array, top_p: float) -> Array:
+    """Mask logits outside the smallest cumulative-``top_p`` set to -inf
+    (the most probable token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while the cumulative mass BEFORE them is < top_p
+    keep_sorted = (cum - probs) < top_p
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= cutoff, logits, -jnp.inf)
+
+
 def generate(
     model,
     params: Any,
     prompt_ids: Array,
     *,
     max_new_tokens: int,
+    prompt_lengths: Optional[Array] = None,
     temperature: float = 0.0,
+    top_p: float | None = None,
     rng: Optional[jax.Array] = None,
     eos_id: int | None = None,
 ) -> Array:
     """``prompt_ids [B, P]`` int32 → generated ids ``[B, max_new_tokens]``.
 
-    ``model`` must be built with ``decode_max_length >= P + max_new_tokens``
-    (its KV caches are that static length). The whole prefill + decode
-    scan jits as one program; call under ``jax.jit`` for repeat use —
-    retracing only happens when shapes change.
+    ``model`` must be built with
+    ``decode_max_length >= P + max_new_tokens - 1`` (the final sampled
+    token is returned, never fed back). Ragged batches: left-pad to width
+    P and pass ``prompt_lengths [B]``. The whole prefill + decode scan
+    jits as one program; call under ``jax.jit`` for repeat use.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     dml = getattr(model, "decode_max_length", 0)
     b, p = prompt_ids.shape
     # the final sampled token is returned, never fed back, so the cache
@@ -58,23 +87,52 @@ def generate(
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_p is not None and top_p < 1.0:
+            scaled = _nucleus_filter(scaled, top_p)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    # per-row geometry: row i's real tokens sit right-aligned in
+    # [pad_i, P) with pad_i = P - L_i; logical positions are 0..L_i-1
+    if prompt_lengths is not None:
+        lengths = prompt_lengths.astype(jnp.int32)
+        pad = p - lengths  # [B]
+        positions = jnp.maximum(
+            jnp.arange(p, dtype=jnp.int32)[None, :] - pad[:, None], 0
+        )
+        key_valid = (
+            jnp.arange(dml, dtype=jnp.int32)[None, :] >= pad[:, None]
+        )[:, None, None, :]  # [B,1,1,S_max]; decode slots (>= P) valid
+        pad_mask = (
+            jnp.arange(p, dtype=jnp.int32)[None, :] >= pad[:, None]
+        )  # [B, P] real-token mask for GDN layers
+    else:
+        lengths = jnp.full((b,), p, jnp.int32)
+        positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+        key_valid = None
+        pad_mask = None
+
+    prefill_method = getattr(model, "logits_last", None) or model.logits
+    accepts_padding = "padding_mask" in inspect.signature(
+        prefill_method
+    ).parameters
+
+    def call(variables, ids, pos, padding_mask):
+        # logits_last == logits at t=1, so one method serves both phases
+        kwargs = {"mask": key_valid}
+        if accepts_padding:
+            kwargs["padding_mask"] = padding_mask
+        return model.apply(
+            variables, ids, pos,
+            method=prefill_method,
+            mutable=["cache"],
+            **kwargs,
+        )
 
     # prefill: run the whole prompt once, writing every layer's cache;
-    # only the last position's logits are needed, so use the
-    # head-on-one-row method when the model provides it
-    positions = jnp.broadcast_to(
-        jnp.arange(p, dtype=jnp.int32), (b, p)
-    )
-    prefill_method = getattr(model, "logits_last", None) or model.logits
-    logits, state = model.apply(
-        {"params": params},
-        prompt_ids.astype(jnp.int32),
-        positions,
-        method=prefill_method,
-        mutable=["cache"],
+    # only the last position's logits are needed (logits_last fast path)
+    logits, state = call(
+        {"params": params}, prompt_ids.astype(jnp.int32), positions, pad_mask
     )
     key, sub = jax.random.split(rng)
     token = sample(logits[:, -1], sub)
@@ -83,15 +141,16 @@ def generate(
         else jnp.zeros((b,), jnp.bool_)
     )
 
+    step_pad = (
+        jnp.ones((b, 1), jnp.bool_) if accepts_padding else None
+    )
+
     def step(carry, _):
         cache, tok, pos, key, dn = carry
         key, sub = jax.random.split(key)
-        logits_t, new_cache = model.apply(
+        logits_t, new_cache = call(
             {"params": params, "cache": cache},
-            tok[:, None],
-            jnp.full((b, 1), pos, jnp.int32),
-            method=model.logits,
-            mutable=["cache"],
+            tok[:, None], pos[:, None], step_pad,
         )
         nxt = sample(logits_t[:, -1], sub)
         if eos_id is not None:
@@ -101,7 +160,7 @@ def generate(
 
     if max_new_tokens == 1:
         return token[:, None]
-    carry = (state["cache"], token, jnp.int32(p), key, done)
+    carry = (state["cache"], token, lengths, key, done)
     _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
     # prefill sampled the first generated token; each scan step sampled
     # the next one

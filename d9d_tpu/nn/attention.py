@@ -218,16 +218,26 @@ class GroupedQueryAttention(nn.Module):
         this module cannot check it; past the end, ``dynamic_update_slice``
         clamps and outputs silently degrade (loop/generate.py enforces the
         bound statically up front).
+
+        Masking contract: decode accepts only a 4D mask broadcastable to
+        ``[B, Hq, T, decode_max_length]`` whose key axis indexes CACHE
+        SLOTS (loop/generate.py passes ``[B, 1, 1, S_max]`` key-validity
+        for left-padded ragged prompts; slot order equals time order per
+        row, so causality stays slot-based). 2D/3D token-position masks
+        are rejected — their shape can coincide with the slot layout and
+        silently mean the wrong thing.
         """
         from jax import lax
 
         from d9d_tpu.ops.attention.eager import eager_sdpa
 
-        if mask is not None:
+        if mask is not None and (
+            mask.ndim != 4 or mask.shape[-1] != self.decode_max_length
+        ):
             raise NotImplementedError(
-                "explicit attention masks are not supported in decode mode "
-                "(the cache layout can't express a caller mask built for "
-                "the prompt length); decode unpadded prompts"
+                "decode mode accepts only a 4D [B, Hq, T, "
+                "decode_max_length] cache-slot mask (loop/generate.py's "
+                f"key-validity form); got shape {mask.shape}"
             )
         s_max, hkv, d = self.decode_max_length, self.num_kv_heads, self.head_dim
         ck = self.variable(
@@ -253,15 +263,17 @@ class GroupedQueryAttention(nn.Module):
         # written prefix, causally up to the query's own position
         q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
         k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
-        dec_mask = k_pos <= q_abs  # [t, S_max]
+        dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, S_max]
         if self.window_size is not None:
-            dec_mask &= k_pos > q_abs - self.window_size
+            dec_mask &= (k_pos > q_abs - self.window_size)[None, None]
+        if mask is not None:  # 4D cache-slot mask (padded slots False)
+            dec_mask = dec_mask & mask
         return eager_sdpa(
             q, ck.value, cv.value,
             causal=False,
             softmax_scale=self.softmax_scale,
             sinks=sinks,
-            mask=dec_mask[None, None],
+            mask=dec_mask,
         )
 
 

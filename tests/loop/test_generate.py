@@ -196,6 +196,89 @@ class TestDecodeParity:
             assert (np.asarray(out[:, j]) == np.asarray(nxt)).all(), j
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
 
+    def test_ragged_prompts_match_per_row_unpadded(self):
+        """Left-padded batch + prompt_lengths must generate exactly what
+        each row generates alone, unpadded (rope positions and the
+        key-validity mask make pads invisible)."""
+        full, dec, params = _models(decode_max_length=20)
+        rng = np.random.default_rng(5)
+        rows = [
+            jnp.asarray(rng.integers(0, VOCAB, (1, 4)), jnp.int32),
+            jnp.asarray(rng.integers(0, VOCAB, (1, 7)), jnp.int32),
+        ]
+        want = [
+            np.asarray(generate(dec, params, r, max_new_tokens=6))
+            for r in rows
+        ]
+
+        p = 7
+        padded = jnp.concatenate(
+            [
+                jnp.pad(rows[0], ((0, 0), (p - 4, 0))),
+                rows[1],
+            ],
+            axis=0,
+        )
+        got = np.asarray(
+            generate(
+                dec, params, padded, max_new_tokens=6,
+                prompt_lengths=jnp.asarray([4, 7], jnp.int32),
+            )
+        )
+        np.testing.assert_array_equal(got[0], want[0][0])
+        np.testing.assert_array_equal(got[1], want[1][0])
+
+    def test_ragged_prompts_hybrid(self):
+        """Same ragged contract through the GDN hybrid (padding_mask
+        threads to the linear-attention layers)."""
+        from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+
+        cfg = Qwen3MoeConfig.hybrid_tiny(VOCAB)
+        dec = Qwen3MoeCausalLM(
+            config=cfg, sdpa=eager_sdpa, dtype=jnp.float32,
+            decode_max_length=20,
+        )
+        b, t = 2, 8
+        z = jnp.zeros((b, t), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        params = dec.init(jax.random.PRNGKey(6), z, pos, z)["params"]
+
+        rng = np.random.default_rng(8)
+        short = jnp.asarray(rng.integers(0, VOCAB, (1, 3)), jnp.int32)
+        long = jnp.asarray(rng.integers(0, VOCAB, (1, 6)), jnp.int32)
+        want_short = np.asarray(
+            generate(dec, params, short, max_new_tokens=5)
+        )
+        want_long = np.asarray(generate(dec, params, long, max_new_tokens=5))
+        padded = jnp.concatenate(
+            [jnp.pad(short, ((0, 0), (3, 0))), long], axis=0
+        )
+        got = np.asarray(
+            generate(
+                dec, params, padded, max_new_tokens=5,
+                prompt_lengths=jnp.asarray([3, 6], jnp.int32),
+            )
+        )
+        np.testing.assert_array_equal(got[0], want_short[0])
+        np.testing.assert_array_equal(got[1], want_long[0])
+
+    def test_top_p_sampling(self):
+        _, dec, params = _models(decode_max_length=16)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        a = generate(dec, params, prompt, max_new_tokens=6,
+                     temperature=0.8, top_p=0.9,
+                     rng=jax.random.PRNGKey(11))
+        b = generate(dec, params, prompt, max_new_tokens=6,
+                     temperature=0.8, top_p=0.9,
+                     rng=jax.random.PRNGKey(11))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # top_p -> 0 collapses to greedy (only the argmax survives)
+        tiny_p = generate(dec, params, prompt, max_new_tokens=6,
+                          temperature=0.8, top_p=1e-6,
+                          rng=jax.random.PRNGKey(12))
+        greedy = generate(dec, params, prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(greedy))
+
     def test_generate_with_sharded_params(self, devices):
         """Generation under a mesh: FSDP-sharded params + jitted decode
         must reproduce the single-device greedy sequence (the multi-chip
