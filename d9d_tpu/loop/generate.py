@@ -73,6 +73,11 @@ def generate(
         raise ValueError("temperature > 0 needs an rng key")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p is not None and temperature == 0.0:
+        raise ValueError(
+            "top_p has no effect with temperature=0 (greedy argmax); "
+            "set a temperature to sample"
+        )
     dml = getattr(model, "decode_max_length", 0)
     b, p = prompt_ids.shape
     # the final sampled token is returned, never fed back, so the cache

@@ -209,6 +209,48 @@ class CorpusProvider(DatasetProvider):
 # ----------------------
 
 
+def build_model_config(
+    c: ModelConfig,
+    *,
+    ep_axes=None,
+    moe_token_axes=None,
+    remat: bool | None = None,
+) -> Qwen3MoeConfig:
+    """ModelConfig (JSON schema) -> Qwen3MoeConfig — the ONE mapping both
+    pretrain.py and generate.py use, so an exported checkpoint's parameter
+    structure always matches what generate.py rebuilds (e.g. fused_qkv)."""
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", c.vocab_size),),
+        hidden_size=c.hidden_size,
+        num_layers=c.num_layers,
+        num_heads=c.num_heads,
+        num_kv_heads=c.num_kv_heads,
+        head_dim=c.head_dim,
+        moe_intermediate_size=c.moe_intermediate_size,
+        num_experts=c.num_experts,
+        num_experts_per_tok=c.num_experts_per_tok,
+        remat=c.remat if remat is None else remat,
+        fused_qkv=c.fused_qkv,
+        linear_attention_layers=tuple(c.linear_attention_layers),
+        use_output_gate=c.use_output_gate,
+        rope_fraction=c.rope_fraction,
+        zero_centered_norms=c.zero_centered_norms,
+        gdn_qk_heads=c.gdn_qk_heads,
+        gdn_v_heads=c.gdn_v_heads,
+        gdn_head_qk_dim=c.gdn_head_qk_dim,
+        gdn_head_v_dim=c.gdn_head_v_dim,
+        gdn_conv_size=c.gdn_conv_size,
+        shared_expert=SharedExpertParameters(
+            intermediate_size=c.shared_expert_intermediate_size,
+            enable_gate=c.shared_expert_gate,
+        )
+        if c.shared_expert_intermediate_size > 0
+        else None,
+        ep_axes=ep_axes,
+        moe_token_axes=moe_token_axes,
+    )
+
+
 class MoEProvider(ModelProvider):
     def __init__(self, cfg: ModelConfig, ctx):
         self.cfg = cfg
@@ -217,33 +259,8 @@ class MoEProvider(ModelProvider):
     def build_module(self, stage):
         c = self.cfg
         return Qwen3MoeCausalLM(
-            config=Qwen3MoeConfig(
-                vocab_ranges=(("default", c.vocab_size),),
-                hidden_size=c.hidden_size,
-                num_layers=c.num_layers,
-                num_heads=c.num_heads,
-                num_kv_heads=c.num_kv_heads,
-                head_dim=c.head_dim,
-                moe_intermediate_size=c.moe_intermediate_size,
-                num_experts=c.num_experts,
-                num_experts_per_tok=c.num_experts_per_tok,
-                remat=c.remat,
-                fused_qkv=c.fused_qkv,
-                linear_attention_layers=tuple(c.linear_attention_layers),
-                use_output_gate=c.use_output_gate,
-                rope_fraction=c.rope_fraction,
-                zero_centered_norms=c.zero_centered_norms,
-                gdn_qk_heads=c.gdn_qk_heads,
-                gdn_v_heads=c.gdn_v_heads,
-                gdn_head_qk_dim=c.gdn_head_qk_dim,
-                gdn_head_v_dim=c.gdn_head_v_dim,
-                gdn_conv_size=c.gdn_conv_size,
-                shared_expert=SharedExpertParameters(
-                    intermediate_size=c.shared_expert_intermediate_size,
-                    enable_gate=c.shared_expert_gate,
-                )
-                if c.shared_expert_intermediate_size > 0
-                else None,
+            config=build_model_config(
+                c,
                 ep_axes=self.ctx.ep_shard_axes,
                 # ride the residual layout through the EP dispatch (no
                 # boundary reshard; see MoELayer.token_axes)

@@ -278,6 +278,9 @@ class TestDecodeParity:
                           rng=jax.random.PRNGKey(12))
         greedy = generate(dec, params, prompt, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(greedy))
+        # top_p without a temperature is a silent no-op -> rejected loudly
+        with pytest.raises(ValueError, match="top_p has no effect"):
+            generate(dec, params, prompt, max_new_tokens=2, top_p=0.9)
 
     def test_generate_with_sharded_params(self, devices):
         """Generation under a mesh: FSDP-sharded params + jitted decode
