@@ -21,6 +21,7 @@ TPU-native design:
 """
 
 import dataclasses
+import os
 from typing import Optional
 
 import flax.linen as nn
@@ -182,20 +183,25 @@ def grouped_swiglu_apply(
     Caveat (ADVICE r3): because ragged_dot is an opaque custom call, XLA
     materializes the concatenated weight copy each forward (again in the
     backward under remat) — one extra full-weight write+read per MoE layer
-    per microbatch. Measured a net win at the swept config (64E × i256);
-    re-check at flagship expert counts on the next chip window
-    (run_tpu_benches.sh) and pre-concatenate once per step outside the
-    microbatch path if it inverts.
+    per microbatch. Measured a net win at the r3-swept config (64E × i256,
+    bf16), but tools/roofline.py predicts the copy INVERTS at µBS=1 with
+    fp32 master weights (the concat becomes the largest single HBM term);
+    ``D9D_TPU_MOE_FUSED_GATE_UP=0`` switches to two grouped matmuls for
+    the on-chip A/B (run_tpu_benches.sh).
     """
     x = permuted_x.astype(dtype)
     inter = gate_w.shape[-1]
-    gate_up_w = jnp.concatenate(
-        [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
-    )
-    down_w = down_w.astype(dtype)
-    h_gu = grouped_matmul(x, gate_up_w, group_sizes)  # [M, 2*inter]
-    hidden = silu_mul(h_gu[..., :inter], h_gu[..., inter:])
-    out = grouped_matmul(hidden, down_w, group_sizes)
+    if os.environ.get("D9D_TPU_MOE_FUSED_GATE_UP", "1") == "1":
+        gate_up_w = jnp.concatenate(
+            [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
+        )
+        h_gu = grouped_matmul(x, gate_up_w, group_sizes)  # [M, 2*inter]
+        g, u = h_gu[..., :inter], h_gu[..., inter:]
+    else:
+        g = grouped_matmul(x, gate_w.astype(dtype), group_sizes)
+        u = grouped_matmul(x, up_w.astype(dtype), group_sizes)
+    hidden = silu_mul(g, u)
+    out = grouped_matmul(hidden, down_w.astype(dtype), group_sizes)
     return out * permuted_probs[:, None].astype(dtype)
 
 

@@ -167,6 +167,38 @@ class TestFusedParity:
         )
 
 
+def test_unfused_gate_up_env_knob_exact(monkeypatch):
+    """D9D_TPU_MOE_FUSED_GATE_UP=0 (two grouped matmuls, no runtime
+    weight concat — the ub1/fp32 A/B tools/roofline.py motivates) must be
+    numerically identical to the fused default."""
+    import jax.numpy as jnp
+
+    from d9d_tpu.nn.moe import grouped_swiglu_apply
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(48, 32), jnp.float32)
+    wg = jnp.asarray(rng.randn(4, 32, 16) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.randn(4, 32, 16) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.randn(4, 16, 32) * 0.1, jnp.float32)
+    ids = jnp.asarray(rng.randint(0, 4, (48, 2)), jnp.int32)
+    probs = jnp.asarray(rng.rand(48, 2), jnp.float32)
+    sort = sort_tokens_by_expert(ids, 4)
+    px, pp = permute_tokens(x, probs, sort)
+
+    # pin the fused default so a leaked env var can't make this vacuous
+    monkeypatch.setenv("D9D_TPU_MOE_FUSED_GATE_UP", "1")
+    fused = grouped_swiglu_apply(
+        px, pp, sort.group_sizes, wg, wu, wd, jnp.float32
+    )
+    monkeypatch.setenv("D9D_TPU_MOE_FUSED_GATE_UP", "0")
+    unfused = grouped_swiglu_apply(
+        px, pp, sort.group_sizes, wg, wu, wd, jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(unfused), np.asarray(fused), rtol=1e-6, atol=1e-6
+    )
+
+
 class TestLayerIntegration:
     def test_moe_layer_env_switch(self, monkeypatch):
         """MoELayer output is identical (to tolerance) with the pallas

@@ -107,6 +107,17 @@ r["detail"]["variant"] = "ub1_pallas_fused_ffn"
 print(json.dumps(r))
 EOF
 
+# A/B: gate+up WITHOUT the runtime weight concat (tools/roofline.py
+# predicts the concat copy inverts the r3 fusion win at ub1/fp32)
+D9D_TPU_MOE_FUSED_GATE_UP=0 run_leg "MoE ub1 unfused gate+up" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub1_unfused_gate_up"
+print(json.dumps(r))
+EOF
+
 # µBS sweep with bf16 master weights + stochastic AdamW (any ub>1).
 # tools/roofline.py predicts ub2 -> MFU 0.235 and ub4 -> 0.272 (clears
 # the 0.25 target) IF ub4 fits HBM — a leg that OOMs records the failure
