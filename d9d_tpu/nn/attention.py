@@ -19,6 +19,63 @@ from d9d_tpu.nn.sdpa.protocol import SdpaBackend
 from d9d_tpu.ops import RopeStyle, apply_rope
 
 
+def _decode_cache_index(module: nn.Module):
+    """The module's single decode write-index variable (declare once per
+    trace — flax forbids re-declaring a name within one __call__)."""
+    return module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+    )
+
+
+def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
+                         start):
+    """Append ``value [B, T, ...]`` at cache slot ``start``.
+
+    One definition for every decode cache (GQA k/v, MLA latent/rope key).
+    Capacity contract: callers must never feed more than ``s_max`` total
+    tokens — the write index is traced, so this cannot be checked here;
+    past the end ``dynamic_update_slice`` clamps and outputs silently
+    degrade (loop/generate.py enforces the bound statically up front).
+    Returns the full cache buffer.
+    """
+    from jax import lax
+
+    b = value.shape[0]
+    ref = module.variable(
+        "cache", name,
+        lambda: jnp.zeros((b, s_max) + value.shape[2:], value.dtype),
+    )
+    ref.value = lax.dynamic_update_slice(
+        ref.value, value, (0, start) + (0,) * (value.ndim - 2)
+    )
+    return ref.value
+
+
+def _decode_slot_mask(start, t: int, s_max: int, window_size, mask):
+    """Slot-based causal (+window, +caller) mask for decode attention.
+
+    The caller mask must be 4D broadcastable to ``[B, Hq, T, s_max]`` with
+    the key axis indexing CACHE SLOTS (loop/generate.py passes
+    ``[B, 1, 1, S_max]`` key-validity for left-padded ragged prompts; slot
+    order equals time order per row, so causality stays slot-based).
+    2D/3D token-position masks are rejected — their shape can coincide
+    with the slot layout and silently mean the wrong thing.
+    """
+    if mask is not None and (mask.ndim != 4 or mask.shape[-1] != s_max):
+        raise NotImplementedError(
+            "decode mode accepts only a 4D [B, Hq, T, decode_max_length] "
+            f"cache-slot mask (loop/generate.py's form); got {mask.shape}"
+        )
+    q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
+    k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+    dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, s_max]
+    if window_size is not None:
+        dec_mask &= (k_pos > q_abs - window_size)[None, None]
+    if mask is not None:
+        dec_mask = dec_mask & mask
+    return dec_mask
+
+
 class _ProjKernel(nn.Module):
     """Declare a Dense-compatible kernel (``<name>/kernel``, shape
     ``[in, features]``, lecun-normal, logical axes) and return it raw —
@@ -211,69 +268,28 @@ class GroupedQueryAttention(nn.Module):
         attend against the full static-length cache with a validity+causal
         mask (the eager oracle handles cross-length attention + sinks +
         window; decode throughput is cache-bandwidth-bound, so the eager
-        path is the right backend here — no flash tiling to win).
-
-        Capacity contract: callers must never feed more than
-        ``decode_max_length`` total tokens — the write index is traced, so
-        this module cannot check it; past the end, ``dynamic_update_slice``
-        clamps and outputs silently degrade (loop/generate.py enforces the
-        bound statically up front).
-
-        Masking contract: decode accepts only a 4D mask broadcastable to
-        ``[B, Hq, T, decode_max_length]`` whose key axis indexes CACHE
-        SLOTS (loop/generate.py passes ``[B, 1, 1, S_max]`` key-validity
-        for left-padded ragged prompts; slot order equals time order per
-        row, so causality stays slot-based). 2D/3D token-position masks
-        are rejected — their shape can coincide with the slot layout and
-        silently mean the wrong thing.
+        path is the right backend here — no flash tiling to win). Cache
+        mechanics + capacity/mask contracts: the module-level
+        ``_decode_cache_append`` / ``_decode_slot_mask`` helpers.
         """
-        from jax import lax
-
         from d9d_tpu.ops.attention.eager import eager_sdpa
 
-        if mask is not None and (
-            mask.ndim != 4 or mask.shape[-1] != self.decode_max_length
-        ):
-            raise NotImplementedError(
-                "decode mode accepts only a 4D [B, Hq, T, "
-                "decode_max_length] cache-slot mask (loop/generate.py's "
-                f"key-validity form); got shape {mask.shape}"
-            )
-        s_max, hkv, d = self.decode_max_length, self.num_kv_heads, self.head_dim
-        ck = self.variable(
-            "cache", "cached_key",
-            lambda: jnp.zeros((b, s_max, hkv, d), self.dtype),
-        )
-        cv = self.variable(
-            "cache", "cached_value",
-            lambda: jnp.zeros((b, s_max, hkv, d), self.dtype),
-        )
-        idx = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-        )
+        s_max = self.decode_max_length
+        idx = _decode_cache_index(self)
         start = idx.value
-        ck.value = lax.dynamic_update_slice(
-            ck.value, k.astype(self.dtype), (0, start, 0, 0)
+        keys = _decode_cache_append(
+            self, k.astype(self.dtype), "cached_key", s_max, start
         )
-        cv.value = lax.dynamic_update_slice(
-            cv.value, v.astype(self.dtype), (0, start, 0, 0)
+        values = _decode_cache_append(
+            self, v.astype(self.dtype), "cached_value", s_max, start
         )
         idx.value = start + t
-        # query i sits at absolute position start + i; valid keys are the
-        # written prefix, causally up to the query's own position
-        q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
-        k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
-        dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, S_max]
-        if self.window_size is not None:
-            dec_mask &= (k_pos > q_abs - self.window_size)[None, None]
-        if mask is not None:  # 4D cache-slot mask (padded slots False)
-            dec_mask = dec_mask & mask
         return eager_sdpa(
-            q, ck.value, cv.value,
+            q, keys, values,
             causal=False,
             softmax_scale=self.softmax_scale,
             sinks=sinks,
-            mask=dec_mask,
+            mask=_decode_slot_mask(start, t, s_max, self.window_size, mask),
         )
 
 
@@ -327,6 +343,13 @@ class MultiHeadLatentAttention(nn.Module):
     q_lora_rank: int | None = None
     norm_eps: float = 1e-6
     rope_style: RopeStyle = RopeStyle.HALF
+    # Latent-cache decode mode when > 0 (MLA's inference advantage: the
+    # cache holds kv_lora_rank + qk_rope_head_dim floats per token — the
+    # compressed latent plus the shared rotated rope key — instead of
+    # num_heads*(d_nope+d_v); decompression through kv_up_proj runs per
+    # step. The absorbed form (folding kv_up into q/o) would remove the
+    # per-step decompression; future work, noted in docs.
+    decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -383,24 +406,65 @@ class MultiHeadLatentAttention(nn.Module):
         c_kv, k_rope = kv[..., : self.kv_lora_rank], kv[..., self.kv_lora_rank:]
         c_kv = RMSNorm(self.kv_lora_rank, eps=self.norm_eps,
                        name="kv_down_norm", param_dtype=self.param_dtype)(c_kv)
-        kv_up = proj(h * (d_nope + d_v), "kv_up_proj", (None, la.HEADS))(c_kv)
-        kv_up = kv_up.reshape(b, t, h, d_nope + d_v)
+        # rotate the shared rope key at ITS OWN positions before any
+        # caching (write-time rope, like the KV cache's rotated keys)
+        k_rope = apply_rope(
+            k_rope[:, :, None, :], cos[..., : d_rope // 2],
+            sin[..., : d_rope // 2], self.rope_style,
+        )[:, :, 0, :]
+
+        kv_up_proj = proj(h * (d_nope + d_v), "kv_up_proj", (None, la.HEADS))
+
+        if self.decode_max_length > 0:
+            s_max = self.decode_max_length
+            idx = _decode_cache_index(self)
+            start = idx.value
+            c_kv = _decode_cache_append(
+                self, c_kv.astype(self.dtype), "cached_latent", s_max, start
+            )
+            k_rope = _decode_cache_append(
+                self, k_rope.astype(self.dtype), "cached_rope_key", s_max,
+                start,
+            )
+            idx.value = start + t
+            # decompress the whole cached latent for this step (the
+            # absorbed form would avoid this; see decode_max_length note)
+            s_len = s_max
+        else:
+            s_len = t
+
+        kv_up = kv_up_proj(c_kv).reshape(b, s_len, h, d_nope + d_v)
         k_nope, v = kv_up[..., :d_nope], kv_up[..., d_nope:]
 
         # single-head rope key broadcast to every head (MQA-style)
-        k_rope = jnp.broadcast_to(k_rope[:, :, None, :], (b, t, h, d_rope))
-        k_rope = apply_rope(k_rope, cos[..., : d_rope // 2],
-                            sin[..., : d_rope // 2], self.rope_style)
-        k = jnp.concatenate([k_nope, k_rope], axis=-1)
+        k = jnp.concatenate(
+            [
+                k_nope,
+                jnp.broadcast_to(
+                    k_rope[:, :, None, :], (b, s_len, h, d_rope)
+                ).astype(k_nope.dtype),
+            ],
+            axis=-1,
+        )
 
         # pad V: softmax(QKᵀ)·[V|0] = [out|0] (reference :199-207)
         pad = d_qk - d_v
         if pad > 0:
             v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
 
-        out = self.sdpa(
-            q, k, v, causal=True, softmax_scale=d_qk**-0.5, mask=mask
-        )
+        if self.decode_max_length > 0:
+            from d9d_tpu.ops.attention.eager import eager_sdpa
+
+            out = eager_sdpa(
+                q, k, v, causal=False, softmax_scale=d_qk**-0.5,
+                mask=_decode_slot_mask(
+                    start, t, self.decode_max_length, None, mask
+                ),
+            )
+        else:
+            out = self.sdpa(
+                q, k, v, causal=True, softmax_scale=d_qk**-0.5, mask=mask
+            )
         out = checkpoint_name(out, "sdpa_out")
         if pad > 0:
             out = out[..., :d_v]

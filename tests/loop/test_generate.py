@@ -292,12 +292,14 @@ class TestDecodeParity:
         from d9d_tpu.loop import init_sharded_params
         from d9d_tpu.parallel import fsdp_plan
 
+        # build() installs the mesh ambiently ("most recently built wins");
+        # do it FIRST so every array in this test is created under it — a
+        # prior test's leaked mesh (e.g. the MLA ring tests' 4-device one)
+        # must not own the reference arrays
+        ctx = MeshParameters(dp_shard=8).build()
         full, dec, params = _models(decode_max_length=16)
         prompt = jnp.asarray([[3, 1, 4, 1], [5, 9, 2, 6]], jnp.int32)
         want = np.asarray(generate(dec, params, prompt, max_new_tokens=8))
-
-        # build() installs the mesh ambiently ("most recently built wins")
-        ctx = MeshParameters(dp_shard=8).build()
         z = jnp.zeros((2, 8), jnp.int32)
         pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
         sharded, _ = init_sharded_params(

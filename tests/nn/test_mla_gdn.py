@@ -61,6 +61,55 @@ class TestMLA:
             np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
         )
 
+    def test_latent_cache_decode_matches_full_forward(self):
+        """MLA decode caches (latent, rotated rope key) per token; prefill
+        + teacher-forced single-token steps must reproduce the full
+        forward at every position."""
+        b, t, p = 2, 12, 8
+        full = self._block()
+        dec = MultiHeadLatentAttention(
+            hidden_size=64,
+            num_heads=4,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=12,
+            kv_lora_rank=32,
+            sdpa=eager_sdpa,
+            dtype=jnp.float32,
+            decode_max_length=16,
+        )
+        x = jax.random.normal(jax.random.PRNGKey(3), (b, t, 64))
+        cos, sin = _rope(b, t, 8)
+        params = full.init(jax.random.PRNGKey(1), x, cos, sin)
+        want = full.apply(params, x, cos, sin)
+
+        got_pre, state = dec.apply(
+            params, x[:, :p], cos[:, :p], sin[:, :p], mutable=["cache"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_pre), np.asarray(want[:, :p]),
+            rtol=2e-5, atol=2e-5,
+        )
+        cache = state["cache"]
+        for i in range(p, t):
+            got_i, state = dec.apply(
+                {**params, "cache": cache},
+                x[:, i : i + 1], cos[:, i : i + 1], sin[:, i : i + 1],
+                mutable=["cache"],
+            )
+            cache = state["cache"]
+            np.testing.assert_allclose(
+                np.asarray(got_i[:, 0]), np.asarray(want[:, i]),
+                rtol=2e-5, atol=2e-5,
+            )
+        # the cache really is the compressed form: latent + rope key only
+        slot_bytes = sum(
+            np.prod(v.shape[2:])
+            for k, v in cache.items()
+            if k.startswith("cached")
+        )
+        assert slot_bytes == 32 + 8  # kv_lora_rank + d_rope per token
+
 
 class TestGatedDeltaNet:
     def _block(self, gate=DecayGateKind.mamba, hqk=2, hv=4):

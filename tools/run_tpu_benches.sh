@@ -108,8 +108,11 @@ print(json.dumps(r))
 EOF
 
 # A/B: gate+up WITHOUT the runtime weight concat (tools/roofline.py
-# predicts the concat copy inverts the r3 fusion win at ub1/fp32)
-D9D_TPU_MOE_FUSED_GATE_UP=0 run_leg "MoE ub1 unfused gate+up" \
+# predicts the concat copy inverts the r3 fusion win at ub1/fp32).
+# D9D_TPU_MOE_FFN pinned to xla: under the pallas backend the knob is
+# bypassed and the leg would silently time the wrong variant
+D9D_TPU_MOE_FUSED_GATE_UP=0 D9D_TPU_MOE_FFN=xla \
+  run_leg "MoE ub1 unfused gate+up" \
   bench_results/bench_sweep.jsonl python - <<'EOF'
 import json
 import bench
