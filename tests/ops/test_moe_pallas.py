@@ -167,6 +167,43 @@ class TestFusedParity:
         )
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_fused_parity_random_geometry(seed):
+    """Randomized geometry sweep: token counts not divisible by block_m,
+    skewed expert loads (including empty experts), k=1..3 — the aligned
+    layout must stay exact everywhere."""
+    rng = np.random.RandomState(100 + seed)
+    e = int(rng.choice([3, 5, 8, 13]))
+    k = int(rng.randint(1, min(4, e + 1)))
+    n = int(rng.randint(17, 140))
+    h = int(rng.choice([16, 48]))
+    inter = int(rng.choice([8, 24]))
+    block_m = int(rng.choice([8, 32]))
+    x = jnp.asarray(rng.randn(n, h), jnp.float32)
+    wg = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.randn(e, inter, h) * 0.1, jnp.float32)
+    # skewed routing: concentrate most tokens on few experts
+    hot = rng.choice(e, size=max(1, e // 3), replace=False)
+    ids_np = np.stack([
+        rng.choice(hot, size=k, replace=False)
+        if rng.rand() < 0.8 and len(hot) >= k
+        else rng.choice(e, size=k, replace=False)
+        for _ in range(n)
+    ])
+    ids = jnp.asarray(ids_np, jnp.int32)
+    probs = jnp.asarray(rng.rand(n, k) + 0.05, jnp.float32)
+    sort = sort_tokens_by_expert(ids, e)
+    ref = _reference(x, probs, sort, wg, wu, wd, jnp.float32)
+    got = fused_moe_ffn_apply(
+        x, probs, sort, wg, wu, wd, jnp.float32,
+        num_experts=e, block_m=block_m, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-5, atol=3e-5
+    )
+
+
 def test_unfused_gate_up_env_knob_exact(monkeypatch):
     """D9D_TPU_MOE_FUSED_GATE_UP=0 (two grouped matmuls, no runtime
     weight concat — the ub1/fp32 A/B tools/roofline.py motivates) must be
