@@ -183,11 +183,13 @@ def test_fused_parity_random_geometry(seed):
     wg = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.float32)
     wu = jnp.asarray(rng.randn(e, h, inter) * 0.1, jnp.float32)
     wd = jnp.asarray(rng.randn(e, inter, h) * 0.1, jnp.float32)
-    # skewed routing: concentrate most tokens on few experts
-    hot = rng.choice(e, size=max(1, e // 3), replace=False)
+    # skewed routing: concentrate most tokens on few experts (hot set at
+    # least k wide so the skew branch fires for EVERY seed — with
+    # hot < e, some experts also stay empty)
+    hot = rng.choice(e, size=max(k, e // 3), replace=False)
     ids_np = np.stack([
         rng.choice(hot, size=k, replace=False)
-        if rng.rand() < 0.8 and len(hot) >= k
+        if rng.rand() < 0.8
         else rng.choice(e, size=k, replace=False)
         for _ in range(n)
     ])
