@@ -9,6 +9,7 @@ covers Qwen3 (qk-norm), GPT-OSS-style sinks, and sliding-window models.
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
@@ -346,9 +347,9 @@ class MultiHeadLatentAttention(nn.Module):
     # Latent-cache decode mode when > 0 (MLA's inference advantage: the
     # cache holds kv_lora_rank + qk_rope_head_dim floats per token — the
     # compressed latent plus the shared rotated rope key — instead of
-    # num_heads*(d_nope+d_v); decompression through kv_up_proj runs per
-    # step. The absorbed form (folding kv_up into q/o) would remove the
-    # per-step decompression; future work, noted in docs.
+    # num_heads*(d_nope+d_v)). Single-token steps run the ABSORBED form
+    # (kv_up folded into the query/output sides, attention in rank space
+    # — no per-step decompression); prefill (t > 1) decompresses once.
     decode_max_length: int = 0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
@@ -413,7 +414,14 @@ class MultiHeadLatentAttention(nn.Module):
             sin[..., : d_rope // 2], self.rope_style,
         )[:, :, 0, :]
 
-        kv_up_proj = proj(h * (d_nope + d_v), "kv_up_proj", (None, la.HEADS))
+        # kernel declared raw (same "kv_up_proj/kernel" param path and init
+        # as the nn.Dense it replaces — checkpoints/mappers/plans are
+        # unchanged) so the absorbed decode path below can fold it into
+        # the query/output sides instead of decompressing the cache
+        kv_up_w = _ProjKernel(
+            features=h * (d_nope + d_v), axes=(None, la.HEADS),
+            param_dtype=self.param_dtype, name="kv_up_proj",
+        )(self.kv_lora_rank)
 
         if self.decode_max_length > 0:
             s_max = self.decode_max_length
@@ -427,13 +435,28 @@ class MultiHeadLatentAttention(nn.Module):
                 start,
             )
             idx.value = start + t
-            # decompress the whole cached latent for this step (the
-            # absorbed form would avoid this; see decode_max_length note)
+            dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
+            if t == 1:
+                # ABSORBED form (DeepSeek-V2 decode trick): fold W_up^K
+                # into the query and W_up^V into the output —
+                # q_nope^T (W_k c) == (W_k^T q_nope)^T c — so attention
+                # runs in rank space against the latent cache directly,
+                # with no per-step decompression of s_max slots
+                out = self._absorbed_attend(
+                    q_nope, q_rope, c_kv, k_rope, kv_up_w, dec_mask,
+                    d_qk, d_nope, d_v,
+                )
+                out = checkpoint_name(out, "sdpa_out")
+                return proj(self.hidden_size, "o_proj",
+                            (la.HEADS, la.EMBED))(out.reshape(b, t, h * d_v))
+            # prefill (t > 1): decompress once — compute-optimal there
             s_len = s_max
         else:
             s_len = t
 
-        kv_up = kv_up_proj(c_kv).reshape(b, s_len, h, d_nope + d_v)
+        kv_up = (
+            c_kv.astype(self.dtype) @ kv_up_w.astype(self.dtype)
+        ).reshape(b, s_len, h, d_nope + d_v)
         k_nope, v = kv_up[..., :d_nope], kv_up[..., d_nope:]
 
         # single-head rope key broadcast to every head (MQA-style)
@@ -457,9 +480,7 @@ class MultiHeadLatentAttention(nn.Module):
 
             out = eager_sdpa(
                 q, k, v, causal=False, softmax_scale=d_qk**-0.5,
-                mask=_decode_slot_mask(
-                    start, t, self.decode_max_length, None, mask
-                ),
+                mask=dec_mask,
             )
         else:
             out = self.sdpa(
@@ -470,3 +491,38 @@ class MultiHeadLatentAttention(nn.Module):
             out = out[..., :d_v]
         out = out.reshape(b, t, h * d_v)
         return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)
+
+    def _absorbed_attend(self, q_nope, q_rope, c, k_rope, w, dec_mask,
+                         d_qk, d_nope, d_v):
+        """Rank-space attention against the latent cache (fp32).
+
+        scores = (W_k^T q_nope)^T c + q_rope^T k_rope; the value side
+        stays latent until one final fold through W_v. Per step this
+        costs O(t·h·r·(d_nope+d_v)) absorption + O(t·h·s·r) attention
+        instead of decompressing all s_max slots through kv_up.
+        """
+        h = self.num_heads
+        r = self.kv_lora_rank
+        wk = w.astype(jnp.float32).reshape(r, h, d_nope + d_v)
+        wv = wk[..., d_nope:]
+        wk = wk[..., :d_nope]
+        qn = q_nope.astype(jnp.float32)
+        qr = q_rope.astype(jnp.float32)
+        cf = c.astype(jnp.float32)
+        rf = k_rope.astype(jnp.float32)
+        q_abs = jnp.einsum("bthd,rhd->bthr", qn, wk)
+        scores = (
+            jnp.einsum("bthr,bsr->bhts", q_abs, cf)
+            + jnp.einsum("bthd,bsd->bhts", qr, rf)
+        ) * (d_qk**-0.5)
+        neg_big = jnp.asarray(-1e30, scores.dtype)
+        scores = jnp.where(dec_mask, scores, neg_big)
+        # finite mask sentinel (not -inf): a fully-masked row must produce
+        # zeros like eager_sdpa's guarded softmax, not NaN
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(
+            jnp.any(dec_mask, axis=-1, keepdims=True), p, 0.0
+        )
+        out_lat = jnp.einsum("bhts,bsr->bthr", p, cf)
+        out = jnp.einsum("bthr,rhd->bthd", out_lat, wv)
+        return out.astype(self.dtype)
