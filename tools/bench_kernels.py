@@ -370,6 +370,63 @@ def bench_moe_ffn(tiny):
         emit_timed("moe_ffn_fwd_bwd", name, cfg, grad, x, probs, wg, wu, wd)
 
 
+def bench_mla_decode(tiny):
+    """MLA single-token decode: absorbed (rank-space) vs decompressed.
+
+    The absorbed form folds kv_up into q/o so each step skips
+    decompressing all cache slots; this times one decode step at a
+    DeepSeek-V2-ish geometry with a warm cache. 'decompressed' forces the
+    t=2 code path shape-wise via a 2-token step on the same cache (halved
+    for per-token comparability — documented approximation)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from d9d_tpu.nn.attention import MultiHeadLatentAttention
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+    from d9d_tpu.ops.rope import compute_rope_frequencies, make_rope_cos_sin
+
+    if tiny:
+        h, heads, d_nope, d_rope, d_v, rank, s_max, b = 64, 4, 16, 8, 12, 32, 32, 2
+    else:
+        h, heads, d_nope, d_rope, d_v, rank, s_max, b = (
+            2048, 16, 128, 64, 128, 512, 4096, 8
+        )
+    blk = MultiHeadLatentAttention(
+        hidden_size=h, num_heads=heads, qk_nope_head_dim=d_nope,
+        qk_rope_head_dim=d_rope, v_head_dim=d_v, kv_lora_rank=rank,
+        sdpa=eager_sdpa, dtype=jnp.bfloat16, decode_max_length=s_max,
+    )
+    inv, sc = compute_rope_frequencies(d_rope, 10000.0)
+    rng = np.random.RandomState(0)
+    prefill_t = s_max // 2
+    x = jnp.asarray(rng.randn(b, prefill_t, h), jnp.bfloat16)
+    pos = jnp.broadcast_to(jnp.arange(prefill_t), (b, prefill_t))
+    cos, sin = make_rope_cos_sin(pos, inv, sc, dtype=jnp.bfloat16)
+    params = blk.init(jax.random.PRNGKey(0), x, cos, sin)["params"]
+    _, state = blk.apply(
+        {"params": params}, x, cos, sin, mutable=["cache"]
+    )
+    cache = state["cache"]
+
+    def step(tokens_t):
+        t = tokens_t.shape[1]
+        p2 = jnp.broadcast_to(jnp.arange(prefill_t, prefill_t + t), (b, t))
+        c2, s2 = make_rope_cos_sin(p2, inv, sc, dtype=jnp.bfloat16)
+        out, _ = blk.apply(
+            {"params": params, "cache": cache}, tokens_t, c2, s2,
+            mutable=["cache"],
+        )
+        return out
+
+    one = jnp.asarray(rng.randn(b, 1, h), jnp.bfloat16)
+    two = jnp.asarray(rng.randn(b, 2, h), jnp.bfloat16)
+    cfg = f"h{h}_heads{heads}_r{rank}_s{s_max}_b{b}"
+    emit_timed("mla_decode_step", "absorbed_t1", cfg, jax.jit(step), one)
+    emit_timed("mla_decode_step", "decompressed_t2_halved", cfg,
+               jax.jit(step), two)
+
+
 def bench_stochastic(tiny):
     import jax
     import jax.numpy as jnp
@@ -396,7 +453,7 @@ def main():
     ap.add_argument(
         "--only",
         choices=["sdpa", "linear_ce", "elementwise", "gated_delta",
-                 "ring", "stochastic", "moe_ffn"],
+                 "ring", "stochastic", "moe_ffn", "mla_decode"],
         default=None,
     )
     args = ap.parse_args()
@@ -418,6 +475,7 @@ def main():
         "ring": bench_ring_blocks,
         "stochastic": bench_stochastic,
         "moe_ffn": bench_moe_ffn,
+        "mla_decode": bench_mla_decode,
     }
     for name, fn in benches.items():
         if args.only is None or args.only == name:
