@@ -21,9 +21,11 @@ receive the matching ``padding_mask`` when the model accepts one.
 
 Sampling: ``temperature=0`` is greedy argmax; otherwise
 ``jax.random.categorical`` over ``logits / temperature``, optionally
-truncated to the smallest set of tokens with cumulative probability
-``top_p`` (nucleus sampling). ``eos_id`` freezes finished rows (they keep
-emitting ``eos_id`` so shapes stay static).
+truncated to the ``top_k`` highest-probability tokens and/or the smallest
+set with cumulative probability ``top_p`` (nucleus sampling; both given =
+top-k first, then nucleus over the survivors — the HF composition order).
+``eos_id`` freezes finished rows (they keep emitting ``eos_id`` so shapes
+stay static).
 """
 
 import inspect
@@ -33,6 +35,13 @@ import jax
 import jax.numpy as jnp
 
 from d9d_tpu.core.types import Array
+
+
+def _top_k_filter(logits: Array, top_k: int) -> Array:
+    """Mask logits below the k-th largest to -inf (lax.top_k selection —
+    no full-vocab sort inside the per-token decode step)."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
 
 
 def _nucleus_filter(logits: Array, top_p: float) -> Array:
@@ -58,6 +67,7 @@ def generate(
     prompt_lengths: Optional[Array] = None,
     temperature: float = 0.0,
     top_p: float | None = None,
+    top_k: int | None = None,
     rng: Optional[jax.Array] = None,
     eos_id: int | None = None,
 ) -> Array:
@@ -73,10 +83,12 @@ def generate(
         raise ValueError("temperature > 0 needs an rng key")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_p is not None and temperature == 0.0:
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if (top_p is not None or top_k is not None) and temperature == 0.0:
         raise ValueError(
-            "top_p has no effect with temperature=0 (greedy argmax); "
-            "set a temperature to sample"
+            "top_p/top_k have no effect with temperature=0 (greedy "
+            "argmax); set a temperature to sample"
         )
     dml = getattr(model, "decode_max_length", 0)
     b, p = prompt_ids.shape
@@ -93,6 +105,8 @@ def generate(
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = logits.astype(jnp.float32) / temperature
+        if top_k is not None and top_k < logits.shape[-1]:
+            scaled = _top_k_filter(scaled, top_k)
         if top_p is not None and top_p < 1.0:
             scaled = _nucleus_filter(scaled, top_p)
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

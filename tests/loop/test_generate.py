@@ -278,9 +278,26 @@ class TestDecodeParity:
                           rng=jax.random.PRNGKey(12))
         greedy = generate(dec, params, prompt, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(greedy))
-        # top_p without a temperature is a silent no-op -> rejected loudly
-        with pytest.raises(ValueError, match="top_p has no effect"):
+        # filters without a temperature are a silent no-op -> rejected
+        with pytest.raises(ValueError, match="have no effect"):
             generate(dec, params, prompt, max_new_tokens=2, top_p=0.9)
+        with pytest.raises(ValueError, match="have no effect"):
+            generate(dec, params, prompt, max_new_tokens=2, top_k=5)
+
+    def test_top_k_sampling(self):
+        _, dec, params = _models(decode_max_length=16)
+        prompt = jnp.ones((2, 4), jnp.int32)
+        # top_k=1 collapses to greedy regardless of temperature
+        k1 = generate(dec, params, prompt, max_new_tokens=6,
+                      temperature=1.2, top_k=1, rng=jax.random.PRNGKey(5))
+        greedy = generate(dec, params, prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+        # reproducible under a fixed key
+        a = generate(dec, params, prompt, max_new_tokens=6,
+                     temperature=0.8, top_k=8, rng=jax.random.PRNGKey(6))
+        b = generate(dec, params, prompt, max_new_tokens=6,
+                     temperature=0.8, top_k=8, rng=jax.random.PRNGKey(6))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_generate_with_sharded_params(self, devices):
         """Generation under a mesh: FSDP-sharded params + jitted decode

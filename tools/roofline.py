@@ -316,6 +316,40 @@ def _gdn_layer(inv, n, h, qk_heads, v_heads, dk, dv, dtype_b, passes,
     )
 
 
+def decode_scenario():
+    """run_bench_generate geometry: greedy KV-cache decode on the dense
+    256M model (batch 8). Decode is weight-stream-bound: every step
+    streams ALL params — at fp32 width, because run_bench_generate only
+    sets the compute dtype to bf16 and the modules keep fp32 master
+    params (cast per traversal) — plus the full static-length cache
+    (eager decode attends every slot, masked); MXU work is negligible at
+    batch 8. Per-step costs are constant, so one add scaled by ``gen``
+    covers the whole run."""
+    h, layers, heads, kvh, hd, inter, vocab = 1024, 12, 16, 8, 64, 4096, 32768
+    batch, prompt, gen = 8, 128, 256
+    dtype_b = 2
+    params = (
+        vocab * h
+        + layers * (h * (heads * hd + 2 * kvh * hd) + heads * hd * h
+                    + 3 * h * inter + 2 * h)
+        + h * vocab + h
+    )
+    inv = Inventory()
+    s_max = prompt + gen
+    avg_ctx = prompt + gen / 2
+    inv.add("decode.weights", bytes_=gen * params * 4,
+            flops=gen * 2 * batch * params)
+    inv.add(
+        "decode.kv_cache",
+        bytes_=gen * batch * layers * s_max * 2 * kvh * hd * dtype_b,
+        flops=gen * 2 * batch * layers * heads * hd * avg_ctx * 2,
+    )
+    tokens = batch * gen
+    rep = inv.report(tokens, 1.0)  # MFU meaningless for decode
+    rep.pop("predicted_mfu")
+    return "dense_256m_decode", rep
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--top", type=int, default=6,
@@ -329,6 +363,7 @@ def main():
         moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=False),
         moe_scenario(ub=1, param_dtype_b=4, hybrid=True),
         moe_scenario(ub=2, param_dtype_b=2, hybrid=True),
+        decode_scenario(),
     ]
     for name, rep in scenarios:
         comps = rep.pop("components")
