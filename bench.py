@@ -647,6 +647,18 @@ def run_bench_generate(*, tiny: bool = False) -> dict:
         jnp.arange(prompt, dtype=jnp.int32), (batch, prompt)
     )
     params = model.init(jax.random.PRNGKey(0), z, pos, z)["params"]
+    # inference-weight width A/B: tools/roofline.py attributes 93% of the
+    # decode step to streaming fp32 master weights; D9D_BENCH_DECODE_BF16
+    # casts the params once up front (what a deployment would serve)
+    import os as _os
+
+    infer_bf16 = _os.environ.get("D9D_BENCH_DECODE_BF16", "0") == "1"
+    if infer_bf16:
+        params = jax.tree.map(
+            lambda p: p.astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
     rng = np.random.RandomState(0)
     prompt_ids = jnp.asarray(
         rng.randint(0, cfg.vocab_size, (batch, prompt)), jnp.int32
@@ -682,6 +694,7 @@ def run_bench_generate(*, tiny: bool = False) -> dict:
             "batch": batch,
             "prompt": prompt,
             "new_tokens": gen,
+            "weights": "bf16" if infer_bf16 else "fp32_masters",
             "device": jax.devices()[0].device_kind,
         },
     }

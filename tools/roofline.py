@@ -336,13 +336,17 @@ def decode_scenario():
     )
     inv = Inventory()
     s_max = prompt + gen
-    avg_ctx = prompt + gen / 2
-    inv.add("decode.weights", bytes_=gen * params * 4,
-            flops=gen * 2 * batch * params)
+    # the embedding is a GATHER (batch rows, nn/embedding.py), not a
+    # streamed matmul operand — exclude it from the per-step weight stream
+    streamed = params - vocab * h
+    inv.add("decode.weights", bytes_=gen * streamed * 4,
+            flops=gen * 2 * batch * streamed)
     inv.add(
         "decode.kv_cache",
+        # eager decode attends every static slot, masked: bytes AND flops
+        # both scale with s_max
         bytes_=gen * batch * layers * s_max * 2 * kvh * hd * dtype_b,
-        flops=gen * 2 * batch * layers * heads * hd * avg_ctx * 2,
+        flops=gen * 2 * batch * layers * heads * hd * s_max * 2,
     )
     tokens = batch * gen
     rep = inv.report(tokens, 1.0)  # MFU meaningless for decode

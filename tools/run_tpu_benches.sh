@@ -225,6 +225,16 @@ import bench
 print(json.dumps(bench.run_bench_generate()))
 PYEOF
 
+# roofline says 93% of the decode step is the fp32 weight stream —
+# serving-width bf16 params should roughly double tokens/s
+D9D_BENCH_DECODE_BF16=1 \
+  run_leg "decode throughput, bf16 inference weights" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
+import json
+import bench
+print(json.dumps(bench.run_bench_generate()))
+PYEOF
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
