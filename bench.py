@@ -647,8 +647,8 @@ def run_bench_generate(*, tiny: bool = False) -> dict:
         jnp.arange(prompt, dtype=jnp.int32), (batch, prompt)
     )
     params = model.init(jax.random.PRNGKey(0), z, pos, z)["params"]
-    # inference-weight width A/B: tools/roofline.py attributes 93% of the
-    # decode step to streaming fp32 master weights; D9D_BENCH_DECODE_BF16
+    # inference-weight width A/B: tools/roofline.py attributes most of the
+    # decode step (~92%) to streaming fp32 master weights; D9D_BENCH_DECODE_BF16
     # casts the params once up front (what a deployment would serve)
     import os as _os
 

@@ -52,21 +52,25 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
     return ref.value
 
 
-def _decode_slot_mask(start, t: int, s_max: int, window_size, mask):
-    """Slot-based causal (+window, +caller) mask for decode attention.
-
-    The caller mask must be 4D broadcastable to ``[B, Hq, T, s_max]`` with
-    the key axis indexing CACHE SLOTS (loop/generate.py passes
-    ``[B, 1, 1, S_max]`` key-validity for left-padded ragged prompts; slot
-    order equals time order per row, so causality stays slot-based).
-    2D/3D token-position masks are rejected — their shape can coincide
-    with the slot layout and silently mean the wrong thing.
-    """
+def _check_slot_mask(mask, s_max: int):
+    """Shared decode mask contract: 4D broadcastable to
+    ``[B, Hq, T, s_max]`` with the key axis indexing CACHE SLOTS
+    (loop/generate.py passes ``[B, 1, 1, S_max]`` key-validity for
+    left-padded ragged prompts; slot order equals time order per row, so
+    causality stays slot-based). 2D/3D token-position masks are rejected
+    — their shape can coincide with the slot layout and silently mean the
+    wrong thing."""
     if mask is not None and (mask.ndim != 4 or mask.shape[-1] != s_max):
         raise NotImplementedError(
             "decode mode accepts only a 4D [B, Hq, T, decode_max_length] "
             f"cache-slot mask (loop/generate.py's form); got {mask.shape}"
         )
+
+
+def _decode_slot_mask(start, t: int, s_max: int, window_size, mask):
+    """Slot-based causal (+window, +caller) mask for decode attention
+    (mask contract: :func:`_check_slot_mask`)."""
+    _check_slot_mask(mask, s_max)
     q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
     k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
     dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, s_max]
@@ -75,6 +79,25 @@ def _decode_slot_mask(start, t: int, s_max: int, window_size, mask):
     if mask is not None:
         dec_mask = dec_mask & mask
     return dec_mask
+
+
+def _prefill_segments(mask, t: int, s_max: int) -> dict:
+    """Segment-id kwargs expressing a slot-validity mask during a prefill
+    that attends only the new tokens (left pads get id 0, real tokens 1 —
+    real queries then never see pad keys; pad rows' outputs are don't-care
+    positions discarded downstream). Only the key-validity FORM
+    ``[B, 1, 1, s_max]`` is expressible as segments, so head/query-varying
+    masks are rejected rather than silently collapsed."""
+    if mask is None:
+        return {}
+    _check_slot_mask(mask, s_max)
+    if mask.shape[1] != 1 or mask.shape[2] != 1:
+        raise NotImplementedError(
+            "the decode prefill fast path supports only key-validity "
+            f"masks [B, 1, 1, s_max]; got {mask.shape}"
+        )
+    seg = mask[:, 0, 0, :t].astype(jnp.int32)
+    return {"q_segments": seg, "kv_segments": seg}
 
 
 class _ProjKernel(nn.Module):
@@ -285,6 +308,22 @@ class GroupedQueryAttention(nn.Module):
             self, v.astype(self.dtype), "cached_value", s_max, start
         )
         idx.value = start + t
+        if t > 1:
+            # PREFILL fast path: attend the new tokens against themselves
+            # through the training SDPA (flash on TPU) — the eager slot
+            # path would materialize [t, s_max] logits, which explodes
+            # for long prompts. Valid only when the cache was empty
+            # (start == 0), which is exactly how loop/generate.py issues
+            # its one multi-token call; start is traced, so the contract
+            # is documented rather than checked (like the capacity bound).
+            return self.sdpa(
+                q, k, v,
+                causal=True,
+                softmax_scale=self.softmax_scale,
+                window_size=self.window_size,
+                sinks=sinks,
+                **_prefill_segments(mask, t, s_max),
+            )
         return eager_sdpa(
             q, keys, values,
             causal=False,
@@ -423,36 +462,41 @@ class MultiHeadLatentAttention(nn.Module):
             param_dtype=self.param_dtype, name="kv_up_proj",
         )(self.kv_lora_rank)
 
-        if self.decode_max_length > 0:
+        decode = self.decode_max_length > 0
+        prefill_segs = {}
+        if decode:
             s_max = self.decode_max_length
             idx = _decode_cache_index(self)
             start = idx.value
-            c_kv = _decode_cache_append(
+            cached_c = _decode_cache_append(
                 self, c_kv.astype(self.dtype), "cached_latent", s_max, start
             )
-            k_rope = _decode_cache_append(
+            cached_r = _decode_cache_append(
                 self, k_rope.astype(self.dtype), "cached_rope_key", s_max,
                 start,
             )
             idx.value = start + t
-            dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
             if t == 1:
                 # ABSORBED form (DeepSeek-V2 decode trick): fold W_up^K
                 # into the query and W_up^V into the output —
                 # q_nope^T (W_k c) == (W_k^T q_nope)^T c — so attention
                 # runs in rank space against the latent cache directly,
                 # with no per-step decompression of s_max slots
+                dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
                 out = self._absorbed_attend(
-                    q_nope, q_rope, c_kv, k_rope, kv_up_w, dec_mask,
+                    q_nope, q_rope, cached_c, cached_r, kv_up_w, dec_mask,
                     d_qk, d_nope, d_v,
                 )
                 out = checkpoint_name(out, "sdpa_out")
                 return proj(self.hidden_size, "o_proj",
                             (la.HEADS, la.EMBED))(out.reshape(b, t, h * d_v))
-            # prefill (t > 1): decompress once — compute-optimal there
-            s_len = s_max
-        else:
-            s_len = t
+            # prefill (t > 1): decompress only the NEW tokens and attend
+            # them causally through the training SDPA — valid for the
+            # first call (start == 0), which is how loop/generate.py
+            # issues its one multi-token call (contract documented at
+            # GroupedQueryAttention._decode_attend)
+            prefill_segs = _prefill_segments(mask, t, s_max)
+        s_len = t
 
         kv_up = (
             c_kv.astype(self.dtype) @ kv_up_w.astype(self.dtype)
@@ -475,12 +519,10 @@ class MultiHeadLatentAttention(nn.Module):
         if pad > 0:
             v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
 
-        if self.decode_max_length > 0:
-            from d9d_tpu.ops.attention.eager import eager_sdpa
-
-            out = eager_sdpa(
-                q, k, v, causal=False, softmax_scale=d_qk**-0.5,
-                mask=dec_mask,
+        if decode:  # t > 1 prefill over just the new tokens
+            out = self.sdpa(
+                q, k, v, causal=True, softmax_scale=d_qk**-0.5,
+                **prefill_segs,
             )
         else:
             out = self.sdpa(

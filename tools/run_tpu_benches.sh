@@ -225,7 +225,7 @@ import bench
 print(json.dumps(bench.run_bench_generate()))
 PYEOF
 
-# roofline says 93% of the decode step is the fp32 weight stream —
+# roofline attributes ~92% of the decode step to the fp32 weight stream —
 # serving-width bf16 params should roughly double tokens/s
 D9D_BENCH_DECODE_BF16=1 \
   run_leg "decode throughput, bf16 inference weights" \
