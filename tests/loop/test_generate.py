@@ -228,6 +228,40 @@ class TestDecodeParity:
         np.testing.assert_array_equal(got[0], want[0][0])
         np.testing.assert_array_equal(got[1], want[1][0])
 
+    def test_ragged_prompts_flash_prefill_backend(self):
+        """The ragged contract through the Pallas flash backend (what the
+        prefill fast path runs on TPU; interpret mode here): segment ids
+        must make left pads invisible exactly like the eager mask."""
+        from d9d_tpu.ops.attention.pallas_flash import make_pallas_flash_sdpa
+
+        cfg = _cfg()
+        flash = make_pallas_flash_sdpa()  # interpret auto-on off-TPU
+        dec = Qwen3DenseCausalLM(
+            config=cfg, sdpa=flash, dtype=jnp.float32,
+            decode_max_length=20,
+        )
+        b, t = 2, 8
+        z = jnp.zeros((b, t), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        params = dec.init(jax.random.PRNGKey(9), z, pos, z)["params"]
+
+        rng = np.random.default_rng(10)
+        short = jnp.asarray(rng.integers(0, VOCAB, (1, 4)), jnp.int32)
+        long = jnp.asarray(rng.integers(0, VOCAB, (1, 7)), jnp.int32)
+        want_short = np.asarray(generate(dec, params, short, max_new_tokens=5))
+        want_long = np.asarray(generate(dec, params, long, max_new_tokens=5))
+        padded = jnp.concatenate(
+            [jnp.pad(short, ((0, 0), (3, 0))), long], axis=0
+        )
+        got = np.asarray(
+            generate(
+                dec, params, padded, max_new_tokens=5,
+                prompt_lengths=jnp.asarray([4, 7], jnp.int32),
+            )
+        )
+        np.testing.assert_array_equal(got[0], want_short[0])
+        np.testing.assert_array_equal(got[1], want_long[0])
+
     def test_ragged_prompts_hybrid(self):
         """Same ragged contract through the GDN hybrid (padding_mask
         threads to the linear-attention layers)."""
