@@ -20,6 +20,34 @@ from d9d_tpu.nn.sdpa.protocol import SdpaBackend
 from d9d_tpu.ops import RopeStyle, apply_rope
 
 
+def _decode_contract_checks(start, t: int, s_max: int):
+    """Functionalized assertions for the two traced decode contracts
+    (ADVICE r4): the multi-token prefill fast path is only valid on an
+    empty cache, and the cache must never overflow (past capacity,
+    ``dynamic_update_slice`` clamps and attention silently degrades).
+    ``checkify.debug_check`` is a no-op in plain jit but fails loudly
+    when the caller wraps with ``checkify.checkify`` — which the decode
+    contract tests do; ``loop/generate.py`` additionally enforces both
+    bounds statically before tracing.
+    """
+    from jax.experimental import checkify
+
+    checkify.debug_check(
+        start + t <= s_max,
+        f"decode cache overflow: start {{start}} + {t} new tokens exceed "
+        f"decode_max_length={s_max}",
+        start=start,
+    )
+    if t > 1:
+        checkify.debug_check(
+            start == 0,
+            f"decode prefill (t={t} > 1) requires an empty cache "
+            f"(the fast path attends only the new tokens); got cache "
+            f"index {{start}}",
+            start=start,
+        )
+
+
 def _decode_cache_index(module: nn.Module):
     """The module's single decode write-index variable (declare once per
     trace — flax forbids re-declaring a name within one __call__)."""
@@ -301,6 +329,7 @@ class GroupedQueryAttention(nn.Module):
         s_max = self.decode_max_length
         idx = _decode_cache_index(self)
         start = idx.value
+        _decode_contract_checks(start, t, s_max)
         keys = _decode_cache_append(
             self, k.astype(self.dtype), "cached_key", s_max, start
         )
@@ -315,7 +344,8 @@ class GroupedQueryAttention(nn.Module):
             # for long prompts. Valid only when the cache was empty
             # (start == 0), which is exactly how loop/generate.py issues
             # its one multi-token call; start is traced, so the contract
-            # is documented rather than checked (like the capacity bound).
+            # is asserted via checkify (_decode_contract_checks) and
+            # enforced statically by generate().
             return self.sdpa(
                 q, k, v,
                 causal=True,
@@ -331,6 +361,28 @@ class GroupedQueryAttention(nn.Module):
             sinks=sinks,
             mask=_decode_slot_mask(start, t, s_max, self.window_size, mask),
         )
+
+
+def _decompress_kv(c, k_rope, w, num_heads: int, d_nope: int, dtype):
+    """Expand MLA latents through kv_up: ``c [B,S,r]`` + shared rotated
+    rope key ``k_rope [B,S,d_rope]`` → ``(k [B,S,H,d_nope+d_rope],
+    v [B,S,H,d_v])`` with the single-head rope key broadcast to every
+    head (MQA-style). One definition for the prefill/training body and
+    the decompressed-decode oracle so their layouts cannot drift."""
+    b, s = c.shape[:2]
+    kv = (c.astype(dtype) @ w.astype(dtype)).reshape(b, s, num_heads, -1)
+    k_nope, v = kv[..., :d_nope], kv[..., d_nope:]
+    d_rope = k_rope.shape[-1]
+    k = jnp.concatenate(
+        [
+            k_nope,
+            jnp.broadcast_to(
+                k_rope[:, :, None, :], (b, s, num_heads, d_rope)
+            ).astype(k_nope.dtype),
+        ],
+        axis=-1,
+    )
+    return k, v
 
 
 class LowRankProjection(nn.Module):
@@ -390,6 +442,12 @@ class MultiHeadLatentAttention(nn.Module):
     # (kv_up folded into the query/output sides, attention in rank space
     # — no per-step decompression); prefill (t > 1) decompresses once.
     decode_max_length: int = 0
+    # False: single-token steps instead decompress EVERY cache slot
+    # through kv_up and attend over the slot cache — the cost the
+    # absorbed trick avoids. Kept as the absorbed form's correctness
+    # oracle and the honest half of the bench A/B (ADVICE r4: timing a
+    # t=2 prefill on a warm cache measures neither).
+    decode_absorbed: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -468,6 +526,7 @@ class MultiHeadLatentAttention(nn.Module):
             s_max = self.decode_max_length
             idx = _decode_cache_index(self)
             start = idx.value
+            _decode_contract_checks(start, t, s_max)
             cached_c = _decode_cache_append(
                 self, c_kv.astype(self.dtype), "cached_latent", s_max, start
             )
@@ -477,16 +536,23 @@ class MultiHeadLatentAttention(nn.Module):
             )
             idx.value = start + t
             if t == 1:
-                # ABSORBED form (DeepSeek-V2 decode trick): fold W_up^K
-                # into the query and W_up^V into the output —
-                # q_nope^T (W_k c) == (W_k^T q_nope)^T c — so attention
-                # runs in rank space against the latent cache directly,
-                # with no per-step decompression of s_max slots
                 dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
-                out = self._absorbed_attend(
-                    q_nope, q_rope, cached_c, cached_r, kv_up_w, dec_mask,
-                    d_qk, d_nope, d_v,
-                )
+                if self.decode_absorbed:
+                    # ABSORBED form (DeepSeek-V2 decode trick): fold
+                    # W_up^K into the query and W_up^V into the output —
+                    # q_nope^T (W_k c) == (W_k^T q_nope)^T c — so
+                    # attention runs in rank space against the latent
+                    # cache directly, with no per-step decompression of
+                    # s_max slots
+                    out = self._absorbed_attend(
+                        q_nope, q_rope, cached_c, cached_r, kv_up_w,
+                        dec_mask, d_qk, d_nope, d_v,
+                    )
+                else:
+                    out = self._decompressed_attend(
+                        q, cached_c, cached_r, kv_up_w, dec_mask,
+                        d_qk, d_nope,
+                    )
                 out = checkpoint_name(out, "sdpa_out")
                 return proj(self.hidden_size, "o_proj",
                             (la.HEADS, la.EMBED))(out.reshape(b, t, h * d_v))
@@ -496,23 +562,7 @@ class MultiHeadLatentAttention(nn.Module):
             # issues its one multi-token call (contract documented at
             # GroupedQueryAttention._decode_attend)
             prefill_segs = _prefill_segments(mask, t, s_max)
-        s_len = t
-
-        kv_up = (
-            c_kv.astype(self.dtype) @ kv_up_w.astype(self.dtype)
-        ).reshape(b, s_len, h, d_nope + d_v)
-        k_nope, v = kv_up[..., :d_nope], kv_up[..., d_nope:]
-
-        # single-head rope key broadcast to every head (MQA-style)
-        k = jnp.concatenate(
-            [
-                k_nope,
-                jnp.broadcast_to(
-                    k_rope[:, :, None, :], (b, s_len, h, d_rope)
-                ).astype(k_nope.dtype),
-            ],
-            axis=-1,
-        )
+        k, v = _decompress_kv(c_kv, k_rope, kv_up_w, h, d_nope, self.dtype)
 
         # pad V: softmax(QKᵀ)·[V|0] = [out|0] (reference :199-207)
         pad = d_qk - d_v
@@ -533,6 +583,23 @@ class MultiHeadLatentAttention(nn.Module):
             out = out[..., :d_v]
         out = out.reshape(b, t, h * d_v)
         return proj(self.hidden_size, "o_proj", (la.HEADS, la.EMBED))(out)
+
+    def _decompressed_attend(self, q, c, k_rope, w, dec_mask,
+                             d_qk, d_nope):
+        """Non-absorbed decode: decompress every cache slot through kv_up
+        each step (O(s_max·r·h·(d_nope+d_v)) per token — the traffic the
+        absorbed form avoids) and attend over the slot cache. Serves as
+        the absorbed path's correctness oracle and the honest
+        'decompressed' leg of tools/bench_kernels.py mla_decode.
+        """
+        from d9d_tpu.ops.attention.eager import eager_sdpa
+
+        k, v = _decompress_kv(
+            c, k_rope, w, self.num_heads, d_nope, self.dtype
+        )
+        return eager_sdpa(
+            q, k, v, causal=False, softmax_scale=d_qk**-0.5, mask=dec_mask
+        )
 
     def _absorbed_attend(self, q_nope, q_rope, c, k_rope, w, dec_mask,
                          d_qk, d_nope, d_v):

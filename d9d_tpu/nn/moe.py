@@ -21,7 +21,6 @@ TPU-native design:
 """
 
 import dataclasses
-import os
 from typing import Optional
 
 import flax.linen as nn
@@ -35,6 +34,7 @@ from d9d_tpu.nn import logical_axes as la
 from d9d_tpu.nn.mlp import SwiGLU
 from d9d_tpu.ops.ep_dispatch import ep_dispatch_compute_combine
 from d9d_tpu.ops.moe import (
+    gate_up_grouped_matmul,
     grouped_matmul,
     permute_tokens,
     sort_tokens_by_expert,
@@ -190,16 +190,9 @@ def grouped_swiglu_apply(
     the on-chip A/B (run_tpu_benches.sh).
     """
     x = permuted_x.astype(dtype)
-    inter = gate_w.shape[-1]
-    if os.environ.get("D9D_TPU_MOE_FUSED_GATE_UP", "1") == "1":
-        gate_up_w = jnp.concatenate(
-            [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
-        )
-        h_gu = grouped_matmul(x, gate_up_w, group_sizes)  # [M, 2*inter]
-        g, u = h_gu[..., :inter], h_gu[..., inter:]
-    else:
-        g = grouped_matmul(x, gate_w.astype(dtype), group_sizes)
-        u = grouped_matmul(x, up_w.astype(dtype), group_sizes)
+    g, u = gate_up_grouped_matmul(
+        x, gate_w.astype(dtype), up_w.astype(dtype), group_sizes
+    )
     hidden = silu_mul(g, u)
     out = grouped_matmul(hidden, down_w.astype(dtype), group_sizes)
     return out * permuted_probs[:, None].astype(dtype)

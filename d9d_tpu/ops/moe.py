@@ -13,6 +13,7 @@ Replaces the reference kernel layer for MoE (SURVEY §2.2):
 All functions operate on a flat token dim; callers reshape [B,T,D]→[N,D].
 """
 
+import os
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -149,6 +150,31 @@ def unpermute_combine(y: Array, sort: TokenSort, num_tokens: int) -> Array:
     ``permute_tokens``; see :func:`combine_pairs` for the formulation.
     """
     return combine_pairs(y, sort.dest, num_tokens)
+
+
+def gate_up_grouped_matmul(
+    x: Array, gate_w: Array, up_w: Array, group_sizes: Array
+) -> tuple[Array, Array]:
+    """Gate and up projections as grouped matmuls → ``(g, u)``.
+
+    Single owner of the ``D9D_TPU_MOE_FUSED_GATE_UP`` A/B (default on:
+    ONE grouped matmul over a runtime ``[E, in, 2*inter]`` concat so the
+    expert-sorted rows stream from HBM once; off: two grouped matmuls,
+    no weight-concat materialization — see nn/moe.py grouped_swiglu_apply
+    for the trade-off). Shared by the XLA MoE chain AND the Pallas
+    backend's fallback/backward reference (ADVICE r4: the env switch must
+    cover every path or the perf A/B is inconsistent). Weights must
+    already be in the compute dtype.
+    """
+    if os.environ.get("D9D_TPU_MOE_FUSED_GATE_UP", "1") == "1":
+        inter = gate_w.shape[-1]
+        gate_up_w = jnp.concatenate([gate_w, up_w], axis=-1)
+        h_gu = grouped_matmul(x, gate_up_w, group_sizes)  # [M, 2*inter]
+        return h_gu[..., :inter], h_gu[..., inter:]
+    return (
+        grouped_matmul(x, gate_w, group_sizes),
+        grouped_matmul(x, up_w, group_sizes),
+    )
 
 
 def grouped_matmul(x: Array, weight: Array, group_sizes: Array) -> Array:

@@ -129,8 +129,40 @@ def _ffn_kernel(gid_ref, a_ref, probs_ref, wg_ref, wu_ref, wd_ref, out_ref):
     out_ref[...] = (y * probs_ref[...]).astype(out_ref.dtype)
 
 
-def _tpu_shapes_ok(h: int, inter: int, block_m: int) -> bool:
-    return h % LANES == 0 and inter % LANES == 0 and block_m % 8 == 0
+def _vmem_bytes_estimate(
+    h: int, inter: int, block_m: int, itemsize: int
+) -> int:
+    """Per-grid-step VMEM bytes the fused kernel needs (ADVICE r4).
+
+    Pallas double-buffers every streamed input block: three expert weight
+    blocks (``2*h*inter`` gate+up plus ``inter*h`` down) dominate; the
+    ``[block_m, h]`` activation/output tiles and ``[block_m, 1]`` probs
+    ride along. The kernel body additionally holds fp32 gate/up products
+    and the hidden tile (``3 * block_m * inter`` fp32, single-buffered).
+    """
+    weights = 3 * h * inter * itemsize * 2  # double-buffered DMA
+    tiles = (2 * block_m * h + block_m) * itemsize * 2
+    scratch = 3 * block_m * inter * 4
+    return weights + tiles + scratch
+
+
+def _tpu_shapes_ok(
+    h: int, inter: int, block_m: int, itemsize: int = 2
+) -> bool:
+    """Lane alignment AND VMEM fit — large h/inter geometries would fail
+    at Mosaic compile instead of falling back (ADVICE r4), so estimate
+    the footprint and route oversized shapes to the XLA chain.
+
+    Budget default: v5e/v4 VMEM is 128 MiB/core; leave headroom for
+    Mosaic's own staging. Read at call time like the file's other env
+    knobs so tests/benches can set it after import.
+    """
+    if not (h % LANES == 0 and inter % LANES == 0 and block_m % 8 == 0):
+        return False
+    budget = int(
+        os.environ.get("D9D_TPU_MOE_FFN_VMEM_BUDGET", 96 * 1024 * 1024)
+    )
+    return _vmem_bytes_estimate(h, inter, block_m, itemsize) <= budget
 
 
 @functools.partial(
@@ -172,17 +204,20 @@ def _fused_ffn_call(
 
 def _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype):
     """The existing XLA path (permute -> grouped matmuls -> combine);
-    single source of truth for the custom_vjp backward AND the fallback."""
-    from d9d_tpu.ops.moe import permute_tokens, unpermute_combine
+    single source of truth for the custom_vjp backward AND the fallback.
+    Uses the shared env-switched gate+up helper so the
+    ``D9D_TPU_MOE_FUSED_GATE_UP`` A/B also covers the fallback and the
+    custom_vjp backward under this backend (ADVICE r4)."""
+    from d9d_tpu.ops.moe import (
+        gate_up_grouped_matmul, permute_tokens, unpermute_combine,
+    )
 
     permuted_x, permuted_probs = permute_tokens(x, probs, sort)
     xx = permuted_x.astype(dtype)
-    inter = gate_w.shape[-1]
-    gate_up = jnp.concatenate(
-        [gate_w.astype(dtype), up_w.astype(dtype)], axis=-1
+    g, u = gate_up_grouped_matmul(
+        xx, gate_w.astype(dtype), up_w.astype(dtype), sort.group_sizes
     )
-    h_gu = grouped_matmul(xx, gate_up, sort.group_sizes)
-    hidden = silu_mul(h_gu[..., :inter], h_gu[..., inter:])
+    hidden = silu_mul(g, u)
     y = grouped_matmul(hidden, down_w.astype(dtype), sort.group_sizes)
     y = y * permuted_probs[:, None].astype(dtype)
     return unpermute_combine(y, sort, x.shape[0]).astype(x.dtype)
@@ -310,7 +345,8 @@ def fused_moe_ffn_apply(
         interpret = jax.default_backend() != "tpu"
     if block_m is None:
         block_m = int(os.environ.get("D9D_TPU_MOE_FFN_BLOCK_M", "128"))
-    if not interpret and not _tpu_shapes_ok(h, inter, block_m):
+    itemsize = jnp.dtype(dtype).itemsize
+    if not interpret and not _tpu_shapes_ok(h, inter, block_m, itemsize):
         return _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype)
     from jax.ad_checkpoint import checkpoint_name
 

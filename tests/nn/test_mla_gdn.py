@@ -61,10 +61,13 @@ class TestMLA:
             np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5
         )
 
-    def test_latent_cache_decode_matches_full_forward(self):
+    @pytest.mark.parametrize("absorbed", [True, False])
+    def test_latent_cache_decode_matches_full_forward(self, absorbed):
         """MLA decode caches (latent, rotated rope key) per token; prefill
         + teacher-forced single-token steps must reproduce the full
-        forward at every position."""
+        forward at every position — in BOTH the absorbed (rank-space)
+        form and the decompressed oracle (``decode_absorbed=False``,
+        which re-expands every cache slot through kv_up per step)."""
         b, t, p = 2, 12, 8
         full = self._block()
         dec = MultiHeadLatentAttention(
@@ -77,6 +80,7 @@ class TestMLA:
             sdpa=eager_sdpa,
             dtype=jnp.float32,
             decode_max_length=16,
+            decode_absorbed=absorbed,
         )
         x = jax.random.normal(jax.random.PRNGKey(3), (b, t, 64))
         cos, sin = _rope(b, t, 8)
