@@ -619,6 +619,7 @@ def run_bench_generate(*, tiny: bool = False) -> dict:
     from d9d_tpu.loop.generate import generate
     from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
     from d9d_tpu.nn.sdpa import build_sdpa_backend
+    from d9d_tpu.ops.attention.pallas_decode import decode_attention_backend
     from tools.benchtime import host_fetch_sync, measure_rtt
 
     if tiny:
@@ -695,6 +696,7 @@ def run_bench_generate(*, tiny: bool = False) -> dict:
             "prompt": prompt,
             "new_tokens": gen,
             "weights": "bf16" if infer_bf16 else "fp32_masters",
+            "decode_attn": decode_attention_backend(),
             "device": jax.devices()[0].device_kind,
         },
     }

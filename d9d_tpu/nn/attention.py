@@ -80,6 +80,31 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
     return ref.value
 
 
+def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
+                                     s_max: int, start):
+    """Append ``value [B, T, H, D]`` at cache slot ``start`` of a
+    HEADS-MAJOR cache buffer ``[B, H, s_max, D]``.
+
+    GQA decode caches store the flash-decode kernel's streaming layout
+    (ops/attention/pallas_decode.py) so the per-step attention never
+    relayouts the cache — the write-side transpose touches only the T
+    new tokens (T = 1 on decode steps), while a read-side transpose
+    would copy all ``s_max`` slots every step. Same capacity contract
+    as :func:`_decode_cache_append`.
+    """
+    from jax import lax
+
+    b, _, h, d = value.shape
+    ref = module.variable(
+        "cache", name,
+        lambda: jnp.zeros((b, h, s_max, d), value.dtype),
+    )
+    ref.value = lax.dynamic_update_slice(
+        ref.value, jnp.transpose(value, (0, 2, 1, 3)), (0, 0, start, 0)
+    )
+    return ref.value
+
+
 def _check_slot_mask(mask, s_max: int):
     """Shared decode mask contract: 4D broadcastable to
     ``[B, Hq, T, s_max]`` with the key axis indexing CACHE SLOTS
@@ -317,23 +342,34 @@ class GroupedQueryAttention(nn.Module):
 
     def _decode_attend(self, q, k, v, sinks, mask, b, t):
         """KV-cache attention: write the new k/v at the cache index, then
-        attend against the full static-length cache with a validity+causal
-        mask (the eager oracle handles cross-length attention + sinks +
-        window; decode throughput is cache-bandwidth-bound, so the eager
-        path is the right backend here — no flash tiling to win). Cache
-        mechanics + capacity/mask contracts: the module-level
-        ``_decode_cache_append`` / ``_decode_slot_mask`` helpers.
+        attend against the full static-length cache.
+
+        Per-step attention is cache-bandwidth-bound; on TPU it runs the
+        Pallas flash-decode kernel (ops/attention/pallas_decode.py):
+        streams each (batch, kv-head) cache slice from HBM exactly once
+        with the GQA group as the matmul M dim, skips slots past the
+        write index, and never materializes [B,H,T,S] logits — the
+        eager oracle remains the fallback (non-TPU, or masks beyond the
+        key-validity form) and the parity reference. Cache mechanics +
+        capacity/mask contracts: the module-level ``_decode_cache_append``
+        / ``_decode_slot_mask`` helpers.
         """
         from d9d_tpu.ops.attention.eager import eager_sdpa
+        from d9d_tpu.ops.attention.pallas_decode import (
+            decode_attention_backend,
+            flash_decode_attention,
+        )
 
         s_max = self.decode_max_length
         idx = _decode_cache_index(self)
         start = idx.value
         _decode_contract_checks(start, t, s_max)
-        keys = _decode_cache_append(
+        # heads-major [B, Hkv, s_max, D]: the flash-decode kernel's
+        # streaming layout, written in place (no per-step cache relayout)
+        keys = _decode_cache_append_heads_major(
             self, k.astype(self.dtype), "cached_key", s_max, start
         )
-        values = _decode_cache_append(
+        values = _decode_cache_append_heads_major(
             self, v.astype(self.dtype), "cached_value", s_max, start
         )
         idx.value = start + t
@@ -354,8 +390,23 @@ class GroupedQueryAttention(nn.Module):
                 sinks=sinks,
                 **_prefill_segments(mask, t, s_max),
             )
+        key_validity_mask = mask is None or (
+            mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
+        )
+        if decode_attention_backend() == "pallas" and key_validity_mask:
+            _check_slot_mask(mask, s_max)
+            return flash_decode_attention(
+                q, keys, values,
+                start=start,
+                softmax_scale=self.softmax_scale,
+                window_size=self.window_size,
+                sinks=sinks,
+                kv_valid=None if mask is None else mask[:, 0, 0, :],
+            )
         return eager_sdpa(
-            q, keys, values,
+            q,
+            jnp.transpose(keys, (0, 2, 1, 3)),
+            jnp.transpose(values, (0, 2, 1, 3)),
             causal=False,
             softmax_scale=self.softmax_scale,
             sinks=sinks,

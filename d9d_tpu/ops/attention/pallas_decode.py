@@ -1,0 +1,299 @@
+"""Pallas TPU flash-decode attention over a KV slot cache.
+
+Parity: the reference serves decode through its flash varlen path
+(d9d/kernel/flash_attn/function.py:384, flash_attn_varlen_func with
+cache seqlens); this is the TPU-native equivalent for the KV-cache
+decode step that previously routed to the eager fallback
+(pallas_flash.py routes cross-length attention to eager — fine for the
+training bench geometry, wrong for serving batches where the [B,H,T,S]
+eager logits round-trip HBM every step).
+
+Decode attention is KV-cache-bandwidth-bound: the optimal kernel
+streams each (batch, kv-head) cache slice from HBM EXACTLY ONCE and
+never materializes logits. Two layout decisions follow:
+
+- The GQA group is the matmul M dimension. ``q [B,T,Hq,D]`` is reshaped
+  to ``[B, Hkv, g·T, D]`` (g = Hq/Hkv) so one grid step attends every
+  query head of the group against the shared kv block. The training
+  kernel's (b, h, q-block, kv-block) grid would re-stream the whole
+  cache g times per group — a g× HBM tax that training amortizes over
+  large q blocks but decode (T ~ 1) cannot. The cache arrives
+  HEADS-MAJOR ``[B, Hkv, S, D]`` — the layout the GQA decode cache
+  maintains on write — so the kernel streams it directly; a read-side
+  relayout would copy every slot every step and erase the win.
+- The kv-block grid dim is innermost and sequential; per-(b, kv-head)
+  online-softmax state (m, l, acc over g·T rows) persists in VMEM
+  scratch across kv steps, exactly like the training forward.
+
+Slot semantics ride positions: the cache write index ``start`` enters
+as a traced SMEM scalar, queries sit at global positions
+``start + [0,T)``, keys at their slot index — so causal/window masking
+over slots needs no mask tensor, and kv blocks wholly in the causal
+future of the last query are skipped (a decode step on a mostly-empty
+cache touches only ceil((start+T)/block_kv) blocks). Per-key validity
+(ragged left-padded prompts: loop/generate.py's [B,1,1,S] mask) streams
+as an int row-vector alongside k/v. Sinks join outside the kernel as
+the standard (o, lse) denominator correction (pallas_flash.py:21).
+
+Forward-only by design: decode never differentiates. ``jax.jit``-safe
+(static T/S/g; ``start`` traced).
+"""
+
+import dataclasses
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from d9d_tpu.core.types import Array
+
+NEG_BIG = -1e30
+LANES = 128
+
+
+def decode_attention_backend() -> str:
+    """'pallas' or 'eager' — env-selected like the SDPA backend family.
+
+    ``D9D_TPU_DECODE_ATTN``: ``auto`` (default; pallas on TPU, eager
+    elsewhere — interpret-mode pallas is a test vehicle, not a CPU
+    serving path), ``pallas``, or ``eager``.
+    """
+    mode = os.environ.get("D9D_TPU_DECODE_ATTN", "auto")
+    if mode == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "eager"
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class _DecodeConfig:
+    scale: float
+    window: int | None
+    t: int           # new tokens this step (queries)
+    rows: int        # g·T real query rows per (b, kv-head)
+    rows_pad: int    # rows padded to the sublane multiple
+    s_len: int       # real cache capacity (pre-padding)
+    block_kv: int
+    has_valid: bool
+    interpret: bool
+
+
+def _decode_kernel(*refs, cfg: _DecodeConfig):
+    if cfg.has_valid:
+        offs_ref, q_ref, k_ref, v_ref, valid_ref = refs[:5]
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[5:]
+    else:
+        offs_ref, q_ref, k_ref, v_ref = refs[:4]
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[4:]
+        valid_ref = None
+    start = offs_ref[0]
+    ik = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_BIG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # whole-block skip: every key slot past the LAST query's position is
+    # invisible (and with a window, every slot at/before the FIRST
+    # query's window floor) — traced predicates, pl.when skips the MXU
+    # work. This is what makes a step on a warm-but-not-full cache cost
+    # O(start + T), not O(s_max).
+    k_lo = ik * cfg.block_kv
+    k_hi = k_lo + cfg.block_kv - 1
+    skip = k_lo > start + (cfg.t - 1)
+    if cfg.window is not None:
+        skip |= k_hi <= start - cfg.window
+
+    @pl.when(jnp.logical_not(skip))
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * cfg.scale  # [rows_pad, bkv]
+
+        rp, bkv = s.shape
+        row = jax.lax.broadcasted_iota(jnp.int32, (rp, bkv), 0)
+        k_pos = k_lo + jax.lax.broadcasted_iota(jnp.int32, (rp, bkv), 1)
+        # row r = (head-in-group, token i) flattened as ig·T + i, so the
+        # query's global slot position is start + r % T
+        q_pos = start + jax.lax.rem(row, cfg.t)
+        mask = (k_pos < cfg.s_len) & (k_pos <= q_pos) & (row < cfg.rows)
+        if cfg.window is not None:
+            mask &= k_pos > q_pos - cfg.window
+        if valid_ref is not None:
+            mask &= valid_ref[0, :, :] != 0  # [1, bkv] key validity
+        s = jnp.where(mask, s, NEG_BIG)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_ref[:, :1] + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == n_kv - 1)
+    def _finalize():
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        o_ref[0, 0, :, :] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype
+        )
+        lse_ref[0, 0, :, :] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _pad_to(n: int, m: int) -> int:
+    return (-n) % m
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg",),
+)
+def _decode_call(cfg: _DecodeConfig, q_rows, kp, vp, valid, offsets):
+    """``q_rows [B, Hkv, rows_pad, D]`` vs cache ``k/v [B, Hkv, S_pad, D]``
+    (heads-major — the caller's cache layout, streamed with no relayout)
+    → ``(o [B, Hkv, rows_pad, D], lse [B, Hkv, rows_pad])``."""
+    b, hkv, rp, d = q_rows.shape
+    s_pad = kp.shape[2]
+    n_kv = s_pad // cfg.block_kv
+
+    valid_specs, valid_bufs = (), ()
+    if cfg.has_valid:
+        valid_specs = (
+            pl.BlockSpec((1, 1, cfg.block_kv),
+                         lambda bi, hi, ki: (bi, 0, ki)),
+        )
+        valid_bufs = (valid[:, None, :].astype(jnp.int32),)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_decode_kernel, cfg=cfg),
+        grid=(b, hkv, n_kv),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, rp, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            *valid_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, rp, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, rp, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, rp, d), q_rows.dtype),
+            jax.ShapeDtypeStruct((b, hkv, rp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rp, LANES), jnp.float32),
+            pltpu.VMEM((rp, LANES), jnp.float32),
+            pltpu.VMEM((rp, d), jnp.float32),
+        ],
+        compiler_params=(
+            None if cfg.interpret else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        ),
+        interpret=cfg.interpret,
+    )(offsets, q_rows, kp, vp, *valid_bufs)
+    return o, lse[..., 0]
+
+
+def flash_decode_attention(
+    q: Array,
+    k_cache: Array,
+    v_cache: Array,
+    *,
+    start: Array,
+    softmax_scale: float | None = None,
+    window_size: int | None = None,
+    sinks: Array | None = None,
+    kv_valid: Array | None = None,
+    block_kv: int = 512,
+    interpret: bool | None = None,
+) -> Array:
+    """Decode-step attention: ``q [B,T,Hq,D]`` (new tokens at cache
+    positions ``start + [0,T)``) against the full slot cache
+    ``k/v [B,Hkv,S,D]`` (HEADS-MAJOR — the layout
+    ``_decode_cache_append_heads_major`` maintains, so the cache streams
+    into the kernel with zero per-step relayout) → ``[B,T,Hq,D]``.
+
+    Slot-causal + optional sliding window over global positions;
+    ``kv_valid [B,S]`` masks dead slots (left-padded ragged prompts);
+    ``sinks [Hq]`` join the softmax denominator via the standard
+    outside-the-kernel correction. Forward-only (decode never
+    backpropagates). Semantics match ``eager_sdpa(q, cacheᵀ, cacheᵀ,
+    causal=False, mask=_decode_slot_mask(...))`` — the parity test
+    drives both.
+    """
+    b, t, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    rows = g * t
+    rp = rows + _pad_to(rows, 8)
+    bkv = min(block_kv, s + _pad_to(s, LANES))
+    s_pad = s + _pad_to(s, bkv)
+
+    cfg = _DecodeConfig(
+        scale=softmax_scale if softmax_scale is not None else d**-0.5,
+        window=window_size,
+        t=t,
+        rows=rows,
+        rows_pad=rp,
+        s_len=s,
+        block_kv=bkv,
+        has_valid=kv_valid is not None,
+        interpret=interpret,
+    )
+
+    # [B,T,Hq,D] → [B,Hkv,g·T,D], row r = ig·T + i
+    q_rows = (
+        q.transpose(0, 2, 1, 3)
+        .reshape(b, hkv, g * t, d)
+    )
+    if rp != rows:
+        q_rows = jnp.pad(q_rows, ((0, 0), (0, 0), (0, rp - rows), (0, 0)))
+    pad_s = s_pad - s
+    kp = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else k_cache
+    vp = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else v_cache
+    validp = None
+    if kv_valid is not None:
+        validp = jnp.pad(kv_valid, ((0, 0), (0, pad_s))) if pad_s else kv_valid
+
+    offsets = jnp.asarray(start, jnp.int32).reshape(1)
+    o, lse = _decode_call(cfg, q_rows, kp, vp, validp, offsets)
+
+    o = o[:, :, :rows]
+    lse = lse[:, :, :rows]
+    if sinks is not None:
+        # sink joins only the denominator: o' = o / (1 + exp(sink - lse))
+        sink_rows = jnp.repeat(
+            sinks.astype(jnp.float32).reshape(hkv, g), t, axis=1
+        ).reshape(1, hkv, rows, 1)
+        z = jnp.clip(sink_rows - lse[..., None], max=60.0)
+        o = (o.astype(jnp.float32) / (1.0 + jnp.exp(z))).astype(o.dtype)
+
+    # [B,Hkv,g·T,D] → [B,T,Hq,D]
+    return (
+        o.reshape(b, hkv, g, t, d)
+        .transpose(0, 3, 1, 2, 4)
+        .reshape(b, t, hq, d)
+    )

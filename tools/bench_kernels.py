@@ -434,6 +434,69 @@ def bench_mla_decode(tiny):
     )
 
 
+def bench_decode_attn(tiny):
+    """Per-step decode attention at serving shapes: eager slot-mask path
+    vs the Pallas flash-decode kernel (ops/attention/pallas_decode.py).
+
+    The kernel streams each (batch, kv-head) cache slice once and skips
+    slots past the write index, so its cost should scale with the warm
+    fraction; the eager path materializes [B,Hq,1,S] logits and reads
+    the full cache regardless. Rows at start = S/2 and S-1 expose the
+    skip win; a windowed row models sliding-window serving."""
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.nn.attention import _decode_slot_mask
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+    from d9d_tpu.ops.attention.pallas_decode import flash_decode_attention
+
+    if tiny:
+        shapes = [(2, 4, 2, 16, 64)]
+    else:
+        # (b, hq, hkv, d, s): Qwen3-ish serving geometries, batch >= 32
+        shapes = [(32, 16, 8, 128, 4096), (64, 16, 8, 128, 2048),
+                  (8, 32, 8, 128, 8192)]
+    interpret = jax.default_backend() != "tpu"
+    for b, hq, hkv, d, s in shapes:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (b, 1, hq, d), jnp.bfloat16)
+        # heads-major [B, Hkv, S, D]: the decode cache's storage layout;
+        # the eager fallback pays its read-side transpose (as the module
+        # path does), the kernel streams it natively
+        k = jax.random.normal(kk, (b, hkv, s, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, hkv, s, d), jnp.bfloat16)
+
+        def eager_step(q, k, v, start, s=s):
+            mask = _decode_slot_mask(start, 1, s, None, None)
+            return eager_sdpa(
+                q,
+                jnp.transpose(k, (0, 2, 1, 3)),
+                jnp.transpose(v, (0, 2, 1, 3)),
+                causal=False, mask=mask,
+            )
+
+        def pallas_step(q, k, v, start, window=None):
+            return flash_decode_attention(
+                q, k, v, start=start, window_size=window,
+                interpret=interpret,
+            )
+
+        cfg_base = f"b{b}_h{hq}:{hkv}_d{d}_s{s}"
+        for frac, tag in ((s // 2, "warm50"), (s - 1, "full")):
+            start = jnp.asarray(frac, jnp.int32)
+            cfg = f"{cfg_base}_{tag}"
+            emit_timed("decode_attn_step", "eager", cfg,
+                       jax.jit(eager_step), q, k, v, start)
+            emit_timed("decode_attn_step", "pallas_decode", cfg,
+                       jax.jit(pallas_step), q, k, v, start)
+        emit_timed(
+            "decode_attn_step", "pallas_decode_window1k",
+            f"{cfg_base}_full",
+            jax.jit(functools.partial(pallas_step, window=1024)),
+            q, k, v, jnp.asarray(s - 1, jnp.int32),
+        )
+
+
 def bench_stochastic(tiny):
     import jax
     import jax.numpy as jnp
@@ -460,7 +523,8 @@ def main():
     ap.add_argument(
         "--only",
         choices=["sdpa", "linear_ce", "elementwise", "gated_delta",
-                 "ring", "stochastic", "moe_ffn", "mla_decode"],
+                 "ring", "stochastic", "moe_ffn", "mla_decode",
+                 "decode_attn"],
         default=None,
     )
     args = ap.parse_args()
@@ -483,6 +547,7 @@ def main():
         "stochastic": bench_stochastic,
         "moe_ffn": bench_moe_ffn,
         "mla_decode": bench_mla_decode,
+        "decode_attn": bench_decode_attn,
     }
     for name, fn in benches.items():
         if args.only is None or args.only == name:

@@ -235,6 +235,20 @@ import bench
 print(json.dumps(bench.run_bench_generate()))
 PYEOF
 
+# r5: decode attention now defaults to the Pallas flash-decode kernel on
+# TPU (ops/attention/pallas_decode.py); this leg pins the old eager slot
+# path so the kernel's end-to-end effect is a recorded A/B (the isolated
+# kernel rows live in bench_kernels.py --only decode_attn)
+D9D_TPU_DECODE_ATTN=eager D9D_BENCH_DECODE_BF16=1 \
+  run_leg "decode throughput, eager decode-attention A/B" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
+import json
+import bench
+r = bench.run_bench_generate()
+r["detail"]["variant"] = "eager_decode_attn"
+print(json.dumps(r))
+PYEOF
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
