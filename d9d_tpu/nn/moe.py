@@ -348,11 +348,13 @@ class MoELayer(nn.Module):
         self, x: Array, topk_ids: Array, topk_probs: Array
     ) -> Array:
         sort = sort_tokens_by_expert(topk_ids, self.num_grouped_experts)
-        if moe_ffn_backend() == "pallas":
+        if moe_ffn_backend() in ("pallas", "pallas_gather"):
             # one fused Pallas kernel over the group-aligned layout: the
             # [M, 2*inter]/[M, inter] intermediates and the gate+up weight
             # concat never touch HBM (ops/moe_pallas.py; backward runs
-            # the XLA chain below via custom_vjp — identical math)
+            # the XLA chain below via custom_vjp — identical math).
+            # pallas_gather additionally keeps x resident in VMEM and
+            # gathers rows in-kernel (no HBM aligned activation buffer)
             return fused_moe_ffn_apply(
                 x, topk_probs, sort,
                 self.grouped_experts.gate_weight,

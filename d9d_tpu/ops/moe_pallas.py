@@ -34,7 +34,15 @@ function's own inputs). The fused kernel accelerates the forward AND the
 remat recompute (jax.checkpoint replays the custom fwd).
 
 Enable via ``D9D_TPU_MOE_FFN=pallas`` (default ``xla``); falls back to
-the XLA path when shapes don't meet the TPU tiling constraints.
+the XLA path when shapes don't meet the TPU tiling constraints or the
+VMEM budget. ``D9D_TPU_MOE_FFN=pallas_gather`` additionally fuses the
+permute gather into the kernel: the whole token matrix ``x [N, h]``
+(and flat probs) sits resident in VMEM and each M-tile gathers its rows
+in-kernel via the scalar-prefetched ``pair_src`` map, so the aligned
+activation buffer never exists in HBM — tools/roofline.py's top
+residual HBM term after the µBS/bf16 levers. The gather variant
+auto-falls back to plain ``pallas`` when the residency or SMEM index
+maps don't fit (:func:`_gather_fits`).
 
 Scope: the LOCAL MoE path only. The EP flow's per-shard ``expert_fn``
 receives rows the dispatch all-to-all already delivered in expert-sorted
@@ -129,6 +137,41 @@ def _ffn_kernel(gid_ref, a_ref, probs_ref, wg_ref, wu_ref, wd_ref, out_ref):
     out_ref[...] = (y * probs_ref[...]).astype(out_ref.dtype)
 
 
+def _ffn_gather_kernel(
+    gid_ref, ps_ref, x_ref, probs_ref, wg_ref, wu_ref, wd_ref, out_ref,
+    a_scr, p_scr, *, block_m: int, top_k: int,
+):
+    """Gather-fused tile: rows stream VMEM→VMEM inside the kernel.
+
+    The whole token matrix ``x [N, h]`` (and flat probs ``[M, 1]``) sits
+    resident in VMEM (eligibility gates on the fit); each grid step
+    gathers its tile's rows by the scalar-prefetched ``pair_src`` map —
+    so the aligned activation buffer of the two-step path never exists
+    in HBM (that buffer cost a full [m_pad, h] write + read per layer
+    pass, the top residual HBM term in tools/roofline.py's post-µBS4
+    attribution). Pad rows (pair_src < 0) load row 0 and are zeroed.
+    """
+    t = pl.program_id(0)
+
+    def body(i, _):
+        src = ps_ref[t * block_m + i]
+        valid = src >= 0
+        src0 = jnp.maximum(src, 0)
+        row = x_ref[pl.ds(src0 // top_k, 1), :]
+        a_scr[pl.ds(i, 1), :] = jnp.where(valid, row, 0)
+        pr = probs_ref[pl.ds(src0, 1), :]
+        p_scr[pl.ds(i, 1), :] = jnp.where(valid, pr, 0)
+        return 0
+
+    jax.lax.fori_loop(0, block_m, body, 0, unroll=8)
+    a = a_scr[...]
+    g = jnp.dot(a, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(a, wu_ref[0], preferred_element_type=jnp.float32)
+    hidden = (jax.nn.silu(g) * u).astype(a.dtype)
+    y = jnp.dot(hidden, wd_ref[0], preferred_element_type=jnp.float32)
+    out_ref[...] = (y * p_scr[...]).astype(out_ref.dtype)
+
+
 def _vmem_bytes_estimate(
     h: int, inter: int, block_m: int, itemsize: int
 ) -> int:
@@ -146,23 +189,58 @@ def _vmem_bytes_estimate(
     return weights + tiles + scratch
 
 
+def _vmem_budget() -> int:
+    """Shared VMEM budget for both eligibility gates. Default: v5e/v4
+    VMEM is 128 MiB/core; leave headroom for Mosaic's own staging. Read
+    at call time like the file's other env knobs."""
+    return int(
+        os.environ.get("D9D_TPU_MOE_FFN_VMEM_BUDGET", 96 * 1024 * 1024)
+    )
+
+
+# scalar-prefetch budget for the gather variant's SMEM riders (gid +
+# pair_src, int32). TPU scalar memory is far smaller than VMEM and its
+# exact capacity is generation/toolchain-dependent — this conservative
+# cap routes oversized maps to the two-step path instead of risking a
+# Mosaic compile failure (same contract as the VMEM gate).
+_SMEM_PREFETCH_BUDGET = 256 * 1024
+
+
+def _gather_fits(
+    n: int, m: int, h: int, inter: int, block_m: int, itemsize: int,
+    num_experts: int = 0,
+) -> bool:
+    """Can the gather variant hold x [n, h] + probs [m, 1] resident in
+    VMEM on top of the base kernel footprint (plus its gather scratch),
+    and its index maps in scalar memory? Resident blocks are counted
+    double-buffered like every other pipelined input (their index map is
+    constant, but Pallas still allocates pipeline buffers). Also
+    requires n and m sublane-aligned (full-array blocks)."""
+    if n % 8 != 0 or m % 8 != 0:
+        return False
+    # SMEM riders: pair_src [m_pad] + gid [m_pad / block_m], int32
+    # (m_pad bound per aligned_metadata: every group pads by < block_m)
+    m_pad = (-(-m // block_m) + num_experts) * block_m
+    if 4 * (m_pad + m_pad // block_m) > _SMEM_PREFETCH_BUDGET:
+        return False
+    resident = (n * h * itemsize + m * 4) * 2  # double-buffered
+    scratch = block_m * h * itemsize + block_m * 4
+    return (
+        _vmem_bytes_estimate(h, inter, block_m, itemsize)
+        + resident + scratch
+    ) <= _vmem_budget()
+
+
 def _tpu_shapes_ok(
     h: int, inter: int, block_m: int, itemsize: int = 2
 ) -> bool:
     """Lane alignment AND VMEM fit — large h/inter geometries would fail
     at Mosaic compile instead of falling back (ADVICE r4), so estimate
-    the footprint and route oversized shapes to the XLA chain.
-
-    Budget default: v5e/v4 VMEM is 128 MiB/core; leave headroom for
-    Mosaic's own staging. Read at call time like the file's other env
-    knobs so tests/benches can set it after import.
-    """
+    the footprint and route oversized shapes to the XLA chain
+    (budget: :func:`_vmem_budget`)."""
     if not (h % LANES == 0 and inter % LANES == 0 and block_m % 8 == 0):
         return False
-    budget = int(
-        os.environ.get("D9D_TPU_MOE_FFN_VMEM_BUDGET", 96 * 1024 * 1024)
-    )
-    return _vmem_bytes_estimate(h, inter, block_m, itemsize) <= budget
+    return _vmem_bytes_estimate(h, inter, block_m, itemsize) <= _vmem_budget()
 
 
 @functools.partial(
@@ -202,6 +280,59 @@ def _fused_ffn_call(
     )(gid, aligned_x, aligned_probs, gate_w, up_w, down_w)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "top_k", "interpret")
+)
+def _fused_gather_call(
+    x: Array,
+    probs_flat: Array,
+    gid: Array,
+    pair_src: Array,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    *,
+    block_m: int,
+    top_k: int,
+    interpret: bool,
+) -> Array:
+    """``x [N, h]`` resident + in-kernel row gather → aligned ``[m_pad, h]``
+    outputs (same aligned layout as :func:`_fused_ffn_call`)."""
+    n, h = x.shape
+    m = probs_flat.shape[0]
+    inter = gate_w.shape[-1]
+    m_pad = pair_src.shape[0]
+    n_tiles = m_pad // block_m
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # gid + pair_src ride SMEM
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((n, h), lambda t, gid_ref, ps_ref: (0, 0)),
+            pl.BlockSpec((m, 1), lambda t, gid_ref, ps_ref: (0, 0)),
+            pl.BlockSpec((1, h, inter),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, h, inter),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, inter, h),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, h),
+                               lambda t, gid_ref, ps_ref: (t, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_m, h), x.dtype),
+            pltpu.VMEM((block_m, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _ffn_gather_kernel, block_m=block_m, top_k=top_k
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, h), x.dtype),
+        interpret=interpret,
+    )(gid, pair_src, x, probs_flat, gate_w, up_w, down_w)
+
+
 def _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype):
     """The existing XLA path (permute -> grouped matmuls -> combine);
     single source of truth for the custom_vjp backward AND the fallback.
@@ -231,7 +362,7 @@ def _zero_cotangent(x):
     return np.zeros(x.shape, dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
 def fused_moe_ffn(
     x: Array,
     probs: Array,
@@ -245,50 +376,67 @@ def fused_moe_ffn(
     num_experts: int,
     block_m: int,
     interpret: bool,
+    gather: bool,
 ) -> Array:
     """[N, D] tokens + routing -> combined [N, D] expert outputs.
 
     The TokenSort is passed as four flat arrays (custom_vjp cannot take a
     NamedTuple across the nondiff boundary); int arrays get float0
-    cotangents like pallas_flash's segment ids.
+    cotangents like pallas_flash's segment ids. ``gather`` selects the
+    in-kernel row-gather variant (x resident in VMEM; no HBM aligned
+    activation buffer).
     """
     out, _ = _fused_fwd(
         x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
-        group_sizes, num_experts, block_m, interpret,
+        group_sizes, num_experts, block_m, interpret, gather,
     )
     return out
 
 
 def _fused_fwd(
     x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
-    group_sizes, num_experts, block_m, interpret,
+    group_sizes, num_experts, block_m, interpret, gather,
 ):
     sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
     meta = aligned_metadata(sort, num_experts, block_m)
     n, h = x.shape
     k = dest.shape[0] // n
     dtype = gate_w.dtype  # caller pre-casts weights to the compute dtype
-    # ONE gather fills the aligned activation buffer (pair i owns token
-    # i // k); pad rows read token 0 and are zeroed by the mask. Traffic
-    # = today's sorted-layout gather PLUS the pad rows (m_pad - m zero
-    # rows written and re-read) — the static worst case pads every group
-    # by block_m, so keep E*block_m small against M (the block_m
-    # eligibility/sweep choices encode this).
-    valid = (meta.pair_src >= 0)[:, None]
-    token_src = jnp.maximum(meta.pair_src, 0) // k
-    aligned_x = jnp.where(
-        valid, jnp.take(x, token_src, axis=0), 0
-    ).astype(dtype)
-    aligned_probs = jnp.where(
-        valid,
-        jnp.take(probs.reshape(-1), jnp.maximum(meta.pair_src, 0))[:, None],
-        0,
-    ).astype(jnp.float32)
-    y_aligned = _fused_ffn_call(
-        aligned_x, aligned_probs, meta.gid,
-        gate_w, up_w, down_w,
-        block_m=block_m, interpret=interpret,
-    )
+    if gather:
+        # the kernel gathers rows itself from a VMEM-resident x — no
+        # [m_pad, h] aligned buffer in HBM at all (the buffer costs a
+        # full write + read per layer pass on the two-step path)
+        y_aligned = _fused_gather_call(
+            x.astype(dtype),
+            probs.reshape(-1, 1).astype(jnp.float32),
+            meta.gid, meta.pair_src,
+            gate_w, up_w, down_w,
+            block_m=block_m, top_k=k, interpret=interpret,
+        )
+    else:
+        # ONE gather fills the aligned activation buffer (pair i owns
+        # token i // k); pad rows read token 0 and are zeroed by the
+        # mask. Traffic = today's sorted-layout gather PLUS the pad rows
+        # (m_pad - m zero rows written and re-read) — the static worst
+        # case pads every group by block_m, so keep E*block_m small
+        # against M (the block_m eligibility/sweep choices encode this).
+        valid = (meta.pair_src >= 0)[:, None]
+        token_src = jnp.maximum(meta.pair_src, 0) // k
+        aligned_x = jnp.where(
+            valid, jnp.take(x, token_src, axis=0), 0
+        ).astype(dtype)
+        aligned_probs = jnp.where(
+            valid,
+            jnp.take(
+                probs.reshape(-1), jnp.maximum(meta.pair_src, 0)
+            )[:, None],
+            0,
+        ).astype(jnp.float32)
+        y_aligned = _fused_ffn_call(
+            aligned_x, aligned_probs, meta.gid,
+            gate_w, up_w, down_w,
+            block_m=block_m, interpret=interpret,
+        )
     # combine: collision-free gather by pair then K-sum (ops/moe.py
     # combine_pairs formulation, over the aligned layout)
     pair_y = jnp.take(y_aligned, meta.dest_aligned, axis=0)
@@ -298,7 +446,7 @@ def _fused_fwd(
     return out, residuals
 
 
-def _fused_bwd(num_experts, block_m, interpret, residuals, d_out):
+def _fused_bwd(num_experts, block_m, interpret, gather, residuals, d_out):
     (x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
      group_sizes) = residuals
     sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
@@ -320,7 +468,10 @@ fused_moe_ffn.defvjp(_fused_fwd, _fused_bwd)
 
 
 def moe_ffn_backend() -> str:
-    """'pallas' or 'xla' — env-selected like the SDPA backend family."""
+    """'pallas', 'pallas_gather' or 'xla' — env-selected like the SDPA
+    backend family. ``pallas_gather`` additionally fuses the permute
+    gather into the kernel (x resident in VMEM; falls back to plain
+    ``pallas`` when the residency doesn't fit)."""
     return os.environ.get("D9D_TPU_MOE_FFN", "xla")
 
 
@@ -336,9 +487,13 @@ def fused_moe_ffn_apply(
     num_experts: int,
     block_m: int | None = None,
     interpret: bool | None = None,
+    gather: bool | None = None,
 ) -> Array:
     """Entry point for nn/moe.py: fused kernel when eligible, else the
-    reference XLA chain (identical math either way)."""
+    reference XLA chain (identical math either way). ``gather`` forces
+    the in-kernel row-gather variant on/off (None = env-selected via
+    ``D9D_TPU_MOE_FFN=pallas_gather``); either way the VMEM-fit gate
+    can veto it."""
     h = x.shape[-1]
     inter = gate_w.shape[-1]
     if interpret is None:
@@ -348,13 +503,19 @@ def fused_moe_ffn_apply(
     itemsize = jnp.dtype(dtype).itemsize
     if not interpret and not _tpu_shapes_ok(h, inter, block_m, itemsize):
         return _reference_apply(x, probs, sort, gate_w, up_w, down_w, dtype)
+    if gather is None:
+        gather = moe_ffn_backend() == "pallas_gather"
+    gather = gather and _gather_fits(
+        x.shape[0], probs.size, h, inter, block_m, itemsize,
+        num_experts=num_experts,
+    )
     from jax.ad_checkpoint import checkpoint_name
 
     out = fused_moe_ffn(
         x, probs,
         gate_w.astype(dtype), up_w.astype(dtype), down_w.astype(dtype),
         sort.sort_idx, sort.dest, sort.token_idx, sort.group_sizes,
-        num_experts, block_m, interpret,
+        num_experts, block_m, interpret, gather,
     )
     # same checkpoint name the XLA chain's grouped dots carry, so the
     # save_expensive remat policy keeps its meaning under this backend

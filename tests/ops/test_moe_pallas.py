@@ -85,7 +85,9 @@ class TestAlignedMetadata:
 
 class TestFusedParity:
     @pytest.mark.parametrize("block_m", [8, 16, 64])
-    def test_forward_matches_reference(self, block_m):
+    @pytest.mark.parametrize("backend", ["pallas", "pallas_gather"])
+    def test_forward_matches_reference(self, block_m, backend, monkeypatch):
+        monkeypatch.setenv("D9D_TPU_MOE_FFN", backend)
         x, ids, probs, wg, wu, wd = _problem()
         e = wg.shape[0]
         sort = sort_tokens_by_expert(ids, e)
@@ -97,6 +99,43 @@ class TestFusedParity:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
+
+    def test_gather_variant_gradients(self, monkeypatch):
+        """The gather variant shares the reference backward; its custom
+        fwd must still produce exact grads end to end."""
+        monkeypatch.setenv("D9D_TPU_MOE_FFN", "pallas_gather")
+        x, ids, probs, wg, wu, wd = _problem(seed=11)
+        e = wg.shape[0]
+        sort = sort_tokens_by_expert(ids, e)
+
+        def loss(fn):
+            def run(x_, wg_):
+                return (fn(x_, wg_) ** 2).sum()
+            return run
+
+        fused = loss(lambda x_, wg_: fused_moe_ffn_apply(
+            x_, probs, sort, wg_, wu, wd, jnp.float32,
+            num_experts=e, block_m=16, interpret=True,
+        ))
+        ref = loss(lambda x_, wg_: _reference(
+            x_, probs, sort, wg_, wu, wd, jnp.float32
+        ))
+        gf = jax.grad(fused, argnums=(0, 1))(x, wg)
+        gr = jax.grad(ref, argnums=(0, 1))(x, wg)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+            )
+
+    def test_gather_fit_gate_falls_back(self, monkeypatch):
+        """Unaligned token counts (n % 8 != 0) must silently use the
+        two-step aligned path, not the resident-x kernel."""
+        from d9d_tpu.ops.moe_pallas import _gather_fits
+
+        assert _gather_fits(96, 192, 64, 32, 16, 4)
+        assert not _gather_fits(97, 194, 64, 32, 16, 4)  # misaligned
+        monkeypatch.setenv("D9D_TPU_MOE_FFN_VMEM_BUDGET", "1024")
+        assert not _gather_fits(96, 192, 64, 32, 16, 4)  # over budget
 
     def test_gradients_match_reference(self):
         x, ids, probs, wg, wu, wd = _problem(seed=3)

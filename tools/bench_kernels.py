@@ -357,6 +357,14 @@ def bench_moe_ffn(tiny):
                 jnp.bfloat16, num_experts=e, block_m=bm,
             )
         )
+        # r5: in-kernel row gather (x resident in VMEM) — the aligned
+        # activation buffer never round-trips HBM
+        variants[f"pallas_gather_bm{bm}"] = jax.jit(
+            lambda x, probs, ids, wg, wu, wd, bm=bm: fused_moe_ffn_apply(
+                x, probs, sort_tokens_by_expert(ids, e), wg, wu, wd,
+                jnp.bfloat16, num_experts=e, block_m=bm, gather=True,
+            )
+        )
     cfg = f"n{n}_h{h}_i{inter}_e{e}_k{k}"
     for name, fn in variants.items():
         emit_timed("moe_ffn_fwd", name, cfg, fn, x, probs, ids, wg, wu, wd)

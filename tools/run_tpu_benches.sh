@@ -107,6 +107,19 @@ r["detail"]["variant"] = "ub1_pallas_fused_ffn"
 print(json.dumps(r))
 EOF
 
+# r5 A/B: gather-fused expert FFN — x resident in VMEM, rows gathered
+# in-kernel, no HBM aligned activation buffer (falls back to plain
+# pallas when the residency gate vetoes; bench_kernels --only moe_ffn
+# carries the isolated kernel rows)
+D9D_TPU_MOE_FFN=pallas_gather run_leg "MoE ub1 + pallas gather-fused FFN" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub1_pallas_gather_ffn"
+print(json.dumps(r))
+EOF
+
 # A/B: gate+up WITHOUT the runtime weight concat (tools/roofline.py
 # predicts the concat copy inverts the r3 fusion win at ub1/fp32).
 # D9D_TPU_MOE_FFN pinned to xla: under the pallas backend the knob is
