@@ -208,7 +208,7 @@ _SMEM_PREFETCH_BUDGET = 256 * 1024
 
 def _gather_fits(
     n: int, m: int, h: int, inter: int, block_m: int, itemsize: int,
-    num_experts: int = 0,
+    num_experts: int,
 ) -> bool:
     """Can the gather variant hold x [n, h] + probs [m, 1] resident in
     VMEM on top of the base kernel footprint (plus its gather scratch),

@@ -132,10 +132,18 @@ class TestFusedParity:
         two-step aligned path, not the resident-x kernel."""
         from d9d_tpu.ops.moe_pallas import _gather_fits
 
-        assert _gather_fits(96, 192, 64, 32, 16, 4)
-        assert not _gather_fits(97, 194, 64, 32, 16, 4)  # misaligned
+        assert _gather_fits(96, 192, 64, 32, 16, 4, num_experts=8)
+        assert not _gather_fits(
+            97, 194, 64, 32, 16, 4, num_experts=8  # misaligned
+        )
+        # the SMEM estimate must count aligned_metadata's real pair_src
+        # length ((ceil(m/bm) + E) * bm), so a huge expert count alone
+        # can veto even when VMEM residency fits
+        assert not _gather_fits(96, 192, 64, 32, 16, 4, num_experts=8192)
         monkeypatch.setenv("D9D_TPU_MOE_FFN_VMEM_BUDGET", "1024")
-        assert not _gather_fits(96, 192, 64, 32, 16, 4)  # over budget
+        assert not _gather_fits(
+            96, 192, 64, 32, 16, 4, num_experts=8  # over budget
+        )
 
     def test_gradients_match_reference(self):
         x, ids, probs, wg, wu, wd = _problem(seed=3)
