@@ -4,6 +4,12 @@ match full-forward logits exactly (teacher forcing)."""
 import numpy as np
 import pytest
 
+# slow tier (r5 quick-tier trim): whole-model prefill+decode parity loops
+# dominate the quick tier (~5 min on a 1-CPU box); the quick decode
+# signal lives in tests/nn/test_decode_contracts.py and
+# tests/ops/test_decode_attention.py
+pytestmark = pytest.mark.e2e
+
 import jax
 import jax.numpy as jnp
 

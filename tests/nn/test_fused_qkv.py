@@ -2,6 +2,11 @@
 three Dense projections with the SAME parameter pytree (r4 dense-MFU
 lever; checkpoints/plans see no difference)."""
 
+import pytest
+
+# slow tier (r5 quick-tier trim): whole-model double-build parity
+pytestmark = pytest.mark.e2e
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -99,6 +99,7 @@ def test_parity_under_jit_traced_start():
         )
 
 
+@pytest.mark.e2e  # slow tier: whole-module prefill+decode loop ×2 backends
 def test_gqa_module_routes_pallas(monkeypatch):
     """GroupedQueryAttention decode through the kernel (env-forced on
     CPU → interpret mode) must match the default eager routing."""

@@ -214,6 +214,7 @@ class TestFusedParity:
         )
 
 
+@pytest.mark.e2e  # slow tier: 4-seed randomized sweep (r5 quick trim)
 @pytest.mark.parametrize("seed", range(4))
 def test_fused_parity_random_geometry(seed):
     """Randomized geometry sweep: token counts not divisible by block_m,
@@ -285,6 +286,7 @@ def test_unfused_gate_up_env_knob_exact(monkeypatch):
     )
 
 
+@pytest.mark.e2e  # slow tier: whole-layer double-run (r5 quick trim)
 class TestLayerIntegration:
     def test_moe_layer_env_switch(self, monkeypatch):
         """MoELayer output is identical (to tolerance) with the pallas
