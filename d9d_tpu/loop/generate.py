@@ -70,6 +70,7 @@ def generate(
     top_k: int | None = None,
     rng: Optional[jax.Array] = None,
     eos_id: int | None = None,
+    prefill_chunk_size: int | None = None,
 ) -> Array:
     """``prompt_ids [B, P]`` int32 → generated ids ``[B, max_new_tokens]``.
 
@@ -78,6 +79,16 @@ def generate(
     token is returned, never fed back). Ragged batches: left-pad to width
     P and pass ``prompt_lengths [B]``. The whole prefill + decode scan
     jits as one program; call under ``jax.jit`` for repeat use.
+
+    ``prefill_chunk_size``: feed the prompt through the cache in chunks
+    of at most this many tokens (long-context serving: prefill
+    activation memory stays O(chunk) instead of O(P)). The first chunk
+    runs the flash prefill fast path; continuation chunks attend the
+    slot cache (``d9d_tpu.nn.decode_flags.continuation_chunk``) —
+    results are exact, not approximate. Keep the chunk at or below
+    ``MAX_DECODE_ROWS // (Hq/Hkv)`` (ops/attention/pallas_decode.py) so
+    GQA continuation chunks ride the flash-decode kernel on TPU rather
+    than the eager ``[t, s_max]`` fallback.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
@@ -148,11 +159,31 @@ def generate(
             **kwargs,
         )
 
-    # prefill: run the whole prompt once, writing every layer's cache;
-    # only the last position's logits are needed (logits_last fast path)
+    # prefill: write every layer's cache; only the last position's
+    # logits are needed (logits_last fast path). With a chunk size, the
+    # prompt streams through in bounded pieces — chunk 0 on the empty
+    # cache (fast path), the rest as slot-cache continuation chunks
+    # (static Python loop: each chunk traces once with static shapes)
+    if prefill_chunk_size is not None and prefill_chunk_size < 1:
+        raise ValueError(
+            f"prefill_chunk_size must be >= 1, got {prefill_chunk_size}"
+        )
+    from d9d_tpu.nn.decode_flags import continuation_chunk
+
+    ids = prompt_ids.astype(jnp.int32)
+    chunk = prefill_chunk_size if prefill_chunk_size is not None else p
     logits, state = call(
-        {"params": params}, prompt_ids.astype(jnp.int32), positions, pad_mask
+        {"params": params}, ids[:, :chunk], positions[:, :chunk],
+        None if pad_mask is None else pad_mask[:, :chunk],
     )
+    for lo in range(chunk, p, chunk):
+        hi = min(lo + chunk, p)
+        with continuation_chunk():
+            logits, state = call(
+                {"params": params, "cache": state["cache"]},
+                ids[:, lo:hi], positions[:, lo:hi],
+                None if pad_mask is None else pad_mask[:, lo:hi],
+            )
     key, sub = jax.random.split(rng)
     token = sample(logits[:, -1], sub)
     done = (

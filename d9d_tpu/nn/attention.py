@@ -22,8 +22,10 @@ from d9d_tpu.ops import RopeStyle, apply_rope
 
 def _decode_contract_checks(start, t: int, s_max: int):
     """Functionalized assertions for the two traced decode contracts
-    (ADVICE r4): the multi-token prefill fast path is only valid on an
-    empty cache, and the cache must never overflow (past capacity,
+    (ADVICE r4): the multi-token prefill FAST PATH is only valid on an
+    empty cache (continuation chunks — ``in_continuation_chunk()`` —
+    take the slot-cache path instead and are valid at any index), and
+    the cache must never overflow (past capacity,
     ``dynamic_update_slice`` clamps and attention silently degrades).
     ``checkify.debug_check`` is a no-op in plain jit but fails loudly
     when the caller wraps with ``checkify.checkify`` — which the decode
@@ -32,18 +34,21 @@ def _decode_contract_checks(start, t: int, s_max: int):
     """
     from jax.experimental import checkify
 
+    from d9d_tpu.nn.decode_flags import in_continuation_chunk
+
     checkify.debug_check(
         start + t <= s_max,
         f"decode cache overflow: start {{start}} + {t} new tokens exceed "
         f"decode_max_length={s_max}",
         start=start,
     )
-    if t > 1:
+    if t > 1 and not in_continuation_chunk():
         checkify.debug_check(
             start == 0,
             f"decode prefill (t={t} > 1) requires an empty cache "
             f"(the fast path attends only the new tokens); got cache "
-            f"index {{start}}",
+            f"index {{start}} — wrap continuation chunks in "
+            f"d9d_tpu.nn.decode_flags.continuation_chunk()",
             start=start,
         )
 
@@ -354,8 +359,10 @@ class GroupedQueryAttention(nn.Module):
         capacity/mask contracts: the module-level ``_decode_cache_append``
         / ``_decode_slot_mask`` helpers.
         """
+        from d9d_tpu.nn.decode_flags import in_continuation_chunk
         from d9d_tpu.ops.attention.eager import eager_sdpa
         from d9d_tpu.ops.attention.pallas_decode import (
+            MAX_DECODE_ROWS,
             decode_attention_backend,
             flash_decode_attention,
         )
@@ -373,15 +380,18 @@ class GroupedQueryAttention(nn.Module):
             self, v.astype(self.dtype), "cached_value", s_max, start
         )
         idx.value = start + t
-        if t > 1:
+        if t > 1 and not in_continuation_chunk():
             # PREFILL fast path: attend the new tokens against themselves
             # through the training SDPA (flash on TPU) — the eager slot
             # path would materialize [t, s_max] logits, which explodes
             # for long prompts. Valid only when the cache was empty
             # (start == 0), which is exactly how loop/generate.py issues
-            # its one multi-token call; start is traced, so the contract
-            # is asserted via checkify (_decode_contract_checks) and
-            # enforced statically by generate().
+            # its first (or only) multi-token call; start is traced, so
+            # the contract is asserted via checkify
+            # (_decode_contract_checks) and enforced statically by
+            # generate(). Continuation prefill chunks (chunked prefill,
+            # loop/generate.py prefill_chunk_size) fall through to the
+            # slot-cache path below, which is valid at any cache index.
             return self.sdpa(
                 q, k, v,
                 causal=True,
@@ -393,7 +403,12 @@ class GroupedQueryAttention(nn.Module):
         key_validity_mask = mask is None or (
             mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1
         )
-        if decode_attention_backend() == "pallas" and key_validity_mask:
+        rows = (self.num_heads // self.num_kv_heads) * t
+        if (
+            decode_attention_backend() == "pallas"
+            and key_validity_mask
+            and rows <= MAX_DECODE_ROWS
+        ):
             _check_slot_mask(mask, s_max)
             return flash_decode_attention(
                 q, keys, values,
@@ -586,9 +601,11 @@ class MultiHeadLatentAttention(nn.Module):
                 start,
             )
             idx.value = start + t
-            if t == 1:
+            from d9d_tpu.nn.decode_flags import in_continuation_chunk
+
+            if t == 1 or in_continuation_chunk():
                 dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
-                if self.decode_absorbed:
+                if t == 1 and self.decode_absorbed:
                     # ABSORBED form (DeepSeek-V2 decode trick): fold
                     # W_up^K into the query and W_up^V into the output —
                     # q_nope^T (W_k c) == (W_k^T q_nope)^T c — so
@@ -600,6 +617,11 @@ class MultiHeadLatentAttention(nn.Module):
                         dec_mask, d_qk, d_nope, d_v,
                     )
                 else:
+                    # decompressed slot attention: the single-step
+                    # oracle (decode_absorbed=False) and the
+                    # continuation-prefill-chunk path — a chunk
+                    # amortizes the one full-cache decompression over
+                    # its t tokens (the vLLM-style MLA chunk recipe)
                     out = self._decompressed_attend(
                         q, cached_c, cached_r, kv_up_w, dec_mask,
                         d_qk, d_nope,
@@ -610,8 +632,9 @@ class MultiHeadLatentAttention(nn.Module):
             # prefill (t > 1): decompress only the NEW tokens and attend
             # them causally through the training SDPA — valid for the
             # first call (start == 0), which is how loop/generate.py
-            # issues its one multi-token call (contract documented at
-            # GroupedQueryAttention._decode_attend)
+            # issues its first (or only) multi-token call (contract at
+            # GroupedQueryAttention._decode_attend; continuation chunks
+            # took the slot path above)
             prefill_segs = _prefill_segments(mask, t, s_max)
         k, v = _decompress_kv(c_kv, k_rope, kv_up_w, h, d_nope, self.dtype)
 

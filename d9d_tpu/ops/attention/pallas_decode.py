@@ -53,6 +53,13 @@ from d9d_tpu.core.types import Array
 NEG_BIG = -1e30
 LANES = 128
 
+# practical bound on the resident q block (g·T rows): the kernel keeps
+# one un-tiled [rows, D] q block + fp32 accumulators per (b, kv-head);
+# beyond this, a big prefill chunk is better served by the training
+# flash kernel's tiled grid (callers fall back to the eager slot path
+# or cap their chunk size — loop/generate.py documents the bound)
+MAX_DECODE_ROWS = 1024
+
 
 def decode_attention_backend() -> str:
     """'pallas' or 'eager' — env-selected like the SDPA backend family.
