@@ -1,0 +1,272 @@
+"""Continuous batching: a slot-based serving loop over decode models.
+
+Beyond-reference surface (the reference's ``Inference`` is forward-only
+batch scoring; its serving story ends there). ``ContinuousBatcher``
+keeps a fixed batch of ``batch_size`` slots decoding through ONE jitted
+single-token step; requests are admitted into free slots as they
+arrive and evicted on EOS/budget — rows never wait for each other
+(the vLLM-style iteration-level scheduling loop, in its static-shape
+TPU form).
+
+Static shapes are the law under XLA, so admission is TOKEN-LEVEL: the
+step always processes exactly one token per slot. A newly admitted
+request spends its first ``len(prompt)`` steps consuming its prompt
+(teacher-forced through the same decode step — cache contents and the
+final-position logits are bit-identical to a one-shot prefill), then
+flips to generation. The price is prompt consumption at one token per
+step; long prompts can instead be pre-filled out-of-band with
+``generate``'s chunked prefill and handed over — the primitives
+compose, this loop stays shape-static.
+
+Per-row cache state rides the decode modules unchanged: the serving
+loop seeds the flax cache with a PER-ROW ``[B]`` ``cache_index``
+(modules accept either rank — ``nn/attention.py``), the flash-decode
+kernel takes per-row ``start`` offsets natively
+(``ops/attention/pallas_decode.py``), and row admission resets just
+that row's cache slice (every cache leaf leads with the batch dim).
+GDN layers need nothing: their recurrent state is per-row already.
+
+Parity contract: greedy serving of any admission schedule must emit,
+per request, exactly the tokens ``generate(model, params, prompt)``
+produces — ``tests/loop/test_serve.py`` drives staggered schedules
+against that oracle.
+"""
+
+import collections
+import dataclasses
+import inspect
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d9d_tpu.core.types import Array
+
+
+@dataclasses.dataclass
+class _Slot:
+    rid: int = -1            # active request id, -1 = idle
+    pending: list = dataclasses.field(default_factory=list)  # prompt left
+    pos: int = 0             # next rope position for this row
+    emitted: int = 0
+    budget: int = 0          # max_new_tokens for the active request
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: list
+    max_new_tokens: int
+
+
+def _zero_row(cache, row_mask: Array):
+    """Zero every cache leaf's ``row_mask``-selected batch rows (all
+    decode cache leaves — KV/latent caches, GDN state, conv tails,
+    per-row cache_index — lead with the batch dim)."""
+    def z(x):
+        m = row_mask.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(m, jnp.zeros_like(x), x)
+
+    return jax.tree.map(z, cache)
+
+
+class ContinuousBatcher:
+    """Iteration-level scheduler over a KV-cache decode model.
+
+    ``model`` must be built with ``decode_max_length`` ≥ the longest
+    ``len(prompt) + max_new_tokens - 1`` it will serve. ``submit()``
+    queues a request (admitted into the first free slot at the next
+    ``step()``); each ``step()`` advances every active slot by one
+    token and returns ``{rid: token}`` for tokens EMITTED this step
+    (generation phase only). ``outputs[rid]`` accumulates; ``drain()``
+    runs steps until every submitted request finishes.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        batch_size: int,
+        eos_id: Optional[int] = None,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+    ):
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature > 0 needs an rng key")
+        self._model = model
+        self._params = params
+        self._b = batch_size
+        self._eos = eos_id
+        self._temp = temperature
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._dml = int(getattr(model, "decode_max_length", 0))
+        if self._dml <= 0:
+            raise ValueError("model must be built with decode_max_length > 0")
+
+        self._slots = [_Slot() for _ in range(batch_size)]
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._next_rid = 0
+        self._tokens = np.zeros((batch_size,), np.int32)  # next inputs
+        self.outputs: dict[int, list[int]] = {}
+        self.done: set[int] = set()
+
+        method = getattr(model, "logits_last", None) or model.logits
+        accepts_padding = (
+            "padding_mask" in inspect.signature(method).parameters
+        )
+        step_pad = (
+            jnp.ones((batch_size, 1), jnp.bool_) if accepts_padding else None
+        )
+
+        def step_fn(cache, tok, pos, key):
+            kwargs = {"mask": None}
+            if step_pad is not None:
+                kwargs["padding_mask"] = step_pad
+            logits, state = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None], pos[:, None],
+                method=method, mutable=["cache"], **kwargs,
+            )
+            row_logits = logits[:, -1].astype(jnp.float32)
+            if temperature == 0.0:
+                nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(
+                    key, row_logits / temperature, axis=-1
+                ).astype(jnp.int32)
+            return state["cache"], nxt
+
+        # donate the cache: XLA aliases input buffers to outputs, so the
+        # per-step update is in place — no second cache residency or
+        # full-cache memcpy per token
+        self._step = jax.jit(step_fn, donate_argnums=0)
+        self._reset = jax.jit(_zero_row, donate_argnums=0)
+        self._cache = self._init_cache()
+
+    def _init_cache(self):
+        z = jnp.zeros((self._b, 1), jnp.int32)
+        # eval_shape: cache SHAPES only — model.init would materialize
+        # (and immediately discard) a full second copy of the parameters
+        shapes = jax.eval_shape(
+            self._model.init, jax.random.PRNGKey(0), z, z, z
+        )
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+        )
+        # per-row write indices: seed [B] zeros in place of the scalar —
+        # the decode modules accept either rank (nn/attention.py)
+        from flax.traverse_util import flatten_dict, unflatten_dict
+
+        flat = flatten_dict(cache)
+        for path in list(flat):
+            if path[-1] == "cache_index":
+                flat[path] = jnp.zeros((self._b,), jnp.int32)
+        return unflatten_dict(flat)
+
+    # ------------------------------------------------------------------
+    def submit(
+        self, prompt: Sequence[int], *, max_new_tokens: int
+    ) -> int:
+        """Queue a request; returns its request id. Admission happens at
+        the next step() with a free slot."""
+        prompt = [int(x) for x in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        need = len(prompt) + max_new_tokens - 1
+        if need > self._dml:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens}"
+                f" - 1 = {need} exceeds decode_max_length={self._dml}"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        self.outputs[rid] = []
+        return rid
+
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self._slots if s.rid >= 0) + len(self._queue)
+
+    def _admit(self):
+        reset_mask = np.zeros((self._b,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot.rid >= 0 or not self._queue:
+                continue
+            req = self._queue.popleft()
+            self._slots[i] = _Slot(
+                rid=req.rid,
+                pending=list(req.prompt[1:]),
+                pos=0,
+                emitted=0,
+                budget=req.max_new_tokens,
+            )
+            self._tokens[i] = req.prompt[0]
+            reset_mask[i] = True
+        if reset_mask.any():
+            self._cache = self._reset(
+                self._cache, jnp.asarray(reset_mask)
+            )
+
+    def step(self) -> dict[int, int]:
+        """Admit waiting requests, advance every slot one token; returns
+        ``{rid: token}`` for tokens emitted (generation phase) this step."""
+        self._admit()
+        if all(s.rid < 0 for s in self._slots):
+            return {}
+        pos = np.asarray([s.pos for s in self._slots], np.int32)
+        self._rng, sub = jax.random.split(self._rng)
+        self._cache, nxt = self._step(
+            self._cache, jnp.asarray(self._tokens), jnp.asarray(pos), sub
+        )
+        nxt = np.asarray(nxt)
+
+        emitted: dict[int, int] = {}
+        evict_mask = np.zeros((self._b,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot.rid < 0:
+                continue
+            slot.pos += 1
+            if slot.pending:  # still consuming the prompt
+                self._tokens[i] = slot.pending.pop(0)
+                continue
+            tok = int(nxt[i])  # sampled from the row's latest position
+            emitted[slot.rid] = tok
+            self.outputs[slot.rid].append(tok)
+            slot.emitted += 1
+            finished = slot.emitted >= slot.budget or (
+                self._eos is not None and tok == self._eos
+            )
+            if finished:
+                self.done.add(slot.rid)
+                self._slots[i] = _Slot()
+                self._tokens[i] = 0
+                evict_mask[i] = True
+            else:
+                self._tokens[i] = tok
+        if evict_mask.any():
+            # reset at EVICTION, not just admission: an idle row still
+            # runs through the jitted step, so its cache_index would
+            # otherwise climb past capacity (spurious checkify overflow
+            # under contract validation) and defeat the flash kernel's
+            # whole-block skip (a huge start makes every block visible)
+            self._cache = self._reset(
+                self._cache, jnp.asarray(evict_mask)
+            )
+        return emitted
+
+    def drain(self, max_steps: int = 100_000) -> dict[int, list[int]]:
+        """Step until every submitted request has finished."""
+        steps = 0
+        while self.active:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("drain exceeded max_steps")
+        return self.outputs

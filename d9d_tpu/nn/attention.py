@@ -36,26 +36,33 @@ def _decode_contract_checks(start, t: int, s_max: int):
 
     from d9d_tpu.nn.decode_flags import in_continuation_chunk
 
+    # jnp.all: start may be per-row [B] (continuous batching)
     checkify.debug_check(
-        start + t <= s_max,
-        f"decode cache overflow: start {{start}} + {t} new tokens exceed "
+        jnp.all(start + t <= s_max),
+        f"decode cache overflow: cache index + {t} new tokens exceed "
         f"decode_max_length={s_max}",
-        start=start,
     )
     if t > 1 and not in_continuation_chunk():
         checkify.debug_check(
-            start == 0,
+            jnp.all(start == 0),
             f"decode prefill (t={t} > 1) requires an empty cache "
-            f"(the fast path attends only the new tokens); got cache "
-            f"index {{start}} — wrap continuation chunks in "
+            f"(the fast path attends only the new tokens); wrap "
+            f"continuation chunks in "
             f"d9d_tpu.nn.decode_flags.continuation_chunk()",
-            start=start,
         )
 
 
 def _decode_cache_index(module: nn.Module):
-    """The module's single decode write-index variable (declare once per
-    trace — flax forbids re-declaring a name within one __call__)."""
+    """The module's decode write-index variable (declare once per trace —
+    flax forbids re-declaring a name within one __call__).
+
+    Initialized SCALAR (one shared index — the closed-batch generate
+    loop). A serving loop may seed the cache collection with a per-row
+    ``[B]`` index instead (flax returns the provided value untouched);
+    every consumer below handles both ranks — this is how continuous
+    batching (loop/serve.py) lets each row's cache fill at its own rate
+    without any module plumbing.
+    """
     return module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
@@ -63,7 +70,8 @@ def _decode_cache_index(module: nn.Module):
 
 def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
                          start):
-    """Append ``value [B, T, ...]`` at cache slot ``start``.
+    """Append ``value [B, T, ...]`` at cache slot ``start`` (scalar, or
+    per-row ``[B]`` for continuous batching).
 
     One definition for every decode cache (GQA k/v, MLA latent/rope key).
     Capacity contract: callers must never feed more than ``s_max`` total
@@ -79,9 +87,16 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
         "cache", name,
         lambda: jnp.zeros((b, s_max) + value.shape[2:], value.dtype),
     )
-    ref.value = lax.dynamic_update_slice(
-        ref.value, value, (0, start) + (0,) * (value.ndim - 2)
-    )
+    if jnp.ndim(start) == 0:
+        ref.value = lax.dynamic_update_slice(
+            ref.value, value, (0, start) + (0,) * (value.ndim - 2)
+        )
+    else:
+        ref.value = jax.vmap(
+            lambda c, v, s: lax.dynamic_update_slice(
+                c, v, (s,) + (0,) * (v.ndim - 1)
+            )
+        )(ref.value, value, start)
     return ref.value
 
 
@@ -104,9 +119,15 @@ def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
         "cache", name,
         lambda: jnp.zeros((b, h, s_max, d), value.dtype),
     )
-    ref.value = lax.dynamic_update_slice(
-        ref.value, jnp.transpose(value, (0, 2, 1, 3)), (0, 0, start, 0)
-    )
+    vt = jnp.transpose(value, (0, 2, 1, 3))
+    if jnp.ndim(start) == 0:
+        ref.value = lax.dynamic_update_slice(
+            ref.value, vt, (0, 0, start, 0)
+        )
+    else:  # per-row [B] write indices (continuous batching)
+        ref.value = jax.vmap(
+            lambda c, v, s: lax.dynamic_update_slice(c, v, (0, s, 0))
+        )(ref.value, vt, start)
     return ref.value
 
 
@@ -127,13 +148,24 @@ def _check_slot_mask(mask, s_max: int):
 
 def _decode_slot_mask(start, t: int, s_max: int, window_size, mask):
     """Slot-based causal (+window, +caller) mask for decode attention
-    (mask contract: :func:`_check_slot_mask`)."""
+    (mask contract: :func:`_check_slot_mask`). ``start`` scalar →
+    ``[1, 1, t, s_max]``; per-row ``[B]`` → ``[B, 1, t, s_max]``."""
     _check_slot_mask(mask, s_max)
-    q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
-    k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
-    dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, s_max]
-    if window_size is not None:
-        dec_mask &= (k_pos > q_abs - window_size)[None, None]
+    if jnp.ndim(start) == 0:
+        q_abs = start + jnp.arange(t, dtype=jnp.int32)[:, None]
+        k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+        dec_mask = (k_pos <= q_abs)[None, None]  # [1, 1, t, s_max]
+        if window_size is not None:
+            dec_mask &= (k_pos > q_abs - window_size)[None, None]
+    else:
+        q_abs = (
+            start[:, None, None]
+            + jnp.arange(t, dtype=jnp.int32)[None, :, None]
+        )  # [B, t, 1]
+        k_pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
+        dec_mask = (k_pos <= q_abs)[:, None]  # [B, 1, t, s_max]
+        if window_size is not None:
+            dec_mask &= (k_pos > q_abs - window_size)[:, None]
     if mask is not None:
         dec_mask = dec_mask & mask
     return dec_mask

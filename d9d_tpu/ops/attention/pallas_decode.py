@@ -95,7 +95,9 @@ def _decode_kernel(*refs, cfg: _DecodeConfig):
         offs_ref, q_ref, k_ref, v_ref = refs[:4]
         o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[4:]
         valid_ref = None
-    start = offs_ref[0]
+    # per-batch-row write index (continuous batching: rows fill at
+    # independent rates; a shared index is just the broadcast case)
+    start = offs_ref[pl.program_id(0)]
     ik = pl.program_id(2)
     n_kv = pl.num_programs(2)
 
@@ -239,12 +241,14 @@ def flash_decode_attention(
     into the kernel with zero per-step relayout) → ``[B,T,Hq,D]``.
 
     Slot-causal + optional sliding window over global positions;
-    ``kv_valid [B,S]`` masks dead slots (left-padded ragged prompts);
-    ``sinks [Hq]`` join the softmax denominator via the standard
-    outside-the-kernel correction. Forward-only (decode never
-    backpropagates). Semantics match ``eager_sdpa(q, cacheᵀ, cacheᵀ,
-    causal=False, mask=_decode_slot_mask(...))`` — the parity test
-    drives both.
+    ``start`` may be a scalar (one shared write index — the closed-batch
+    generate loop) or per-row ``[B]`` (continuous batching: each row's
+    cache fills at its own rate). ``kv_valid [B,S]`` masks dead slots
+    (left-padded ragged prompts); ``sinks [Hq]`` join the softmax
+    denominator via the standard outside-the-kernel correction.
+    Forward-only (decode never backpropagates). Semantics match
+    ``eager_sdpa(q, cacheᵀ, cacheᵀ, causal=False,
+    mask=_decode_slot_mask(...))`` — the parity test drives both.
     """
     b, t, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
@@ -285,7 +289,9 @@ def flash_decode_attention(
     if kv_valid is not None:
         validp = jnp.pad(kv_valid, ((0, 0), (0, pad_s))) if pad_s else kv_valid
 
-    offsets = jnp.asarray(start, jnp.int32).reshape(1)
+    offsets = jnp.broadcast_to(
+        jnp.asarray(start, jnp.int32).reshape(-1), (b,)
+    )
     o, lse = _decode_call(cfg, q_rows, kp, vp, validp, offsets)
 
     o = o[:, :, :rows]

@@ -80,6 +80,26 @@ def test_parity_sinks_and_validity():
     )
 
 
+def test_parity_per_row_start():
+    """Continuous batching: each row carries its own write index; the
+    kernel must mask slot-causally per row (oracle: per-row slot mask)."""
+    b, t, hq, hkv, d, s = 3, 1, 4, 2, 16, 64
+    q, k, v = _mk(b, t, hq, hkv, d, s, seed=9)
+    starts = jnp.asarray([0, 17, 63], jnp.int32)
+    got = flash_decode_attention(
+        q, k, v, start=starts, interpret=True, block_kv=32
+    )
+    for i in range(b):
+        want_i = _oracle(
+            q[i : i + 1], k[i : i + 1], v[i : i + 1],
+            int(starts[i]), None, None, None,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[i : i + 1]), np.asarray(want_i),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
 def test_parity_under_jit_traced_start():
     """start is traced in real decode loops (lax.scan carry)."""
     b, t, hq, hkv, d, s = 1, 1, 4, 4, 16, 64
