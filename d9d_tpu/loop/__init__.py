@@ -15,6 +15,8 @@ from d9d_tpu.loop.control.providers import (
 from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.generate import generate
+from d9d_tpu.loop.serve import ContinuousBatcher
+from d9d_tpu.loop.speculative import speculative_generate
 from d9d_tpu.loop.inference import (
     Inference,
     InferenceTask,
@@ -58,4 +60,6 @@ __all__ = [
     "Trainer",
     "build_train_step",
     "generate",
+    "ContinuousBatcher",
+    "speculative_generate",
 ]

@@ -1,0 +1,237 @@
+"""Speculative decoding: draft proposes, target verifies in one call.
+
+Serving extension over the decode stack (docs/design/generation.md):
+a small DRAFT model decodes ``k`` tokens autoregressively, then the
+TARGET model scores all of them in ONE multi-token continuation call —
+``1 + j`` committed tokens per target call instead of 1, where ``j`` is
+the accepted prefix length. Greedy acceptance (argmax-match) makes the
+output BIT-IDENTICAL to target-only greedy decoding — speculation is a
+latency optimization, never an approximation; the tests pin
+``speculative_generate == generate`` exactly.
+
+Cache mechanics (why this needs no new module support):
+
+- The verify call is an ordinary continuation chunk
+  (``d9d_tpu.nn.decode_flags.continuation_chunk``): ``t = 1 + k``
+  tokens against the warm slot cache, per-row ``start`` — machinery
+  chunked prefill and continuous batching already built.
+- REJECTION IS AN INDEX REWIND. Attention decode caches are slot-causal
+  (``_decode_slot_mask`` / the flash-decode kernel mask by the write
+  index), so keys written for rejected proposals become invisible the
+  moment ``cache_index`` rewinds — no buffer surgery. Rows rewind
+  independently (per-row ``[B]`` indices).
+- GatedDeltaNet layers are REJECTED by contract
+  (``NotImplementedError``): their recurrent state advances
+  irreversibly through every token, so rejected proposals would need
+  per-position state checkpoints the layer does not keep. Speculate
+  with attention-family models (dense GQA, Llama, MLA); hybrids decode
+  through ``generate``/``ContinuousBatcher``.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from d9d_tpu.core.types import Array
+from d9d_tpu.nn.decode_flags import continuation_chunk
+
+
+def _assert_rewindable(cache) -> None:
+    from flax.traverse_util import flatten_dict
+
+    for path in flatten_dict(cache):
+        if path[-1] in ("delta_state", "conv_tail"):
+            raise NotImplementedError(
+                "speculative decoding requires rewindable decode state; "
+                "GatedDeltaNet layers advance a recurrent state that "
+                "cannot roll back past rejected proposals "
+                f"(cache leaf {'/'.join(path)}). Use generate() or "
+                "ContinuousBatcher for hybrid models."
+            )
+
+
+def _set_indices(cache, new_index: Array):
+    """Rewind every cache_index leaf to per-row ``new_index [B]``."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(cache)
+    for path in list(flat):
+        if path[-1] == "cache_index":
+            flat[path] = new_index
+    return unflatten_dict(flat)
+
+
+def speculative_generate(
+    model,
+    params: Any,
+    draft_model,
+    draft_params: Any,
+    prompt_ids: Array,
+    *,
+    max_new_tokens: int,
+    speculate_k: int = 4,
+    eos_id: Optional[int] = None,
+) -> Array:
+    """``prompt_ids [B, P]`` → ``[B, max_new_tokens]``, bit-identical to
+    ``generate(model, params, prompt_ids, max_new_tokens=...)`` (greedy).
+
+    Both models need ``decode_max_length >= P + max_new_tokens - 1``
+    (the draft additionally writes up to ``speculate_k`` speculative
+    slots, which rewind — capacity must cover
+    ``P + max_new_tokens - 1 + speculate_k`` on both). Host-driven loop:
+    each iteration drafts ``speculate_k`` greedy tokens, verifies them
+    in one target call, commits the accepted prefix plus the target's
+    own token at the first mismatch.
+    """
+    b, p = prompt_ids.shape
+    k = int(speculate_k)
+    if k < 1:
+        raise ValueError(f"speculate_k must be >= 1, got {k}")
+    for name, m in (("model", model), ("draft_model", draft_model)):
+        dml = int(getattr(m, "decode_max_length", 0))
+        need = p + max_new_tokens - 1 + k
+        if dml < need:
+            raise ValueError(
+                f"{name}.decode_max_length={dml} < prompt {p} + "
+                f"max_new_tokens {max_new_tokens} - 1 + speculate_k {k} "
+                f"= {need} (speculative slots rewind but must fit)"
+            )
+
+    def prefill(m, prm):
+        z_pos = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+        logits, state = m.apply(
+            {"params": prm}, prompt_ids.astype(jnp.int32), z_pos,
+            method=m.logits_last, mutable=["cache"],
+        )
+        return logits[:, -1], state["cache"]
+
+    # contract check BEFORE any forward pass: eval_shape exposes the
+    # cache tree (leaf names included) without compiling or running
+    z1 = jnp.zeros((b, 1), jnp.int32)
+    for m, prm in ((model, params), (draft_model, draft_params)):
+        _assert_rewindable(
+            jax.eval_shape(m.init, jax.random.PRNGKey(0), z1, z1, z1)[
+                "cache"
+            ]
+        )
+
+    t_logits, t_cache = prefill(model, params)
+    d_logits, d_cache = prefill(draft_model, draft_params)
+    # per-row indices from here on (rows accept different prefix lengths)
+    n = np.full((b,), p, np.int32)  # committed length per row
+    t_cache = _set_indices(t_cache, jnp.asarray(n))
+    d_cache = _set_indices(d_cache, jnp.asarray(n))
+
+    @jax.jit
+    def draft_step(cache, tok, pos):
+        logits, state = draft_model.apply(
+            {"params": draft_params, "cache": cache},
+            tok[:, None], pos[:, None],
+            method=draft_model.logits_last, mutable=["cache"],
+        )
+        return (
+            state["cache"],
+            jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
+        )
+
+    def verify_fn(cache, toks, pos):
+        logits, state = model.apply(
+            {"params": params, "cache": cache},
+            toks, pos, method=model.logits, mutable=["cache"],
+        )
+        return (
+            state["cache"],
+            jnp.argmax(logits, axis=-1).astype(jnp.int32),  # [B, 1+k]
+        )
+
+    verify = jax.jit(verify_fn)
+    rewind = jax.jit(_set_indices)
+
+    # first committed token: target's own greedy continuation of the
+    # prompt (not yet fed to either cache)
+    pending = np.asarray(jnp.argmax(t_logits, axis=-1), np.int32)
+    out = np.zeros((b, max_new_tokens), np.int32)
+    out[:, 0] = pending
+    emitted = np.ones((b,), np.int32)
+    done = (
+        (pending == eos_id) if eos_id is not None
+        else np.zeros((b,), bool)
+    )
+
+    while int((emitted < max_new_tokens).sum()) and not bool(done.all()):
+        # done rows still flow through the static-shape step; park their
+        # writes at slot 0 (their cache is dead) so a finished row near
+        # capacity can never violate the overflow contract
+        n_eff = np.where(done, 0, n).astype(np.int32)
+        # --- draft k greedy tokens from (pending, positions n..) ------
+        proposals = np.zeros((b, k), np.int32)
+        tok = jnp.asarray(pending)
+        for i in range(k):
+            d_cache, tok = draft_step(
+                d_cache, tok, jnp.asarray(n_eff + i)
+            )
+            proposals[:, i] = np.asarray(tok)
+        # one extra draft step writes proposals[k-1]'s KEY (its output is
+        # discarded): on a fully-accepted round the committed text
+        # includes proposals[k-1], and without this write the draft
+        # cache would carry a permanently visible unwritten slot —
+        # silently degrading every later proposal's conditioning (and
+        # with it the acceptance rate)
+        d_cache, _ = draft_step(d_cache, tok, jnp.asarray(n_eff + k))
+        # --- one target call scores pending + all proposals -----------
+        toks = jnp.concatenate(
+            [jnp.asarray(pending)[:, None], jnp.asarray(proposals)],
+            axis=1,
+        )  # [B, 1+k]
+        pos = (
+            jnp.asarray(n_eff)[:, None]
+            + jnp.arange(1 + k, dtype=jnp.int32)[None]
+        )
+        with continuation_chunk():
+            t_cache, greedy = verify(t_cache, toks, pos)
+        greedy = np.asarray(greedy)  # greedy[:, i] = target tok after toks[:, :i+1]
+
+        # --- accept the matching prefix, commit the bonus token -------
+        new_tokens = np.zeros((b,), np.int32)
+        for r in range(b):
+            if done[r]:
+                new_tokens[r] = 0
+                continue
+            j = 0
+            while j < k and proposals[r, j] == greedy[r, j]:
+                j += 1
+            # committed this round: proposals[:j] plus target's token at
+            # the first mismatch (or after all k accepted) — all of them
+            # target-greedy by construction
+            committed = list(proposals[r, :j]) + [greedy[r, j]]
+            for c in committed:
+                if emitted[r] >= max_new_tokens or done[r]:
+                    break
+                out[r, emitted[r]] = c
+                emitted[r] += 1
+                if eos_id is not None and c == eos_id:
+                    done[r] = True
+            # pending token fed next round = last committed token;
+            # its KEY is not yet in either cache (position n + j + ...)
+            n[r] += 1 + j  # pending + accepted proposals are now cached
+            new_tokens[r] = committed[-1] if committed else 0
+        pending = new_tokens
+        # rewind both caches' write indices to the committed length —
+        # rejected proposals' keys become invisible (slot-causal masks);
+        # done rows park at 0
+        n_eff = np.where(done, 0, n).astype(np.int32)
+        t_cache = rewind(t_cache, jnp.asarray(n_eff))
+        d_cache = rewind(d_cache, jnp.asarray(n_eff))
+        if eos_id is not None:
+            done |= emitted >= max_new_tokens
+        else:
+            done = emitted >= max_new_tokens
+
+    if eos_id is not None:
+        # frozen rows keep emitting eos (generate()'s static-shape rule)
+        for r in range(b):
+            if emitted[r] < max_new_tokens and done[r]:
+                out[r, emitted[r]:] = eos_id
+    return jnp.asarray(out)
