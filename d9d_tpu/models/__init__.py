@@ -1,6 +1,7 @@
-"""Model families: Qwen3 (dense / MoE / Next-hybrid — reference parity)
-and Llama-3 (beyond-reference, BASELINE config 4)."""
+"""Model families: Qwen3 (dense / MoE / Next-hybrid — reference parity),
+Llama-3 (beyond-reference, BASELINE config 4), and DeepSeek-V2
+(beyond-reference: MLA latent attention + shared-expert MoE)."""
 
-from d9d_tpu.models import llama, qwen3
+from d9d_tpu.models import deepseek, llama, qwen3
 
-__all__ = ["llama", "qwen3"]
+__all__ = ["deepseek", "llama", "qwen3"]

@@ -1,0 +1,165 @@
+"""DeepSeek-V2 model family: MLA attention + shared-expert MoE.
+
+Beyond-reference family (the reference ships only Qwen3 models,
+d9d/module/model/): DeepSeek-V2's decoder is the Qwen3-MoE stack with
+MultiHeadLatentAttention in place of GQA (``Qwen3MoeConfig.mla``),
+dense first-k layers (``mlp_only_layers`` = HF
+``first_k_dense_replace``), ungated shared experts
+(``SharedExpertParameters(enable_gate=False)``, width =
+``n_shared_experts * moe_intermediate_size``), and the
+``routed_scaling_factor`` on the routed experts' output — so
+sharding plans, pipelining stages, PEFT, generation (latent-cache
+decode incl. the absorbed rank-space form) and serving
+(ContinuousBatcher / speculative_generate) all apply unchanged.
+
+Checkpoint-fidelity status vs transformers ``DeepseekV2ForCausalLM``:
+router semantics match the HF configs (``norm_topk_prob=False`` raw
+softmax weights; the 236B preset's ``group_limited_greedy`` routing via
+``router_n_group/topk_group``), the yarn long-context scaling and its
+mscale attention temperature are configured per the published configs
+(``_yarn_mscale``), and the parameter LAYOUT maps 1:1 onto the MLA/MoE
+blocks — but no HF weight mapper or logits-parity test exists yet, so
+treat checkpoint loading as future work (the Qwen3/Llama/Next families
+are the logits-parity-tested interop surface).
+"""
+
+from d9d_tpu.models.qwen3.moe import (
+    MLAParameters,
+    Qwen3MoeBackbone as DeepseekBackbone,
+    Qwen3MoeCausalLM as DeepseekCausalLM,
+    Qwen3MoeConfig,
+)
+from d9d_tpu.nn.moe import SharedExpertParameters
+from d9d_tpu.ops import RopeScalingYarn
+
+DeepseekConfig = Qwen3MoeConfig  # same static surface; mla set
+
+
+def _yarn_mscale(factor: float, mscale: float) -> float:
+    """DeepSeek yarn_get_mscale: the attention-temperature term the
+    checkpoints fold into the softmax scale (mscale == mscale_all_dim
+    in both published configs, so cos/sin stay unscaled and the scale
+    adjustment is mscale(factor)**2 on d_qk**-0.5)."""
+    import math
+
+    return 0.1 * mscale * math.log(factor) + 1.0 if factor > 1 else 1.0
+
+
+def _deepseek_yarn() -> RopeScalingYarn:
+    """The yarn scaling both published DeepSeek-V2 configs ship
+    (factor 40 over a 4096 original context; attention_factor 1.0
+    because the temperature rides the softmax scale instead)."""
+    return RopeScalingYarn(
+        factor=40.0,
+        original_max_position=4096,
+        beta_fast=32.0,
+        beta_slow=1.0,
+        attention_factor=1.0,
+    )
+
+
+def deepseek_v2_tiny(vocab_size: int = 256) -> Qwen3MoeConfig:
+    """CPU-runnable DeepSeek-V2-shaped config (tests / smoke): MLA on
+    every layer, first layer dense, 1 ungated shared expert."""
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", vocab_size),),
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,  # unused by MLA; kept for config invariants
+        head_dim=16,
+        moe_intermediate_size=32,
+        num_experts=8,
+        num_experts_per_tok=2,
+        intermediate_size=128,
+        mlp_only_layers=(0,),
+        shared_expert=SharedExpertParameters(
+            intermediate_size=32, enable_gate=False
+        ),
+        mla=MLAParameters(
+            kv_lora_rank=32,
+            qk_nope_head_dim=16,
+            qk_rope_head_dim=8,
+            v_head_dim=16,
+            q_lora_rank=None,
+        ),
+        routed_scaling_factor=1.0,
+        norm_topk_prob=False,
+        qk_norm=False,
+        rope_theta=10_000.0,
+        remat=False,
+    )
+
+
+def deepseek_v2_lite(vocab_size: int = 102_400) -> Qwen3MoeConfig:
+    """DeepSeek-V2-Lite geometry (15.7B total / 2.4B active): 27 layers,
+    MLA with rank-512 latents and no q compression, 64 routed + 2
+    shared experts, first layer dense."""
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", vocab_size),),
+        hidden_size=2048,
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=128,
+        moe_intermediate_size=1408,
+        num_experts=64,
+        num_experts_per_tok=6,
+        intermediate_size=10_944,
+        mlp_only_layers=(0,),
+        shared_expert=SharedExpertParameters(
+            intermediate_size=2 * 1408, enable_gate=False
+        ),
+        mla=MLAParameters(
+            kv_lora_rank=512,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+            q_lora_rank=None,
+            softmax_scale=(128 + 64) ** -0.5
+            * _yarn_mscale(40.0, 0.707) ** 2,
+        ),
+        routed_scaling_factor=1.0,
+        norm_topk_prob=False,
+        qk_norm=False,
+        rope_theta=10_000.0,
+        rope_scaling=_deepseek_yarn(),
+    )
+
+
+def deepseek_v2(vocab_size: int = 102_400) -> Qwen3MoeConfig:
+    """DeepSeek-V2 geometry (236B total / 21B active): 60 layers, MLA
+    with q compression (rank 1536), 160 routed + 2 shared experts,
+    routed output scaled 16x."""
+    return Qwen3MoeConfig(
+        vocab_ranges=(("default", vocab_size),),
+        hidden_size=5120,
+        num_layers=60,
+        num_heads=128,
+        num_kv_heads=128,
+        head_dim=128,
+        moe_intermediate_size=1536,
+        num_experts=160,
+        num_experts_per_tok=6,
+        intermediate_size=12_288,
+        mlp_only_layers=(0,),
+        shared_expert=SharedExpertParameters(
+            intermediate_size=2 * 1536, enable_gate=False
+        ),
+        mla=MLAParameters(
+            kv_lora_rank=512,
+            qk_nope_head_dim=128,
+            qk_rope_head_dim=64,
+            v_head_dim=128,
+            q_lora_rank=1536,
+            softmax_scale=(128 + 64) ** -0.5
+            * _yarn_mscale(40.0, 0.707) ** 2,
+        ),
+        routed_scaling_factor=16.0,
+        norm_topk_prob=False,
+        router_n_group=8,
+        router_topk_group=3,
+        qk_norm=False,
+        rope_theta=10_000.0,
+        rope_scaling=_deepseek_yarn(),
+    )

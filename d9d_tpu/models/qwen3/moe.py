@@ -39,6 +39,23 @@ from d9d_tpu.pipelining import (
 
 
 @dataclasses.dataclass(frozen=True)
+class MLAParameters:
+    """Multi-head-latent attention geometry (DeepSeek-V2 family;
+    nn/attention.py MultiHeadLatentAttention). When set on a config,
+    every attention layer runs MLA instead of GQA and rope frequencies
+    are computed over ``qk_rope_head_dim``."""
+
+    kv_lora_rank: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    q_lora_rank: Optional[int] = None
+    # override the default d_qk**-0.5 (DeepSeek yarn mscale: the
+    # checkpoint's softmax scale carries a yarn temperature factor)
+    softmax_scale: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Qwen3MoeConfig:
     vocab_ranges: tuple[tuple[str, int], ...]
     hidden_size: int
@@ -90,6 +107,15 @@ class Qwen3MoeConfig:
     # like 2.0 gives N·k/ep per-shard compute with deterministic drops;
     # None = dropless worst-case buffer
     ep_capacity_factor: Optional[float] = None
+    # MLA attention on every (non-GDN) layer when set — the DeepSeek-V2
+    # family rides this backbone (models/deepseek/)
+    mla: Optional[MLAParameters] = None
+    # DeepSeek routed_scaling_factor (routed experts' output only)
+    routed_scaling_factor: float = 1.0
+    # group-limited routing (DeepSeek group_limited_greedy; see
+    # TopKRouter.n_group / topk_group); 1 = plain top-k
+    router_n_group: int = 1
+    router_topk_group: int = 1
 
     @property
     def vocab_size(self) -> int:
@@ -224,6 +250,25 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 param_dtype=self.param_dtype,
                 name="linear_attn",
             )(normed, padding_mask)
+        elif cfg.mla is not None:
+            from d9d_tpu.nn.attention import MultiHeadLatentAttention
+
+            attn_out = MultiHeadLatentAttention(
+                hidden_size=cfg.hidden_size,
+                num_heads=cfg.num_heads,
+                qk_nope_head_dim=cfg.mla.qk_nope_head_dim,
+                qk_rope_head_dim=cfg.mla.qk_rope_head_dim,
+                v_head_dim=cfg.mla.v_head_dim,
+                kv_lora_rank=cfg.mla.kv_lora_rank,
+                q_lora_rank=cfg.mla.q_lora_rank,
+                softmax_scale=cfg.mla.softmax_scale,
+                sdpa=self.sdpa,
+                norm_eps=cfg.norm_eps,
+                decode_max_length=self.decode_max_length,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="self_attn",
+            )(normed, cos, sin, mask)
         else:
             attn_out = GroupedQueryAttention(
                 hidden_size=cfg.hidden_size,
@@ -265,6 +310,9 @@ class Qwen3MoeDecoderLayer(nn.Module):
                 ep_axes=cfg.ep_axes,
                 token_axes=cfg.moe_token_axes,
                 ep_capacity_factor=cfg.ep_capacity_factor,
+                routed_scaling=cfg.routed_scaling_factor,
+                router_n_group=cfg.router_n_group,
+                router_topk_group=cfg.router_topk_group,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="mlp",
@@ -310,8 +358,12 @@ class Qwen3MoeBackbone(nn.Module):
         x = self._pin(x)
 
         # partial rotary (rope_fraction < 1): frequencies are computed over
-        # the rotary dim, not head_dim (NeoX/Qwen3-Next semantics)
-        rotary_dim = int(cfg.head_dim * cfg.rope_fraction)
+        # the rotary dim, not head_dim (NeoX/Qwen3-Next semantics). MLA
+        # (DeepSeek) rotates only its decoupled rope sub-vector.
+        rotary_dim = (
+            cfg.mla.qk_rope_head_dim if cfg.mla is not None
+            else int(cfg.head_dim * cfg.rope_fraction)
+        )
         inv_freq, att_scale = compute_rope_frequencies(
             rotary_dim, cfg.rope_theta, cfg.rope_scaling
         )

@@ -533,6 +533,9 @@ class MultiHeadLatentAttention(nn.Module):
     q_lora_rank: int | None = None
     norm_eps: float = 1e-6
     rope_style: RopeStyle = RopeStyle.HALF
+    # None -> d_qk**-0.5; DeepSeek yarn checkpoints fold an mscale
+    # temperature into the attention scale (models/deepseek presets)
+    softmax_scale: float | None = None
     # Latent-cache decode mode when > 0 (MLA's inference advantage: the
     # cache holds kv_lora_rank + qk_rope_head_dim floats per token — the
     # compressed latent plus the shared rotated rope key — instead of
@@ -562,6 +565,10 @@ class MultiHeadLatentAttention(nn.Module):
         d_nope, d_rope = self.qk_nope_head_dim, self.qk_rope_head_dim
         d_qk = d_nope + d_rope
         d_v = self.v_head_dim
+        scale = (
+            self.softmax_scale if self.softmax_scale is not None
+            else d_qk**-0.5
+        )
         if d_v > d_qk:
             raise ValueError(
                 f"v_head_dim ({d_v}) must not exceed qk head dim ({d_qk})"
@@ -677,12 +684,12 @@ class MultiHeadLatentAttention(nn.Module):
 
         if decode:  # t > 1 prefill over just the new tokens
             out = self.sdpa(
-                q, k, v, causal=True, softmax_scale=d_qk**-0.5,
+                q, k, v, causal=True, softmax_scale=scale,
                 **prefill_segs,
             )
         else:
             out = self.sdpa(
-                q, k, v, causal=True, softmax_scale=d_qk**-0.5, mask=mask
+                q, k, v, causal=True, softmax_scale=scale, mask=mask
             )
         out = checkpoint_name(out, "sdpa_out")
         if pad > 0:
@@ -703,8 +710,12 @@ class MultiHeadLatentAttention(nn.Module):
         k, v = _decompress_kv(
             c, k_rope, w, self.num_heads, d_nope, self.dtype
         )
+        scale = (
+            self.softmax_scale if self.softmax_scale is not None
+            else d_qk**-0.5
+        )
         return eager_sdpa(
-            q, k, v, causal=False, softmax_scale=d_qk**-0.5, mask=dec_mask
+            q, k, v, causal=False, softmax_scale=scale, mask=dec_mask
         )
 
     def _absorbed_attend(self, q_nope, q_rope, c, k_rope, w, dec_mask,
@@ -726,10 +737,14 @@ class MultiHeadLatentAttention(nn.Module):
         cf = c.astype(jnp.float32)
         rf = k_rope.astype(jnp.float32)
         q_abs = jnp.einsum("bthd,rhd->bthr", qn, wk)
+        scale = (
+            self.softmax_scale if self.softmax_scale is not None
+            else d_qk**-0.5
+        )
         scores = (
             jnp.einsum("bthr,bsr->bhts", q_abs, cf)
             + jnp.einsum("bthd,bsd->bhts", qr, rf)
-        ) * (d_qk**-0.5)
+        ) * scale
         neg_big = jnp.asarray(-1e30, scores.dtype)
         scores = jnp.where(dec_mask, scores, neg_big)
         # finite mask sentinel (not -inf): a fully-masked row must produce

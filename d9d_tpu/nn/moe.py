@@ -65,6 +65,12 @@ class TopKRouter(nn.Module):
     top_k: int
     renormalize_probabilities: bool = True
     enable_expert_bias: bool = False
+    # group-limited routing (DeepSeek ``group_limited_greedy``): experts
+    # partition into ``n_group`` groups, each scored by its best expert;
+    # only experts in the top ``topk_group`` groups are eligible for the
+    # global top-k. n_group == 1 is plain top-k.
+    n_group: int = 1
+    topk_group: int = 1
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -83,16 +89,35 @@ class TopKRouter(nn.Module):
         )(hidden)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
 
+        # selection scores may differ from the returned probs (bias joins
+        # selection only; group-limited routing masks ineligible groups)
+        sel = probs
         if self.enable_expert_bias:
             bias = self.variable(
                 "moe_buffers",
                 "expert_bias",
                 lambda: jnp.zeros((self.num_experts,), jnp.float32),
             ).value
-            _, selected_idx = lax.top_k(probs + bias, self.top_k)
-            selected_probs = jnp.take_along_axis(probs, selected_idx, axis=-1)
-        else:
-            selected_probs, selected_idx = lax.top_k(probs, self.top_k)
+            sel = sel + bias
+        if self.n_group > 1:
+            if self.num_experts % self.n_group != 0:
+                raise ValueError(
+                    f"num_experts {self.num_experts} not divisible by "
+                    f"n_group {self.n_group}"
+                )
+            per = self.num_experts // self.n_group
+            group_score = sel.reshape(
+                *sel.shape[:-1], self.n_group, per
+            ).max(axis=-1)
+            _, top_g = lax.top_k(group_score, self.topk_group)
+            gmask = (
+                jax.nn.one_hot(top_g, self.n_group, dtype=jnp.bool_)
+                .any(axis=-2)
+            )
+            emask = jnp.repeat(gmask, per, axis=-1)
+            sel = jnp.where(emask, sel, -jnp.inf)
+        _, selected_idx = lax.top_k(sel, self.top_k)
+        selected_probs = jnp.take_along_axis(probs, selected_idx, axis=-1)
 
         if self.renormalize_probabilities:
             selected_probs = selected_probs / (
@@ -260,6 +285,9 @@ class MoELayer(nn.Module):
     top_k: int
     router_renormalize_probabilities: bool = True
     router_enable_expert_bias: bool = False
+    # group-limited routing (see TopKRouter.n_group / topk_group)
+    router_n_group: int = 1
+    router_topk_group: int = 1
     shared_expert: Optional[SharedExpertParameters] = None
     ep_axes: Optional[tuple[str, ...]] = None
     # (batch_axes, seq_axes) of the residual activation layout — see class
@@ -273,6 +301,9 @@ class MoELayer(nn.Module):
     # but memory AND compute back at all-gather scale — use it for parity
     # testing or tiny EP degrees, set a factor for production
     ep_capacity_factor: Optional[float] = None
+    # DeepSeek routed_scaling_factor: multiplies the routed experts'
+    # combined output (not the shared expert)
+    routed_scaling: float = 1.0
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -283,6 +314,8 @@ class MoELayer(nn.Module):
             top_k=self.top_k,
             renormalize_probabilities=self.router_renormalize_probabilities,
             enable_expert_bias=self.router_enable_expert_bias,
+            n_group=self.router_n_group,
+            topk_group=self.router_topk_group,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -338,6 +371,10 @@ class MoELayer(nn.Module):
         else:
             out = self._forward_ep(hidden, topk_ids, topk_probs)
 
+        if self.routed_scaling != 1.0:
+            # DeepSeek-style scale on the ROUTED output only (HF
+            # DeepseekV2MoE: routed * factor + shared)
+            out = out * jnp.asarray(self.routed_scaling, out.dtype)
         if shared is not None:
             out = out + shared
         return out
