@@ -1,0 +1,108 @@
+"""Version-compat shims for the ambient-mesh JAX API.
+
+The framework targets the current JAX surface — ``jax.set_mesh``,
+``jax.sharding.get_abstract_mesh``, ``jax.sharding.AxisType`` — which
+older runtimes (0.4.x, e.g. a CPU rig whose JAX is pinned by an
+accelerator plugin) predate. On a current JAX every helper here
+degenerates to the native call; on an old one they fall back to a
+process-local current-mesh slot with the same resolution semantics
+("most recently set mesh wins; a scoped set restores the previous one
+on exit"), so the mesh-dependent stack stays importable and testable
+everywhere.
+
+Only the AMBIENT-MESH bookkeeping is emulated — collectives, shard_map
+and NamedSharding go through the public API on both sides.
+"""
+
+import jax
+
+__all__ = [
+    "HAS_MODERN_JAX",
+    "get_abstract_mesh",
+    "mesh_axis_types_kwargs",
+    "set_mesh",
+    "shard_map",
+]
+
+# True on a runtime with the native ambient-mesh API. The SPMD
+# training/e2e test tier keys off this: the fallbacks below keep
+# single-process serving/decode/bench paths working on old runtimes,
+# but full mesh-training e2e there is uncertified (tests skip it).
+HAS_MODERN_JAX = hasattr(jax, "set_mesh")
+
+# fallback ambient mesh (single slot, matching jax.set_mesh semantics:
+# a statement-form set replaces the current mesh; a scoped set restores
+# the previous one on exit). Single-controller: the executor and all
+# mesh builds run on the main thread; prefetch threads never set meshes.
+_AMBIENT: list = [None]
+
+
+def mesh_axis_types_kwargs(n_axes: int) -> dict:
+    """``axis_types=(Auto,) * n`` where the runtime understands it."""
+    if hasattr(jax.sharding, "AxisType"):
+        return {
+            "axis_types": (jax.sharding.AxisType.Auto,) * n_axes
+        }
+    return {}
+
+
+class _FallbackSetMesh:
+    """Matches ``jax.set_mesh``'s dual use: called as a statement the
+    mesh stays ambient process-wide; used as a context manager the
+    previously ambient mesh is restored at block exit."""
+
+    def __init__(self, mesh):
+        self._prev = _AMBIENT[0]
+        _AMBIENT[0] = mesh
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _AMBIENT[0] = self._prev
+        return False
+
+
+def set_mesh(mesh):
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return _FallbackSetMesh(mesh)
+
+
+def get_abstract_mesh():
+    """The ambient mesh, or None. The fallback returns the concrete
+    ``Mesh`` most recently set — its ``.shape`` mapping is what every
+    caller consumes, so the two paths are interchangeable."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    return _AMBIENT[0]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None):
+    """``jax.shard_map`` with the keyword surface this repo uses.
+
+    The fallback maps onto ``jax.experimental.shard_map.shard_map``:
+    ``check_vma`` → ``check_rep`` (the older name for the same
+    replication-inference toggle) and ``axis_names`` (the subset of mesh
+    axes that go manual) → ``auto`` (its complement).
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from d9d_tpu.core import compat
+
 # Canonical axis names, slowest-varying first.
 AXIS_PP = "pp"
 AXIS_DP_REPLICATE = "dp_r"
@@ -87,9 +89,7 @@ def resolve_ambient_mesh(required_axes=(), *, fallback=None, what="this op"):
     missing. One helper so the resolution rule can't diverge between the
     ring SDPA, the MoE EP path, and the SDPA factory.
     """
-    import jax.sharding as jsh
-
-    mesh = jsh.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or not mesh.shape:
         mesh = fallback
     if mesh is None or not mesh.shape:
@@ -210,10 +210,11 @@ class MeshParameters:
                 )
             # axis_types must be Auto: jax 0.9's make_mesh defaults to
             # Explicit (sharding-in-types), which rejects plain jit use.
+            # (core.compat: older runtimes take no axis_types at all.)
             mesh = jax.make_mesh(
                 self.axis_sizes,
                 MESH_AXIS_NAMES,
-                axis_types=(jax.sharding.AxisType.Auto,) * len(MESH_AXIS_NAMES),
+                **compat.mesh_axis_types_kwargs(len(MESH_AXIS_NAMES)),
             )
         else:
             if len(devices) != self.world_size:
@@ -226,7 +227,7 @@ class MeshParameters:
             mesh = Mesh(
                 dev_array,
                 MESH_AXIS_NAMES,
-                axis_types=(jax.sharding.AxisType.Auto,) * len(MESH_AXIS_NAMES),
+                **compat.mesh_axis_types_kwargs(len(MESH_AXIS_NAMES)),
             )
         # Make the mesh ambient: shard_map/get_abstract_mesh inside modules
         # (e.g. the MoE EP path) resolve it without explicit plumbing.
@@ -234,7 +235,7 @@ class MeshParameters:
         # bound to an earlier mesh must not be applied after a second
         # build() with different axis sizes (the EP path validates axis
         # sizes and fails loudly on mismatch).
-        jax.set_mesh(mesh)
+        compat.set_mesh(mesh)
         return MeshContext(params=self, mesh=mesh)
 
 

@@ -220,24 +220,60 @@ class Inference:
             return jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0), *host
             )
-        outs = self._forward(self.params, batch, rng)
+        return self._fetch_outputs(self._forward(self.params, batch, rng))
+
+    @staticmethod
+    def _fetch_outputs(outs: PyTree) -> PyTree:
+        """Jitted-path device outputs ``[n_mb, mb, ...]`` → host arrays
+        with leading dim = batch size (this blocks on the dispatch)."""
         return jax.tree.map(
             lambda x: np.asarray(x).reshape(-1, *x.shape[2:]), outs
         )
 
+    def _finish_batch(self, outs: PyTree) -> Any:
+        """Fetch a dispatched forward's outputs and run the host-side
+        task processing on them."""
+        return self.task.process_outputs(self._fetch_outputs(outs))
+
     def infer(self) -> list[Any]:
-        """Run the whole dataset; returns task.process_outputs results."""
+        """Run the whole dataset; returns task.process_outputs results.
+
+        On the jitted (non-PP) path the loop is pipelined one batch
+        deep: batch ``i`` is DISPATCHED (async — XLA returns futures)
+        before batch ``i-1``'s outputs are fetched to the host, so the
+        device computes batch ``i`` while the host pays the readback and
+        ``process_outputs`` cost of batch ``i-1``. Results come back in
+        dataset order; each batch's bounded event covers its staging and
+        dispatch plus the previous batch's host-side processing, and the
+        final in-flight batch drains under one more bounded event
+        (``index = number of batches``) so event handlers that bound
+        hangs always have a batch event open while device work or
+        readback is outstanding. The PP engine path stays synchronous
+        (the executor is host-driven).
+        """
         results: list[Any] = []
         t0 = time.perf_counter()
+        inflight: PyTree | None = None  # dispatched, not yet fetched
         for i, raw in enumerate(iter(self.dataset_provider.build())):
             with self.events.bounded(ev.EVENT_INFER_BATCH, inference=self, index=i):
                 batch = self._stage_batch(raw)
                 rng = jax.random.fold_in(self.step_rng, i)
-                host = self._forward_batch(batch, rng)
-                results.append(self.task.process_outputs(host))
+                if self.pp_engine is not None:
+                    host = self._forward_batch(batch, rng)
+                    results.append(self.task.process_outputs(host))
+                else:
+                    outs = self._forward(self.params, batch, rng)
+                    if inflight is not None:
+                        results.append(self._finish_batch(inflight))
+                    inflight = outs
             if (i + 1) % self.config.log_every == 0:
                 logger.info(
                     "inference batch %d (%.2fs)", i + 1, time.perf_counter() - t0
                 )
+        if inflight is not None:
+            with self.events.bounded(
+                ev.EVENT_INFER_BATCH, inference=self, index=len(results) + 1
+            ):
+                results.append(self._finish_batch(inflight))
         self.events.emit(ev.EVENT_INFER_FINISHED, inference=self)
         return results

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from d9d_tpu.core import compat
 from d9d_tpu.core.mesh import MeshContext
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.loop.components.batch_maths import BatchMaths
@@ -143,7 +144,7 @@ def build_pipeline_stages(
         if stage_params is not None and s in stage_params:
             params = stage_params[s]
         else:
-            with jax.set_mesh(submesh):
+            with compat.set_mesh(submesh):
                 params, _ = init_sharded_from_fn(raw_init, submesh, plan)
 
         data_spec = P(ctx.batch_axes, ctx.sequence_axes)
@@ -237,7 +238,7 @@ class PipelineTrainEngine:
                 rng_s = _put_key_replicated(
                     jax.random.fold_in(init_rng, 10_000 + s), submesh
                 )
-                with jax.set_mesh(submesh):
+                with compat.set_mesh(submesh):
                     base, adapters = peft_method.inject(rt.params, rng_s)
                 rt.params = adapters
                 rt.task = PeftStageTask(task, peft_method, base)
@@ -306,7 +307,7 @@ class PipelineTrainEngine:
                 train=False,
             )
         result = self._eval_executor.step(microbatches)
-        with jax.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
+        with compat.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
             return result.loss_sum / jnp.maximum(result.weight_sum, 1e-8)
 
     def step(self, microbatches: list[PyTree]) -> dict:
@@ -318,7 +319,7 @@ class PipelineTrainEngine:
         )
         for s, rt in self.stages.items():
             rt.params = new_params[s]
-        with jax.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
+        with compat.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
             inv_w = 1.0 / jnp.maximum(result.weight_sum, 1e-8)
             loss = result.loss_sum * inv_w
         return {
@@ -351,7 +352,7 @@ class PipelineTrainEngine:
             return _deep_merge([rt.params for rt in self.stages.values()])
         merged = []
         for rt in self.stages.values():
-            with jax.set_mesh(rt.mesh):
+            with compat.set_mesh(rt.mesh):
                 merged.append(self.peft_method.merge(rt.task.base, rt.params))
         return _deep_merge(merged)
 

@@ -2,20 +2,39 @@
 
 Beyond-reference surface (the reference's ``Inference`` is forward-only
 batch scoring; its serving story ends there). ``ContinuousBatcher``
-keeps a fixed batch of ``batch_size`` slots decoding through ONE jitted
-single-token step; requests are admitted into free slots as they
-arrive and evicted on EOS/budget — rows never wait for each other
-(the vLLM-style iteration-level scheduling loop, in its static-shape
-TPU form).
+keeps a fixed batch of ``batch_size`` slots decoding through a jitted
+decode loop; requests are admitted into free slots as they arrive and
+evicted on EOS/budget — rows never wait for each other (the vLLM-style
+iteration-level scheduling loop, in its static-shape TPU form).
+
+Host-interaction contract (the perf-defining design decision): the
+inner decode loop is FUSED — ``chunk_size`` (K) single-token steps run
+as one jitted ``lax.scan`` that advances all slots, applies per-row
+stop/length masks in-device, and accumulates emitted tokens into a
+device-side ``[B, K]`` buffer. The host performs ONE dispatch and ONE
+token readback per K generated tokens instead of per token; admission,
+eviction and finished-row harvesting happen only at chunk boundaries.
+Rows that finish mid-chunk (budget or EOS) are masked dead in-device —
+their emissions stop and their ``cache_index`` pins to 0 the same step,
+so the capacity contract holds without per-token host intervention —
+and are harvested at the boundary. ``drain()`` additionally
+double-buffers: while no admissions are waiting, chunk N+1 is
+dispatched before chunk N's tokens are fetched (its plan is
+deterministic — prompt feeding and positions advance device-side), so
+the readback overlaps device compute via XLA async dispatch.
+
+``chunk_size=None`` selects the legacy per-token stepping path (one
+dispatch + one readback per token) — kept as the oracle for the fused
+path's exactness tests and for latency-critical single-token serving.
 
 Static shapes are the law under XLA, so admission is TOKEN-LEVEL: the
-step always processes exactly one token per slot. A newly admitted
-request spends its first ``len(prompt)`` steps consuming its prompt
-(teacher-forced through the same decode step — cache contents and the
-final-position logits are bit-identical to a one-shot prefill), then
-flips to generation. The price is prompt consumption at one token per
-step; long prompts can instead be pre-filled out-of-band with
-``generate``'s chunked prefill and handed over — the primitives
+loop always processes exactly one token per slot per device step. A
+newly admitted request spends its first ``len(prompt)`` steps consuming
+its prompt (teacher-forced through the same decode step — cache
+contents and the final-position logits are bit-identical to a one-shot
+prefill), then flips to generation. The price is prompt consumption at
+one token per step; long prompts can instead be pre-filled out-of-band
+with ``generate``'s chunked prefill and handed over — the primitives
 compose, this loop stays shape-static.
 
 Per-row cache state rides the decode modules unchanged: the serving
@@ -24,12 +43,18 @@ loop seeds the flax cache with a PER-ROW ``[B]`` ``cache_index``
 kernel takes per-row ``start`` offsets natively
 (``ops/attention/pallas_decode.py``), and row admission resets just
 that row's cache slice (every cache leaf leads with the batch dim).
+Idle and dead rows have their ``cache_index`` pinned to 0 inside the
+jitted step, so a slot left idle for arbitrarily many steps can never
+overflow the capacity contract or defeat the flash-decode block skip.
 GDN layers need nothing: their recurrent state is per-row already.
 
 Parity contract: greedy serving of any admission schedule must emit,
 per request, exactly the tokens ``generate(model, params, prompt)``
 produces — ``tests/loop/test_serve.py`` drives staggered schedules
-against that oracle.
+against that oracle, for both the fused and the per-token path.
+(With ``temperature > 0`` the two paths consume the RNG stream in
+different orders — per chunk vs per token — so sampled outputs are
+both valid draws but not bitwise-identical across modes.)
 """
 
 import collections
@@ -47,9 +72,12 @@ from d9d_tpu.core.types import Array
 @dataclasses.dataclass
 class _Slot:
     rid: int = -1            # active request id, -1 = idle
-    pending: list = dataclasses.field(default_factory=list)  # prompt left
-    pos: int = 0             # next rope position for this row
-    emitted: int = 0
+    # legacy (per-token) mode: prompt tokens after the one in _tokens
+    pending: list = dataclasses.field(default_factory=list)
+    pos: int = 0             # legacy mode: next rope position for this row
+    # fused mode: prompt tokens not yet dispatched as step inputs
+    feed: list = dataclasses.field(default_factory=list)
+    emitted: int = 0         # committed (harvested) emissions
     budget: int = 0          # max_new_tokens for the active request
 
 
@@ -58,6 +86,53 @@ class _Request:
     rid: int
     prompt: list
     max_new_tokens: int
+
+
+@dataclasses.dataclass
+class _ChunkPlan:
+    """Host-side record of one dispatched fused chunk, consumed FIFO at
+    harvest time: enough to replay the device's emission/stop logic on
+    the readback without fetching any mask buffers."""
+
+    k: int
+    rids: list            # rid per slot at dispatch (-1 = idle)
+    emit_from: list       # first step index (within the chunk) that emits
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Host-interaction and utilization counters (reset with ``reset()``).
+
+    ``host_dispatches`` counts jitted-call dispatches (the quantity the
+    fused loop divides by K); ``readbacks`` counts device→host token
+    fetches; ``device_steps`` counts single-token decode steps executed
+    on device; ``slot_steps_busy / slot_steps_total`` give slot
+    occupancy (busy includes prompt-consumption steps).
+    """
+
+    host_dispatches: int = 0
+    readbacks: int = 0
+    chunks: int = 0
+    device_steps: int = 0
+    emitted_tokens: int = 0
+    slot_steps_busy: int = 0
+    slot_steps_total: int = 0
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, 0)
+
+    @property
+    def dispatches_per_1k_tokens(self) -> float:
+        if self.emitted_tokens == 0:
+            return float("inf")
+        return 1000.0 * self.host_dispatches / self.emitted_tokens
+
+    @property
+    def slot_utilization(self) -> float:
+        if self.slot_steps_total == 0:
+            return 0.0
+        return self.slot_steps_busy / self.slot_steps_total
 
 
 def _zero_row(cache, row_mask: Array):
@@ -71,16 +146,34 @@ def _zero_row(cache, row_mask: Array):
     return jax.tree.map(z, cache)
 
 
+def _pin_cache_index(cache, live: Array):
+    """Pin dead/idle rows' per-row write indices to 0: the jitted step
+    advances every row's ``cache_index``, so without the pin a long-idle
+    slot would climb past capacity (spurious checkify overflow under
+    contract validation) and defeat the flash-decode whole-block skip
+    (a huge start makes every block visible)."""
+    from d9d_tpu.nn.decode_flags import map_cache_index
+
+    return map_cache_index(cache, lambda idx: jnp.where(live, idx, 0))
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a KV-cache decode model.
 
     ``model`` must be built with ``decode_max_length`` ≥ the longest
     ``len(prompt) + max_new_tokens - 1`` it will serve. ``submit()``
     queues a request (admitted into the first free slot at the next
-    ``step()``); each ``step()`` advances every active slot by one
+    step/chunk boundary); ``step()`` advances every active slot by one
     token and returns ``{rid: token}`` for tokens EMITTED this step
-    (generation phase only). ``outputs[rid]`` accumulates; ``drain()``
-    runs steps until every submitted request finishes.
+    (generation phase only); ``step_chunk()`` advances by ``chunk_size``
+    tokens in one dispatch and returns ``{rid: [tokens]}``.
+    ``outputs[rid]`` accumulates; ``drain()`` runs (double-buffered)
+    chunks until every submitted request finishes.
+
+    ``chunk_size``: decode steps fused per dispatch (default 8).
+    ``None`` selects the legacy per-token stepping path. ``overlap``
+    (fused mode) lets ``drain()`` keep one chunk in flight while the
+    previous chunk's tokens are fetched.
     """
 
     def __init__(
@@ -92,15 +185,21 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         temperature: float = 0.0,
         rng: Optional[jax.Array] = None,
+        chunk_size: Optional[int] = 8,
+        overlap: bool = True,
     ):
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
+        if chunk_size is not None and chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         self._model = model
         self._params = params
         self._b = batch_size
         self._eos = eos_id
         self._temp = temperature
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._k = chunk_size
+        self._overlap = overlap and chunk_size is not None
         self._dml = int(getattr(model, "decode_max_length", 0))
         if self._dml <= 0:
             raise ValueError("model must be built with decode_max_length > 0")
@@ -108,42 +207,35 @@ class ContinuousBatcher:
         self._slots = [_Slot() for _ in range(batch_size)]
         self._queue: collections.deque[_Request] = collections.deque()
         self._next_rid = 0
-        self._tokens = np.zeros((batch_size,), np.int32)  # next inputs
+        self._tokens = np.zeros((batch_size,), np.int32)  # legacy inputs
         self.outputs: dict[int, list[int]] = {}
         self.done: set[int] = set()
+        self.stats = ServeStats()
 
         method = getattr(model, "logits_last", None) or model.logits
+        self._method = method
         accepts_padding = (
             "padding_mask" in inspect.signature(method).parameters
         )
-        step_pad = (
+        self._step_pad = (
             jnp.ones((batch_size, 1), jnp.bool_) if accepts_padding else None
         )
 
-        def step_fn(cache, tok, pos, key):
-            kwargs = {"mask": None}
-            if step_pad is not None:
-                kwargs["padding_mask"] = step_pad
-            logits, state = model.apply(
-                {"params": params, "cache": cache},
-                tok[:, None], pos[:, None],
-                method=method, mutable=["cache"], **kwargs,
-            )
-            row_logits = logits[:, -1].astype(jnp.float32)
-            if temperature == 0.0:
-                nxt = jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
-            else:
-                nxt = jax.random.categorical(
-                    key, row_logits / temperature, axis=-1
-                ).astype(jnp.int32)
-            return state["cache"], nxt
-
-        # donate the cache: XLA aliases input buffers to outputs, so the
-        # per-step update is in place — no second cache residency or
-        # full-cache memcpy per token
-        self._step = jax.jit(step_fn, donate_argnums=0)
+        # jitted executables are built lazily: the per-token step only
+        # compiles if the legacy path (or a mode mix) is actually used,
+        # and each distinct fused K compiles its own scan
+        self._step = None
+        self._fused: dict[tuple[int, bool], object] = {}  # (k, with_admit)
         self._reset = jax.jit(_zero_row, donate_argnums=0)
         self._cache = self._init_cache()
+
+        # fused-mode device carries (one buffer each, donated through)
+        self._tok_d = jnp.zeros((batch_size,), jnp.int32)
+        self._pos_d = jnp.zeros((batch_size,), jnp.int32)
+        self._live_d = jnp.zeros((batch_size,), jnp.bool_)
+        self._rem_d = jnp.zeros((batch_size,), jnp.int32)
+        # dispatched-but-unharvested fused chunks, FIFO
+        self._pending: collections.deque[tuple] = collections.deque()
 
     def _init_cache(self):
         z = jnp.zeros((self._b, 1), jnp.int32)
@@ -157,20 +249,112 @@ class ContinuousBatcher:
         )
         # per-row write indices: seed [B] zeros in place of the scalar —
         # the decode modules accept either rank (nn/attention.py)
-        from flax.traverse_util import flatten_dict, unflatten_dict
+        from d9d_tpu.nn.decode_flags import map_cache_index
 
-        flat = flatten_dict(cache)
-        for path in list(flat):
-            if path[-1] == "cache_index":
-                flat[path] = jnp.zeros((self._b,), jnp.int32)
-        return unflatten_dict(flat)
+        return map_cache_index(
+            cache, lambda _idx: jnp.zeros((self._b,), jnp.int32)
+        )
+
+    # ------------------------------------------------------------------
+    # jitted executables
+
+    def _model_step(self, cache, tok, pos):
+        """One single-token decode call (trace-time helper shared by the
+        per-token and fused executables)."""
+        kwargs = {"mask": None}
+        if self._step_pad is not None:
+            kwargs["padding_mask"] = self._step_pad
+        logits, state = self._model.apply(
+            {"params": self._params, "cache": cache},
+            tok[:, None], pos[:, None],
+            method=self._method, mutable=["cache"], **kwargs,
+        )
+        return state["cache"], logits[:, -1].astype(jnp.float32)
+
+    def _sample(self, row_logits, key):
+        if self._temp == 0.0:
+            return jnp.argmax(row_logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, row_logits / self._temp, axis=-1
+        ).astype(jnp.int32)
+
+    def _build_step(self):
+        def step_fn(cache, tok, pos, key, live):
+            cache, row_logits = self._model_step(cache, tok, pos)
+            nxt = self._sample(row_logits, key)
+            # idle rows ride through the static-shape step; pin their
+            # write index so an arbitrarily long idle stretch can't
+            # overflow capacity or defeat the flash block skip
+            return _pin_cache_index(cache, live), nxt
+
+        # donate the cache: XLA aliases input buffers to outputs, so the
+        # per-step update is in place — no second cache residency or
+        # full-cache memcpy per token
+        return jax.jit(step_fn, donate_argnums=0)
+
+    def _build_fused(self, k: int, with_admit: bool):
+        """Compile one fused K-step executable. ``with_admit`` variants
+        open with the admitted rows' cache zeroing + carry resets fused
+        into the same dispatch; the no-admit variant (the steady state:
+        every follow-up chunk, all speculative chunks) skips them — the
+        masked zero is a full-capacity read+write of every cache leaf,
+        exactly the O(s_max) traffic class the fused loop exists to
+        avoid paying per chunk."""
+        eos = self._eos
+
+        def fused_fn(cache, tok, pos, live, rem, key,
+                     forced_t, n_forced, emit_from,
+                     admit_mask=None, admit_budget=None):
+            if with_admit:
+                # boundary work, fused into the same dispatch: zero
+                # admitted rows' cache and reset their carries
+                cache = _zero_row(cache, admit_mask)
+                pos = jnp.where(admit_mask, 0, pos)
+                live = jnp.where(admit_mask, True, live)
+                rem = jnp.where(admit_mask, admit_budget, rem)
+            keys = jax.random.split(key, k)
+
+            def body(carry, xs):
+                cache, tok, pos, live, rem = carry
+                j, kj, fj = xs
+                # input: host-forced prompt token while any remain for
+                # this row, else the previous step's sampled token
+                inp = jnp.where((j < n_forced) & live, fj, tok)
+                inp = jnp.where(live, inp, 0)
+                pos_in = jnp.where(live, pos, 0)
+                cache, row_logits = self._model_step(cache, inp, pos_in)
+                nxt = self._sample(row_logits, kj)
+                emit = live & (j >= emit_from)
+                out = jnp.where(emit, nxt, -1)
+                # per-row stop masks, applied in-device: the finishing
+                # emission itself goes out, then the row is dead for the
+                # rest of the chunk (harvested at the boundary)
+                rem = rem - emit.astype(jnp.int32)
+                died = emit & (rem <= 0)
+                if eos is not None:
+                    died = died | (emit & (nxt == eos))
+                live = live & jnp.logical_not(died)
+                tok = jnp.where(live, nxt, tok)
+                pos = jnp.where(live, pos + 1, pos)
+                cache = _pin_cache_index(cache, live)
+                return (cache, tok, pos, live, rem), out
+
+            (cache, tok, pos, live, rem), toks = jax.lax.scan(
+                body, (cache, tok, pos, live, rem),
+                (jnp.arange(k, dtype=jnp.int32), keys, forced_t),
+            )
+            # toks [K, B] → the [B, K] device-side emission buffer the
+            # host fetches in ONE readback per chunk
+            return cache, tok, pos, live, rem, jnp.moveaxis(toks, 0, 1)
+
+        return jax.jit(fused_fn, donate_argnums=(0, 1, 2, 3, 4))
 
     # ------------------------------------------------------------------
     def submit(
         self, prompt: Sequence[int], *, max_new_tokens: int
     ) -> int:
         """Queue a request; returns its request id. Admission happens at
-        the next step() with a free slot."""
+        the next step/chunk boundary with a free slot."""
         prompt = [int(x) for x in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -194,7 +378,14 @@ class ContinuousBatcher:
     def active(self) -> int:
         return sum(1 for s in self._slots if s.rid >= 0) + len(self._queue)
 
-    def _admit(self):
+    def _busy(self) -> bool:
+        return any(s.rid >= 0 for s in self._slots)
+
+    # ------------------------------------------------------------------
+    # legacy per-token path (chunk_size=None): the exactness oracle for
+    # the fused path and the latency-critical single-token mode
+
+    def _admit_legacy(self):
         reset_mask = np.zeros((self._b,), bool)
         for i, slot in enumerate(self._slots):
             if slot.rid >= 0 or not self._queue:
@@ -213,19 +404,27 @@ class ContinuousBatcher:
             self._cache = self._reset(
                 self._cache, jnp.asarray(reset_mask)
             )
+            self.stats.host_dispatches += 1
 
-    def step(self) -> dict[int, int]:
-        """Admit waiting requests, advance every slot one token; returns
-        ``{rid: token}`` for tokens emitted (generation phase) this step."""
-        self._admit()
-        if all(s.rid < 0 for s in self._slots):
+    def _step_legacy(self) -> dict[int, int]:
+        self._admit_legacy()
+        if not self._busy():
             return {}
+        if self._step is None:
+            self._step = self._build_step()
         pos = np.asarray([s.pos for s in self._slots], np.int32)
+        live = np.asarray([s.rid >= 0 for s in self._slots], bool)
         self._rng, sub = jax.random.split(self._rng)
         self._cache, nxt = self._step(
-            self._cache, jnp.asarray(self._tokens), jnp.asarray(pos), sub
+            self._cache, jnp.asarray(self._tokens), jnp.asarray(pos),
+            sub, jnp.asarray(live),
         )
         nxt = np.asarray(nxt)
+        self.stats.host_dispatches += 1
+        self.stats.readbacks += 1
+        self.stats.device_steps += 1
+        self.stats.slot_steps_total += self._b
+        self.stats.slot_steps_busy += int(live.sum())
 
         emitted: dict[int, int] = {}
         evict_mask = np.zeros((self._b,), bool)
@@ -240,6 +439,7 @@ class ContinuousBatcher:
             emitted[slot.rid] = tok
             self.outputs[slot.rid].append(tok)
             slot.emitted += 1
+            self.stats.emitted_tokens += 1
             finished = slot.emitted >= slot.budget or (
                 self._eos is not None and tok == self._eos
             )
@@ -251,22 +451,217 @@ class ContinuousBatcher:
             else:
                 self._tokens[i] = tok
         if evict_mask.any():
-            # reset at EVICTION, not just admission: an idle row still
-            # runs through the jitted step, so its cache_index would
-            # otherwise climb past capacity (spurious checkify overflow
-            # under contract validation) and defeat the flash kernel's
-            # whole-block skip (a huge start makes every block visible)
+            # reset at EVICTION, not just admission, so the freed row's
+            # cache contents can't leak into a same-rid-free diagnostic
+            # view; the overflow/block-skip concern itself is handled by
+            # the in-step cache_index pin
             self._cache = self._reset(
                 self._cache, jnp.asarray(evict_mask)
             )
+            self.stats.host_dispatches += 1
         return emitted
 
+    # ------------------------------------------------------------------
+    # fused path: one dispatch + one readback per K-step chunk
+
+    def _dispatch_chunk(self, k: int, admit: bool) -> None:
+        """Build the host plan for one fused chunk and dispatch it.
+
+        ``admit`` must only be True when no chunk is in flight (the
+        host's slot view is then exact); speculative follow-up chunks
+        dispatch with ``admit=False`` and a plan that is deterministic
+        given the previous dispatch (prompt feeding advances host-side,
+        everything else is a device carry).
+        """
+        admit_mask = np.zeros((self._b,), bool)
+        admit_budget = np.zeros((self._b,), np.int32)
+        if admit:
+            for i, slot in enumerate(self._slots):
+                if slot.rid >= 0 or not self._queue:
+                    continue
+                req = self._queue.popleft()
+                self._slots[i] = _Slot(
+                    rid=req.rid,
+                    feed=list(req.prompt),
+                    emitted=0,
+                    budget=req.max_new_tokens,
+                )
+                admit_mask[i] = True
+                admit_budget[i] = req.max_new_tokens
+
+        forced = np.zeros((self._b, k), np.int32)
+        n_forced = np.zeros((self._b,), np.int32)
+        emit_from = np.full((self._b,), k, np.int32)
+        rids = []
+        for i, slot in enumerate(self._slots):
+            rids.append(slot.rid)
+            if slot.rid < 0:
+                continue
+            m = len(slot.feed)
+            nf = min(m, k)
+            if nf:
+                forced[i, :nf] = slot.feed[:nf]
+            n_forced[i] = nf
+            emit_from[i] = max(m - 1, 0)
+            slot.feed = slot.feed[k:]
+
+        self._rng, sub = jax.random.split(self._rng)
+        with_admit = bool(admit_mask.any())
+        fused = self._fused.get((k, with_admit))
+        if fused is None:
+            fused = self._fused[(k, with_admit)] = self._build_fused(
+                k, with_admit
+            )
+        admit_args = (
+            (jnp.asarray(admit_mask), jnp.asarray(admit_budget))
+            if with_admit else ()
+        )
+        (self._cache, self._tok_d, self._pos_d, self._live_d,
+         self._rem_d, toks) = fused(
+            self._cache, self._tok_d, self._pos_d, self._live_d,
+            self._rem_d, sub,
+            # forced_t: scan xs layout [K, B]
+            jnp.asarray(forced.T), jnp.asarray(n_forced),
+            jnp.asarray(emit_from),
+            *admit_args,
+        )
+        self._pending.append(
+            (toks,
+             _ChunkPlan(k=k, rids=rids, emit_from=emit_from.tolist()))
+        )
+        self.stats.host_dispatches += 1
+        self.stats.chunks += 1
+        self.stats.device_steps += k
+
+    def _harvest_one(self) -> dict[int, list[int]]:
+        """Fetch the oldest in-flight chunk (ONE readback) and replay the
+        device's emission/stop logic on it to commit host state."""
+        toks_d, plan = self._pending.popleft()
+        toks = np.asarray(toks_d)  # the single [B, K] readback
+        self.stats.readbacks += 1
+        self.stats.slot_steps_total += self._b * plan.k
+        emitted: dict[int, list[int]] = {}
+        for i, rid in enumerate(plan.rids):
+            if rid < 0 or rid in self.done:
+                # idle at dispatch, or finished in an earlier chunk that
+                # was harvested after this one was (speculatively)
+                # dispatched — the device masked it dead already
+                continue
+            slot = self._slots[i]
+            # exact occupancy, replayed like the device's stop masks: a
+            # row is busy through the step it dies on, idle after
+            busy_steps = plan.k
+            for j in range(min(plan.emit_from[i], plan.k), plan.k):
+                tok = int(toks[i, j])
+                emitted.setdefault(rid, []).append(tok)
+                self.outputs[rid].append(tok)
+                slot.emitted += 1
+                self.stats.emitted_tokens += 1
+                if slot.emitted >= slot.budget or (
+                    self._eos is not None and tok == self._eos
+                ):
+                    self.done.add(rid)
+                    self._slots[i] = _Slot()
+                    busy_steps = j + 1
+                    break
+            self.stats.slot_steps_busy += busy_steps
+        return emitted
+
+    def _sync(self) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        while self._pending:
+            for rid, toks in self._harvest_one().items():
+                out.setdefault(rid, []).extend(toks)
+        return out
+
+    def _may_outlive_pending(self) -> bool:
+        """Could any busy row still be live after the in-flight chunks?
+
+        With no EOS, stopping is budget-only and fully host-predictable,
+        so a speculative chunk that could only serve dead rows is never
+        dispatched. With an EOS id any emission may stop a row — the
+        host can't know until readback, so speculation proceeds (worst
+        case: one wasted chunk at the tail of a drain).
+        """
+        if self._eos is not None:
+            return True
+        proj = {
+            i: s.emitted for i, s in enumerate(self._slots) if s.rid >= 0
+        }
+        for _toks, plan in self._pending:
+            for i in proj:
+                if plan.rids[i] == self._slots[i].rid:
+                    proj[i] += max(0, plan.k - plan.emit_from[i])
+        return any(
+            proj[i] < self._slots[i].budget for i in proj
+        )
+
+    def step_chunk(self) -> dict[int, list[int]]:
+        """Admit waiting requests, advance every slot ``chunk_size``
+        tokens in ONE dispatch; returns ``{rid: [tokens]}`` emitted
+        (generation phase) during the chunk. Fused mode only."""
+        if self._k is None:
+            raise RuntimeError(
+                "step_chunk() needs a fused batcher (chunk_size not None)"
+            )
+        self._sync()
+        if not self._busy() and not self._queue:
+            return {}
+        self._dispatch_chunk(self._k, admit=True)
+        return self._sync()
+
+    def step(self) -> dict[int, int]:
+        """Admit waiting requests, advance every slot one token; returns
+        ``{rid: token}`` for tokens emitted (generation phase) this step.
+
+        In fused mode this runs a K=1 chunk (same one-dispatch boundary
+        semantics); with ``chunk_size=None`` it is the legacy per-token
+        path.
+        """
+        if self._k is None:
+            return self._step_legacy()
+        self._sync()
+        if not self._busy() and not self._queue:
+            return {}
+        self._dispatch_chunk(1, admit=True)
+        return {
+            rid: toks[0] for rid, toks in self._sync().items() if toks
+        }
+
     def drain(self, max_steps: int = 100_000) -> dict[int, list[int]]:
-        """Step until every submitted request has finished."""
+        """Run until every submitted request has finished.
+
+        Fused mode pipelines chunks double-buffered: while no admissions
+        are waiting, the next chunk is dispatched BEFORE the previous
+        chunk's tokens are fetched, overlapping the host readback with
+        device compute (XLA async dispatch). Admission needs an exact
+        slot view, so a non-empty queue forces a synchronous boundary.
+        """
+        if self._k is None:
+            steps = 0
+            while self.active:
+                self._step_legacy()
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError("drain exceeded max_steps")
+            return self.outputs
+
         steps = 0
-        while self.active:
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError("drain exceeded max_steps")
+        while self.active or self._pending:
+            # admissions are waiting: sync so freed slots refill promptly
+            # (and so the admit plan sees exact state)
+            while self._pending and self._queue:
+                self._harvest_one()
+            if self._queue or (self._busy() and self._may_outlive_pending()):
+                self._dispatch_chunk(self._k, admit=not self._pending)
+                steps += self._k
+                if steps > max_steps:
+                    self._sync()
+                    raise RuntimeError("drain exceeded max_steps")
+                # keep at most one chunk in flight beyond the newest: the
+                # harvest of chunk N overlaps chunk N+1's device compute
+                while len(self._pending) > (1 if self._overlap else 0):
+                    self._harvest_one()
+            elif self._pending:
+                self._harvest_one()
         return self.outputs

@@ -4,7 +4,12 @@ Serving extension over the decode stack (docs/design/generation.md):
 a small DRAFT model decodes ``k`` tokens autoregressively, then the
 TARGET model scores all of them in ONE multi-token continuation call —
 ``1 + j`` committed tokens per target call instead of 1, where ``j`` is
-the accepted prefix length. Greedy acceptance (argmax-match) makes the
+the accepted prefix length. The whole round — index rewind, the ``k``
+draft steps (a ``lax.scan``), the extra key write, and the verify call
+— is ONE jitted program, so the host pays a single dispatch and a
+single readback per round rather than re-entering Python per draft
+token (the same chunked host-interaction contract as the fused
+``ContinuousBatcher`` decode loop). Greedy acceptance (argmax-match) makes the
 output BIT-IDENTICAL to target-only greedy decoding — speculation is a
 latency optimization, never an approximation; the tests pin
 ``speculative_generate == generate`` exactly.
@@ -54,13 +59,9 @@ def _assert_rewindable(cache) -> None:
 
 def _set_indices(cache, new_index: Array):
     """Rewind every cache_index leaf to per-row ``new_index [B]``."""
-    from flax.traverse_util import flatten_dict, unflatten_dict
+    from d9d_tpu.nn.decode_flags import map_cache_index
 
-    flat = flatten_dict(cache)
-    for path in list(flat):
-        if path[-1] == "cache_index":
-            flat[path] = new_index
-    return unflatten_dict(flat)
+    return map_cache_index(cache, lambda _idx: new_index)
 
 
 def speculative_generate(
@@ -80,15 +81,20 @@ def speculative_generate(
     Both models need ``decode_max_length >= P + max_new_tokens - 1``
     (the draft additionally writes up to ``speculate_k`` speculative
     slots, which rewind — capacity must cover
-    ``P + max_new_tokens - 1 + speculate_k`` on both). Host-driven loop:
-    each iteration drafts ``speculate_k`` greedy tokens, verifies them
-    in one target call, commits the accepted prefix plus the target's
-    own token at the first mismatch.
+    ``P + max_new_tokens - 1 + speculate_k`` on both). Each round runs
+    as ONE jitted dispatch (rewind + ``speculate_k`` draft steps as a
+    ``lax.scan`` + the single verify call) and one host readback; the
+    host only runs the accept/commit bookkeeping between rounds —
+    Python is re-entered once per round, not once per draft token.
     """
     b, p = prompt_ids.shape
     k = int(speculate_k)
     if k < 1:
         raise ValueError(f"speculate_k must be >= 1, got {k}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
     for name, m in (("model", model), ("draft_model", draft_model)):
         dml = int(getattr(m, "decode_max_length", 0))
         need = p + max_new_tokens - 1 + k
@@ -119,12 +125,12 @@ def speculative_generate(
 
     t_logits, t_cache = prefill(model, params)
     d_logits, d_cache = prefill(draft_model, draft_params)
-    # per-row indices from here on (rows accept different prefix lengths)
-    n = np.full((b,), p, np.int32)  # committed length per row
-    t_cache = _set_indices(t_cache, jnp.asarray(n))
-    d_cache = _set_indices(d_cache, jnp.asarray(n))
+    # per-row committed length (rows accept different prefix lengths);
+    # the caches' write indices are NOT touched here — every round's
+    # spec_round opens by rewinding both to the committed length, which
+    # covers the first round too (nothing reads them in between)
+    n = np.full((b,), p, np.int32)
 
-    @jax.jit
     def draft_step(cache, tok, pos):
         logits, state = draft_model.apply(
             {"params": draft_params, "cache": cache},
@@ -136,18 +142,45 @@ def speculative_generate(
             jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
         )
 
-    def verify_fn(cache, toks, pos):
-        logits, state = model.apply(
-            {"params": params, "cache": cache},
-            toks, pos, method=model.logits, mutable=["cache"],
-        )
-        return (
-            state["cache"],
-            jnp.argmax(logits, axis=-1).astype(jnp.int32),  # [B, 1+k]
-        )
+    def round_fn(t_cache, d_cache, pending, n_eff):
+        """One full speculation round as a single XLA program: rewind both
+        caches to the committed length, draft ``k`` greedy tokens with a
+        ``lax.scan`` (plus the extra key-write for the fully-accepted
+        case), then verify ``pending + proposals`` in one target call —
+        the host dispatches ONCE and reads back once per round instead of
+        re-entering Python for every draft token."""
+        t_cache = _set_indices(t_cache, n_eff)
+        d_cache = _set_indices(d_cache, n_eff)
 
-    verify = jax.jit(verify_fn)
-    rewind = jax.jit(_set_indices)
+        def body(carry, i):
+            cache, tok = carry
+            cache, nxt = draft_step(cache, tok, n_eff + i)
+            return (cache, nxt), nxt
+
+        (d_cache, last), props = jax.lax.scan(
+            body, (d_cache, pending), jnp.arange(k, dtype=jnp.int32)
+        )
+        proposals = jnp.moveaxis(props, 0, 1)  # [B, k]
+        # one extra draft step writes proposals[k-1]'s KEY (its output is
+        # discarded): on a fully-accepted round the committed text
+        # includes proposals[k-1], and without this write the draft
+        # cache would carry a permanently visible unwritten slot —
+        # silently degrading every later proposal's conditioning (and
+        # with it the acceptance rate)
+        d_cache, _ = draft_step(d_cache, last, n_eff + k)
+        toks = jnp.concatenate([pending[:, None], proposals], axis=1)
+        pos = n_eff[:, None] + jnp.arange(1 + k, dtype=jnp.int32)[None]
+        # trace-time flag: the verify chunk attends the warm slot cache
+        # (valid at any index), not the empty-cache prefill fast path
+        with continuation_chunk():
+            logits, state = model.apply(
+                {"params": params, "cache": t_cache},
+                toks, pos, method=model.logits, mutable=["cache"],
+            )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, 1+k]
+        return state["cache"], d_cache, proposals, greedy
+
+    spec_round = jax.jit(round_fn, donate_argnums=(0, 1))
 
     # first committed token: target's own greedy continuation of the
     # prompt (not yet fed to either cache)
@@ -165,33 +198,14 @@ def speculative_generate(
         # writes at slot 0 (their cache is dead) so a finished row near
         # capacity can never violate the overflow contract
         n_eff = np.where(done, 0, n).astype(np.int32)
-        # --- draft k greedy tokens from (pending, positions n..) ------
-        proposals = np.zeros((b, k), np.int32)
-        tok = jnp.asarray(pending)
-        for i in range(k):
-            d_cache, tok = draft_step(
-                d_cache, tok, jnp.asarray(n_eff + i)
-            )
-            proposals[:, i] = np.asarray(tok)
-        # one extra draft step writes proposals[k-1]'s KEY (its output is
-        # discarded): on a fully-accepted round the committed text
-        # includes proposals[k-1], and without this write the draft
-        # cache would carry a permanently visible unwritten slot —
-        # silently degrading every later proposal's conditioning (and
-        # with it the acceptance rate)
-        d_cache, _ = draft_step(d_cache, tok, jnp.asarray(n_eff + k))
-        # --- one target call scores pending + all proposals -----------
-        toks = jnp.concatenate(
-            [jnp.asarray(pending)[:, None], jnp.asarray(proposals)],
-            axis=1,
-        )  # [B, 1+k]
-        pos = (
-            jnp.asarray(n_eff)[:, None]
-            + jnp.arange(1 + k, dtype=jnp.int32)[None]
+        # ONE dispatch per round: rewind-to-committed + k draft steps +
+        # the extra key write + the verify call, all inside spec_round;
+        # ONE readback fetches proposals and the target's greedy tokens
+        t_cache, d_cache, proposals_d, greedy_d = spec_round(
+            t_cache, d_cache, jnp.asarray(pending), jnp.asarray(n_eff)
         )
-        with continuation_chunk():
-            t_cache, greedy = verify(t_cache, toks, pos)
-        greedy = np.asarray(greedy)  # greedy[:, i] = target tok after toks[:, :i+1]
+        proposals, greedy = jax.device_get((proposals_d, greedy_d))
+        # greedy[:, i] = target tok after toks[:, :i+1]
 
         # --- accept the matching prefix, commit the bonus token -------
         new_tokens = np.zeros((b,), np.int32)
@@ -218,12 +232,11 @@ def speculative_generate(
             n[r] += 1 + j  # pending + accepted proposals are now cached
             new_tokens[r] = committed[-1] if committed else 0
         pending = new_tokens
-        # rewind both caches' write indices to the committed length —
-        # rejected proposals' keys become invisible (slot-causal masks);
-        # done rows park at 0
-        n_eff = np.where(done, 0, n).astype(np.int32)
-        t_cache = rewind(t_cache, jnp.asarray(n_eff))
-        d_cache = rewind(d_cache, jnp.asarray(n_eff))
+        # no explicit rewind dispatch here: the NEXT round's spec_round
+        # opens by setting both caches' write indices to the committed
+        # length (done rows parked at 0) — rejected proposals' keys
+        # become invisible the moment the index rewinds (slot-causal
+        # masks), so the correction rides the next dispatch for free
         if eos_id is not None:
             done |= emitted >= max_new_tokens
         else:

@@ -275,7 +275,7 @@ class GroupedQueryAttention(nn.Module):
             # the kernels every step — fail loudly instead of silently
             # regressing (tp in the ambient mesh is how the plans shard
             # HEADS/KV_HEADS)
-            from jax.sharding import get_abstract_mesh
+            from d9d_tpu.core.compat import get_abstract_mesh
 
             mesh = get_abstract_mesh()
             if mesh is not None and dict(mesh.shape).get("tp", 1) > 1:

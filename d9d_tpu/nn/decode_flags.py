@@ -1,4 +1,4 @@
-"""Trace-time decode-phase flags.
+"""Trace-time decode-phase flags and the cache write-index traversal.
 
 Chunked prefill (loop/generate.py ``prefill_chunk_size``) feeds a long
 prompt through the decode cache in bounded pieces. Whether a multi-token
@@ -34,3 +34,22 @@ def continuation_chunk():
 
 def in_continuation_chunk() -> bool:
     return _continuation.get()
+
+
+def map_cache_index(cache, fn):
+    """Apply ``fn`` to every decode write-index leaf of a cache pytree.
+
+    The ONE place that encodes how those leaves are identified
+    (``path[-1] == "cache_index"`` — the name ``_decode_cache_index``
+    declares in every attention module), so the serving loop's per-row
+    seeding/pinning and speculative decoding's rewind can't drift from
+    each other or from a future leaf rename. Trace-safe: pure pytree
+    surgery, callable inside jit.
+    """
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(cache)
+    for path in list(flat):
+        if path[-1] == "cache_index":
+            flat[path] = fn(flat[path])
+    return unflatten_dict(flat)

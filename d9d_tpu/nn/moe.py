@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from d9d_tpu.core import compat
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn import logical_axes as la
 from d9d_tpu.nn.mlp import SwiGLU
@@ -460,7 +461,7 @@ class MoELayer(nn.Module):
             # legacy flow: flatten tokens globally, reshard over ep_axes
             d = hidden.shape[-1]
             k = topk_ids.shape[-1]
-            out = jax.shard_map(
+            out = compat.shard_map(
                 dispatch_local,
                 mesh=mesh,
                 in_specs=(P(ep_axes, None),) * 3
@@ -517,7 +518,7 @@ class MoELayer(nn.Module):
                 out = lax.all_gather(out, dup_axes, axis=0, tiled=True)
             return out.reshape(b_loc, t_loc, d)
 
-        out = jax.shard_map(
+        out = compat.shard_map(
             ep_body,
             mesh=mesh,
             in_specs=(tok_spec,) * 3 + (P(ep_axes, None, None),) * 3,

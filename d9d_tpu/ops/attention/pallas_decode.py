@@ -144,7 +144,12 @@ def _decode_kernel(*refs, cfg: _DecodeConfig):
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # gate by the mask, not just the sentinel: while every key a row
+        # has seen is masked, m_new stays NEG_BIG and exp(s - m_new)
+        # would be 1 for masked entries — silently emitting mean-of-V.
+        # Zeroing masked probabilities keeps l at 0 for such rows, so
+        # the finalize epilogue yields exact zeros instead.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_ref[:, :1] + p.sum(axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
             p, v, preferred_element_type=jnp.float32
@@ -248,7 +253,13 @@ def flash_decode_attention(
     denominator via the standard outside-the-kernel correction.
     Forward-only (decode never backpropagates). Semantics match
     ``eager_sdpa(q, cacheᵀ, cacheᵀ, causal=False,
-    mask=_decode_slot_mask(...))`` — the parity test drives both.
+    mask=_decode_slot_mask(...))`` — the parity test drives both — with
+    one deliberate divergence: a query row whose EVERY key is masked
+    (e.g. ``kv_valid`` zeroing all slots at or before its position)
+    produces exact ZEROS here, where the eager oracle's finite softmax
+    sentinel yields a uniform mean-of-V. Module callers never hit this
+    case (a row's just-written key is always valid), but public callers
+    passing custom validity get the guarded-softmax behavior.
     """
     b, t, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from d9d_tpu.core import compat
 from d9d_tpu.core.types import Array
 
 _NEG_INF = float("-inf")
@@ -339,7 +340,7 @@ def make_ring_sdpa(
             args += (q_segments, kv_segments)
 
         @functools.partial(
-            jax.shard_map,
+            compat.shard_map,
             mesh=m,
             in_specs=in_specs,
             out_specs=qkv_spec,

@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from d9d_tpu.core import compat
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.stage_info import PipelineStageInfo
 
@@ -178,7 +179,7 @@ class PipelineStageRuntime:
         return getattr(self.task, "last_stage_outputs", None) is not None
 
     def _scoped(self):
-        return jax.set_mesh(self.mesh) if self.mesh is not None else (
+        return compat.set_mesh(self.mesh) if self.mesh is not None else (
             contextlib.nullcontext()
         )
 

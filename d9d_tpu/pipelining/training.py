@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from d9d_tpu.core import compat
 from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
@@ -82,7 +83,7 @@ class PipelinedOptimizer:
         self._update = jax.jit(update, donate_argnums=(0, 1, 2))
 
     def _scoped(self, stage: int):
-        return jax.set_mesh(self.scalar_shardings[stage].mesh)
+        return compat.set_mesh(self.scalar_shardings[stage].mesh)
 
     def init(self, stage_params: dict[int, PyTree]) -> dict[int, PyTree]:
         out = {}

@@ -16,6 +16,14 @@ import sys
 import numpy as np
 import pytest
 
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
+
 from d9d_tpu.core.collectives import allgather_variadic
 from d9d_tpu.core.distributed import main_process_first
 
@@ -89,6 +97,7 @@ def _free_port():
 
 
 @pytest.mark.e2e
+@requires_modern_jax
 def test_two_process_variadic_gather_and_main_first(tmp_path):
     child = tmp_path / "child.py"
     child.write_text(_CHILD)

@@ -3,6 +3,7 @@ import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from d9d_tpu.core import compat
 from d9d_tpu.core import MeshContext, MeshParameters
 
 
@@ -76,7 +77,7 @@ def test_psum_over_axis_groups(devices):
     def f(x):
         return jax.lax.psum(x, axis_name=ctx.grad_reduce_axes)
 
-    out = jax.shard_map(
+    out = compat.shard_map(
         f, mesh=ctx.mesh, in_specs=P(ctx.grad_reduce_axes), out_specs=P()
     )(jnp.ones(4))
     assert out.item() == 4.0

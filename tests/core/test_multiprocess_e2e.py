@@ -24,7 +24,16 @@ import subprocess
 import sys
 
 import pytest
-pytestmark = pytest.mark.e2e  # slow tier: full training/IO flows
+
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
+# slow tier: full training/IO flows
+pytestmark = [pytest.mark.e2e, requires_modern_jax]
 
 
 

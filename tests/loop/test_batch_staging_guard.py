@@ -6,6 +6,16 @@ import warnings
 import numpy as np
 import pytest
 
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
+
+pytestmark = requires_modern_jax
+
 from d9d_tpu.core import MeshParameters
 from d9d_tpu.loop.components.batch_staging import make_batch_stager
 

@@ -2,7 +2,12 @@
 emit, per request, exactly the greedy tokens generate() produces —
 slots decode independently, rows reset cleanly on reuse, and the
 per-row cache-index machinery (nn/attention.py dual-rank support,
-flash-decode per-row start) stays invisible to results."""
+flash-decode per-row start) stays invisible to results.
+
+The fused K-step decode path (the default) must additionally be
+token-identical to the legacy per-token path across K, including
+mid-chunk finishes (budget and EOS), mid-chunk admissions (requests
+submitted between chunk boundaries), and the double-buffered drain."""
 
 import jax
 import jax.numpy as jnp
@@ -176,3 +181,129 @@ def test_capacity_and_validation():
         batcher.submit(list(range(6)), max_new_tokens=4)
     with pytest.raises(ValueError, match="empty prompt"):
         batcher.submit([], max_new_tokens=2)
+
+
+# ---------------------------------------------------------------------
+# fused K-step decode path (the default): token-identical to the legacy
+# per-token path and to generate(), across K and boundary cases
+
+
+def _run_batch(model, params, prompts, *, n, chunk, eos=None,
+               overlap=True, batch_size=2):
+    batcher = ContinuousBatcher(
+        model, params, batch_size=batch_size, eos_id=eos,
+        chunk_size=chunk, overlap=overlap,
+    )
+    rids = [batcher.submit(p, max_new_tokens=n) for p in prompts]
+    outputs = batcher.drain()
+    return [outputs[r] for r in rids]
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_fused_matches_per_token_and_generate(k):
+    """K-chunked decode vs the per-token oracle vs generate(): budgets
+    chosen so rows finish mid-chunk at K=4 and K=16."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(10, 4)
+    n = 6  # not a multiple of either K: finishes land mid-chunk
+    want = _run_batch(model, params, prompts, n=n, chunk=None)
+    got = _run_batch(model, params, prompts, n=n, chunk=k)
+    assert got == want
+    for out, prompt in zip(got, prompts):
+        assert out == _oracle(model, params, prompt, n)
+
+
+@pytest.mark.parametrize("k", [4, 16])
+def test_fused_eos_mid_chunk(k):
+    """EOS fires in-device mid-chunk: the row must stop emitting the
+    same step as the per-token path, and its slot must refill."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(11, 4, lo=2, hi=5)
+    n = 8
+    eos = _oracle(model, params, prompts[0], n)[2]
+    want = _run_batch(model, params, prompts, n=n, chunk=None, eos=eos)
+    got = _run_batch(model, params, prompts, n=n, chunk=k, eos=eos)
+    assert got == want
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_fused_mid_chunk_admission(k):
+    """Requests submitted between chunk boundaries are admitted at the
+    next boundary and still decode exactly."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(12, 3)
+    n = 6
+    batcher = ContinuousBatcher(model, params, batch_size=2, chunk_size=k)
+    rids = [batcher.submit(prompts[0], max_new_tokens=n)]
+    batcher.step_chunk()
+    rids.append(batcher.submit(prompts[1], max_new_tokens=n))
+    batcher.step_chunk()
+    rids.append(batcher.submit(prompts[2], max_new_tokens=n))
+    outputs = batcher.drain()
+    for rid, prompt in zip(rids, prompts):
+        assert outputs[rid] == _oracle(model, params, prompt, n), rid
+
+
+def test_fused_overlap_off_identical():
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(13, 3)
+    a = _run_batch(model, params, prompts, n=5, chunk=8, overlap=True)
+    b = _run_batch(model, params, prompts, n=5, chunk=8, overlap=False)
+    assert a == b
+
+
+@pytest.mark.parametrize("chunk", [None, 4])
+def test_idle_slot_cache_index_stays_pinned(chunk):
+    """Regression (ADVICE r5 #1): a slot left idle for more steps than
+    decode_max_length must not advance its cache_index — the jitted
+    step pins idle/dead rows at 0 — and must serve exactly when
+    finally admitted."""
+    from flax.traverse_util import flatten_dict
+
+    model = _dense(decode_max_length=16)
+    params = _params(model)
+    prompt = [3, 9, 4]
+    n = 12
+    batcher = ContinuousBatcher(model, params, batch_size=2,
+                                chunk_size=chunk)
+    # requests run one at a time through slot 0; slot 1 idles for
+    # 4 * (3 + 12 - 1) steps > decode_max_length = 16
+    for _ in range(4):
+        rid = batcher.submit(prompt, max_new_tokens=n)
+        out = batcher.drain()
+        assert out[rid] == _oracle(model, params, prompt, n)
+    for path, leaf in flatten_dict(batcher._cache).items():
+        if path[-1] == "cache_index":
+            assert int(np.asarray(leaf)[1]) == 0, path
+    # the long-idle slot must admit and serve cleanly
+    r0 = batcher.submit(prompt, max_new_tokens=n)
+    r1 = batcher.submit(prompt, max_new_tokens=n)
+    out = batcher.drain()
+    assert out[r0] == out[r1] == _oracle(model, params, prompt, n)
+
+
+def test_fused_dispatch_counters():
+    """The contract the serving bench pins: the fused path pays one
+    dispatch + one readback per chunk (plus boundary work), at least a
+    4x reduction per 1k tokens vs per-token stepping."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(14, 2)
+    n = 8
+    per_tok = ContinuousBatcher(model, params, batch_size=2,
+                                chunk_size=None)
+    fused = ContinuousBatcher(model, params, batch_size=2, chunk_size=8)
+    for b in (per_tok, fused):
+        for p in prompts:
+            b.submit(p, max_new_tokens=n)
+        b.drain()
+    assert fused.stats.emitted_tokens == per_tok.stats.emitted_tokens
+    assert (
+        per_tok.stats.dispatches_per_1k_tokens
+        >= 4 * fused.stats.dispatches_per_1k_tokens
+    )
+    assert fused.stats.readbacks == fused.stats.chunks

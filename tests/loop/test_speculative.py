@@ -139,3 +139,15 @@ def test_capacity_validation():
             model, params, model, params, _prompt(1, 4),
             max_new_tokens=4, speculate_k=4,
         )
+
+
+def test_max_new_tokens_validation():
+    """ADVICE r5 #2: max_new_tokens=0 must raise a clear ValueError up
+    front (matching ContinuousBatcher.submit), not an IndexError from
+    the output-buffer write."""
+    model, params = _dense()
+    with pytest.raises(ValueError, match="max_new_tokens must be >= 1"):
+        speculative_generate(
+            model, params, model, params, _prompt(1, 3),
+            max_new_tokens=0, speculate_k=2,
+        )

@@ -8,6 +8,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
+
 from d9d_tpu.model_state import (
     MODEL_STATE_INDEX_FILE_NAME,
     ModelStateMapperRename,
@@ -107,6 +115,7 @@ def test_param_tree_roundtrip(tmp_path):
     )
 
 
+@requires_modern_jax
 def test_load_params_with_shardings(tmp_path):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

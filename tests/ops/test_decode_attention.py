@@ -100,6 +100,28 @@ def test_parity_per_row_start():
         )
 
 
+def test_fully_masked_rows_emit_zeros():
+    """ADVICE r5 #3: a row whose EVERY key is masked must produce exact
+    zeros (guarded softmax), not the silent mean-of-V that an unclamped
+    online softmax yields when m never leaves its sentinel. Partially
+    masked rows in the same batch must stay oracle-exact."""
+    b, t, hq, hkv, d, s = 2, 1, 4, 2, 16, 64
+    q, k, v = _mk(b, t, hq, hkv, d, s, seed=11)
+    start = 40
+    valid = np.ones((b, s), np.int32)
+    valid[0, :] = 0        # row 0: nothing visible at all
+    valid[1, :10] = 0      # row 1: ordinary left-padded raggedness
+    got = np.asarray(flash_decode_attention(
+        q, k, v, start=jnp.asarray(start),
+        kv_valid=jnp.asarray(valid), interpret=True, block_kv=32,
+    ))
+    np.testing.assert_array_equal(got[0], np.zeros_like(got[0]))
+    want = _oracle(q, k, v, start, None, None, jnp.asarray(valid))
+    np.testing.assert_allclose(
+        got[1:], np.asarray(want)[1:], rtol=2e-5, atol=2e-5
+    )
+
+
 def test_parity_under_jit_traced_start():
     """start is traced in real decode loops (lax.scan carry)."""
     b, t, hq, hkv, d, s = 1, 1, 4, 4, 16, 64

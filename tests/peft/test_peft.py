@@ -11,6 +11,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
 pytestmark = pytest.mark.e2e  # slow tier: LoRA trainer e2e
 
 
@@ -118,6 +126,7 @@ class TestFullTuneAndStack:
 
 
 class TestLoRATrainerE2E:
+    @requires_modern_jax
     def test_lora_trains_and_base_frozen(self, devices):
         from d9d_tpu.core import MeshParameters
         from d9d_tpu.loop import (

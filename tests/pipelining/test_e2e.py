@@ -11,7 +11,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-pytestmark = pytest.mark.e2e  # slow tier: heavy kernel/e2e parity
+
+from d9d_tpu.core.compat import HAS_MODERN_JAX
+
+# the SPMD/multiprocess e2e tier needs the modern jax runtime
+# (core/compat.py emulates only ambient-mesh bookkeeping)
+requires_modern_jax = pytest.mark.skipif(
+    not HAS_MODERN_JAX, reason="needs the modern-jax SPMD runtime"
+)
+# slow tier: heavy kernel/e2e parity
+pytestmark = [pytest.mark.e2e, requires_modern_jax]
 
 
 from d9d_tpu.pipelining import (

@@ -138,3 +138,67 @@ def test_bench_hybrid_tiny_runs(devices):
     assert result["metric"] == "qwen3_next_hybrid_tokens_per_sec_per_chip"
     assert result["value"] > 0
     assert result["detail"]["mfu"] >= 0
+
+
+def test_bench_serving_tiny_runs(devices):
+    """run_bench_serving: the fused continuous-batching serving row —
+    exactness vs the per-token path is asserted INSIDE the leg, so a
+    fused-loop regression fails here before it reaches a TPU window."""
+    bench = _load_bench()
+    result = bench.run_bench_serving(tiny=True)
+    assert result["metric"] == "serving_tokens_per_sec_per_chip"
+    assert result["value"] > 0
+    assert result["detail"]["exact_vs_per_token"] is True
+    # the fused loop's host contract: >= 4x fewer dispatches per token
+    assert (
+        result["detail"]["per_token_dispatches_per_1k_tokens"]
+        >= 4 * result["detail"]["dispatches_per_1k_tokens"]
+    )
+
+
+def test_bench_serve_tool_tiny_runs(devices):
+    """tools/bench_serve.py: the CPU serving microbench end-to-end —
+    every mode must emit identical tokens and the summary must report
+    the fused dispatch reduction."""
+    import json as _json
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_serve.py"), "--tiny",
+         "--requests", "4", "--ks", "8"],
+        capture_output=True, text=True, timeout=560,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(root)},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [_json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    summary = next(r["summary"] for r in rows if "summary" in r)
+    assert summary["all_modes_exact"] is True
+    assert summary["dispatch_reduction_vs_per_token"] >= 4
+
+
+def test_bench_pp_overhead_tiny_runs(devices):
+    """tools/bench_pp_overhead.py: the executor dispatch-overhead A/B
+    (VERDICT r5 Weak #3) stays runnable; the naive re-dispatch loop must
+    not be FASTER than the pre-compiled plan once warm."""
+    import json as _json
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_pp_overhead.py"),
+         "--tiny"],
+        capture_output=True, text=True, timeout=560,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(root)},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [_json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    summary = next(r["summary"] for r in rows if "summary" in r)
+    # the tiny config is timing-jitter-prone on small CI boxes
+    # (BASELINE.md: repeats ranged ~0.9-2.0x), so allow slack below 1.0
+    # while still catching a real inversion of the A/B
+    assert summary["naive_over_precompiled"] > 0.75

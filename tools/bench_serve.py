@@ -1,0 +1,206 @@
+"""Steady-state serving microbenchmark: fused vs per-token stepping.
+
+VERDICT r5 Weak #5: continuous batching was exactness-verified but
+"steps from Python per token and no bench leg measures steady-state
+slot-utilization tok/s". This harness drives a Poisson-ish arrival
+queue through ``ContinuousBatcher`` and reports, per stepping mode:
+
+- generated tokens/sec (wall clock over the drain),
+- slot-utilization % (busy slot-steps / total slot-steps — busy
+  includes prompt consumption),
+- host dispatches and token readbacks per 1k generated tokens (the
+  quantity the fused K-step loop divides by K),
+
+with an exactness cross-check: every mode must emit identical tokens
+per request (greedy). CPU-runnable by design — the host-interaction
+ratio is hardware-independent, so the dispatch-reduction claim can be
+pinned on this rig today and the tok/s column re-recorded on the TPU
+when a tunnel window opens (bench.py's serving leg does that).
+
+Run:            JAX_PLATFORMS=cpu python tools/bench_serve.py --tiny
+TPU (window):   python tools/bench_serve.py
+
+Prints one JSON line per (mode, K) plus a "summary" line with the
+fused-vs-per-token ratios; BASELINE.md records the measured numbers.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def build_model(tiny: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+    from d9d_tpu.nn.sdpa import build_sdpa_backend
+
+    if tiny:
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 256),),
+            hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+            head_dim=16, intermediate_size=128, remat=False,
+        )
+        dml = 96
+        dtype = jnp.float32
+    else:
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 32_768),),
+            hidden_size=1024, num_layers=12, num_heads=16, num_kv_heads=8,
+            head_dim=64, intermediate_size=4096, remat=False,
+        )
+        dml = 512
+        dtype = jnp.bfloat16
+    model = Qwen3DenseCausalLM(
+        config=cfg, sdpa=build_sdpa_backend(), dtype=dtype,
+        decode_max_length=dml,
+    )
+    z = jnp.zeros((2, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+    params = model.clone(decode_max_length=0).init(
+        jax.random.PRNGKey(0), z, pos, z
+    )["params"]
+    return model, params, cfg
+
+
+def make_workload(*, vocab, requests, seed, prompt_lo, prompt_hi,
+                  gen_lo, gen_hi, mean_interarrival):
+    """Poisson-ish open-loop arrivals: each request carries an arrival
+    offset (in decode steps) drawn from an exponential, so the queue
+    alternates between bursts and lulls like real traffic."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    arrivals, t = [], 0.0
+    for _ in range(requests):
+        t += rng.exponential(mean_interarrival)
+        arrivals.append((
+            int(t),
+            rng.randint(0, vocab, rng.randint(prompt_lo, prompt_hi)).tolist(),
+            int(rng.randint(gen_lo, gen_hi)),
+        ))
+    return arrivals
+
+
+def run_mode(model, params, workload, *, batch_size, chunk_size, overlap):
+    """Drive the arrival schedule through one batcher; arrivals are
+    released against the batcher's own device-step clock."""
+    from d9d_tpu.loop.serve import ContinuousBatcher
+
+    batcher = ContinuousBatcher(
+        model, params, batch_size=batch_size,
+        chunk_size=chunk_size, overlap=overlap,
+    )
+    # warmup: compile every executable this run will use — the budget
+    # spans at least two chunks so BOTH fused variants (the admit-
+    # boundary one and the steady-state no-admit one) trace before the
+    # timed window — then reset counters
+    batcher.submit(
+        workload[0][1], max_new_tokens=2 * (chunk_size or 1) + 2
+    )
+    batcher.drain()
+    batcher.stats.reset()
+    batcher.outputs.clear()
+    batcher.done.clear()
+
+    pending = list(workload)
+    rids = {}
+    clock = 0  # decode-step clock the arrival offsets are drawn against
+    t0 = time.perf_counter()
+    while pending:
+        # release every arrival whose offset has passed the step clock
+        while pending and pending[0][0] <= clock:
+            _, prompt, gen = pending.pop(0)
+            rids[len(rids)] = batcher.submit(prompt, max_new_tokens=gen)
+        if batcher.active:
+            # arrivals still due: step synchronously so the clock stays
+            # exact against the release schedule
+            before = batcher.stats.device_steps
+            if chunk_size is None:
+                batcher.step()
+            else:
+                batcher.step_chunk()
+            clock += batcher.stats.device_steps - before
+        elif pending:
+            clock = pending[0][0]  # idle gap: jump to the next arrival
+    # arrivals exhausted: the tail runs through drain(), which is where
+    # the fused path's double-buffered readback (dispatch chunk N+1
+    # before fetching chunk N) actually engages
+    batcher.drain()
+    dt = time.perf_counter() - t0
+    st = batcher.stats
+    outputs = {i: batcher.outputs[r] for i, r in rids.items()}
+    return {
+        "tok_per_s": st.emitted_tokens / dt,
+        "tokens": st.emitted_tokens,
+        "wall_s": dt,
+        "host_dispatches": st.host_dispatches,
+        "readbacks": st.readbacks,
+        "dispatches_per_1k_tokens": st.dispatches_per_1k_tokens,
+        "slot_utilization": st.slot_utilization,
+    }, outputs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI-sized model + workload (CPU-friendly)")
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--ks", type=int, nargs="*", default=[1, 8, 16])
+    args = ap.parse_args()
+
+    model, params, cfg = build_model(args.tiny)
+    n_req = args.requests or (8 if args.tiny else 24)
+    gen_hi = 24 if args.tiny else 128
+    workload = make_workload(
+        vocab=cfg.vocab_size, requests=n_req, seed=0,
+        prompt_lo=2, prompt_hi=8 if args.tiny else 32,
+        gen_lo=4, gen_hi=gen_hi, mean_interarrival=gen_hi / args.batch_size,
+    )
+
+    rows = {}
+    want = None
+    for label, chunk, overlap in (
+        [("per_token", None, False)]
+        + [(f"fused_k{k}", k, True) for k in args.ks]
+    ):
+        row, outputs = run_mode(
+            model, params, workload,
+            batch_size=args.batch_size, chunk_size=chunk, overlap=overlap,
+        )
+        if want is None:
+            want = outputs
+        row["exact_vs_per_token"] = outputs == want
+        rows[label] = row
+        print(json.dumps({"mode": label, **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in row.items()
+        }}), flush=True)
+
+    base = rows["per_token"]
+    fused = [r for name, r in rows.items() if name != "per_token"]
+    best = max(fused, key=lambda r: r["tok_per_s"]) if fused else base
+    print(json.dumps({
+        "summary": {
+            "dispatch_reduction_vs_per_token": round(
+                base["dispatches_per_1k_tokens"]
+                / best["dispatches_per_1k_tokens"], 2
+            ),
+            "speedup_vs_per_token": round(
+                best["tok_per_s"] / base["tok_per_s"], 3
+            ),
+            "all_modes_exact": all(
+                r["exact_vs_per_token"] for r in rows.values()
+            ),
+        }
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ def host_fetch_sync(out):
     import jax
     import numpy as np
 
+    from d9d_tpu.core import compat
+
     leaf = jax.tree.leaves(out)[0]
     if leaf.ndim == 0:
         np.asarray(jax.device_get(leaf))
@@ -30,7 +32,7 @@ def host_fetch_sync(out):
     # stage params on per-stage submeshes)
     mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
     if mesh is not None and getattr(mesh, "devices", None) is not None:
-        with jax.set_mesh(mesh):
+        with compat.set_mesh(mesh):
             np.asarray(jax.device_get(leaf.ravel()[0]))
     else:
         np.asarray(jax.device_get(leaf.ravel()[0]))

@@ -262,6 +262,26 @@ r["detail"]["variant"] = "eager_decode_attn"
 print(json.dumps(r))
 PYEOF
 
+# r6: steady-state serving row — fused K-step ContinuousBatcher decode
+# loop (one dispatch + one readback per K tokens) vs per-token stepping,
+# Poisson-ish arrivals, slot-utilization + dispatches/1k tokens recorded
+run_leg "serving throughput (fused continuous batching)" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
+import json
+import bench
+print(json.dumps(bench.run_bench_serving()))
+PYEOF
+
+# fused-K sensitivity on chip (K=16 halves the host boundary rate again;
+# CPU sweep: tools/bench_serve.py)
+D9D_BENCH_SERVE_K=16 \
+  run_leg "serving throughput, K=16" \
+  bench_results/bench_sweep.jsonl python - <<'PYEOF'
+import json
+import bench
+print(json.dumps(bench.run_bench_serving()))
+PYEOF
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
@@ -271,6 +291,10 @@ run_leg "kernel latency harness" bench_results/kernels.jsonl \
 : > bench_results/pp.jsonl
 run_leg "pipeline schedule microbench" bench_results/pp.jsonl \
   python tools/bench_pp.py
+
+: > bench_results/pp_overhead.jsonl
+run_leg "executor dispatch-overhead A/B (precompiled vs naive)" \
+  bench_results/pp_overhead.jsonl python tools/bench_pp_overhead.py
 
 echo "== schedule-economics makespan sim (device-free, for the record)"
 : > bench_results/makespan.jsonl
