@@ -20,9 +20,14 @@ tests/test_bench.py so it cannot silently rot against loop refactors.
 import json
 import time
 
-# Peak bf16 TFLOPs per chip by device kind substring.
-PEAK_FLOPS = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v4": 275e12,
-              "v6": 918e12}
+# Peak-FLOPs table and FLOPs-per-token inventory shared with the
+# trainer's live-MFU gauge (d9d_tpu/telemetry/flops.py) — one convention,
+# so live and bench-reported MFU cannot drift apart
+from d9d_tpu.telemetry.flops import (  # noqa: E402
+    DEFAULT_PEAK,
+    PEAK_FLOPS,
+    model_flops_per_token,
+)
 
 # Best previously recorded results (BASELINE.md measured rows).
 RECORDED_DENSE = {"v5 lite": 48163.0, "v5e": 48163.0}
@@ -30,36 +35,19 @@ RECORDED_MOE = {"v5 lite": 25280.0, "v5e": 25280.0}
 RECORDED_HYBRID: dict[str, float] = {}  # no chip row yet (BASELINE cfg 5)
 
 
-def _flops_accounting(cfg, *, seq_len, active_param_count,
-                      n_attn_layers=None, extra_attn_flops=0.0):
+def _flops_accounting(cfg, *, seq_len, active_param_count):
     """(model_flops_per_token, hardware_flops_per_token).
 
-    ``n_attn_layers`` restricts the quadratic-attention term to that many
-    layers (hybrid stacks swap the rest for linear attention);
-    ``extra_attn_flops`` adds non-quadratic per-token sequence-mixing work
-    (e.g. the GDN chunked delta rule)."""
-    n_params = active_param_count
-    layers = cfg.num_layers if n_attn_layers is None else n_attn_layers
-    # causal attention: QK^T + PV fwd+bwd = 12 * L * H * D * T/2 per token
-    attn = 6 * layers * cfg.num_heads * cfg.head_dim * seq_len
-    attn += extra_attn_flops
-    model = 6 * n_params + attn
-    hardware = (8 if cfg.remat else 6) * n_params + attn
+    The model term is telemetry/flops.py's shared inventory: 6N_active +
+    quadratic attention on the non-linear layers (causal QK^T + PV
+    fwd+bwd = 12·L·H·D·T/2 per token) + the GDN chunked delta rule on
+    ``linear_attention_layers``. HFU additionally counts the remat
+    forward recompute as useful work (8N)."""
+    model = model_flops_per_token(
+        active_param_count, seq_len=seq_len, config=cfg
+    )
+    hardware = model + (2.0 * active_param_count if cfg.remat else 0.0)
     return model, hardware
-
-
-def _gdn_flops_per_token(cfg, chunk: int = 64) -> float:
-    """Chunked-WY gated-delta FLOPs per token across the GDN layers
-    (ops/gated_delta.py matmul inventory): per head per token the forward
-    costs ≈ 2·2·C·dk (k·kᵀ, q·kᵀ) + C·dv (triangular solve) + 2·C·dv
-    (attn·u) + 3·2·dk·dv (state read ×2 + state update); fwd+bwd ≈ 3×."""
-    if not cfg.linear_attention_layers:
-        return 0.0
-    dk = cfg.gdn_head_qk_dim or cfg.head_dim
-    dv = cfg.gdn_head_v_dim or cfg.head_dim
-    hv = cfg.gdn_v_heads or cfg.num_heads
-    per_head = 3 * (4 * chunk * dk + 3 * chunk * dv + 6 * dk * dv)
-    return len(cfg.linear_attention_layers) * hv * per_head
 
 
 def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len,
@@ -69,23 +57,50 @@ def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len,
     # (see tools/benchtime.py). run_step is one jitted executable, so
     # fetching the loss drains the whole step. The ~70 ms fetch round-trip
     # is measured on the already-materialized value and subtracted.
+    import os
+
     from tools.benchtime import host_fetch_sync, measure_rtt
 
-    for _ in range(warmup):
-        m = trainer.run_step(next(data_iter))
-    host_fetch_sync(m["loss"])
-    rtt = measure_rtt(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = trainer.run_step(next(data_iter))
-    host_fetch_sync(m["loss"])
-    dt = time.perf_counter() - t0 - rtt
+    # telemetry JSONL alongside the bench row (per-step dispatch spans +
+    # tokens/s gauge) when D9D_TELEMETRY_DIR is set; a span costs ~µs
+    # against multi-ms steps, so the recorded numbers stay honest
+    from d9d_tpu.telemetry import attached_jsonl_sink
+
+    tele_dir = os.environ.get("D9D_TELEMETRY_DIR")
+    with attached_jsonl_sink(
+        tele_dir, run_name=f"bench_{profile_tag or 'train'}"
+    ) as (tele, tele_sink):
+        if tele_sink is not None:
+            # each leg (dense/moe/hybrid) gets its own file; clear the
+            # shared hub's instruments so this leg's flush doesn't report
+            # the previous legs' cumulative counters/histograms
+            tele.reset_instruments()
+        try:
+            for _ in range(warmup):
+                m = trainer.run_step(next(data_iter))
+            host_fetch_sync(m["loss"])
+            rtt = measure_rtt(m["loss"])
+            t0 = time.perf_counter()
+            for k in range(steps):
+                # host dispatch only: run_step returns before the device
+                # finishes (async dispatch), so this span is NOT step wall
+                # time — named bench/dispatch to keep it distinct from the
+                # trainer's synchronous train/step timeline
+                with tele.span("bench/dispatch", step=k):
+                    m = trainer.run_step(next(data_iter))
+            host_fetch_sync(m["loss"])
+            dt = time.perf_counter() - t0 - rtt
+            tok_s = steps * batch * seq_len / dt
+            tele.counter("train/tokens").add(steps * batch * seq_len)
+            tele.gauge("train/tokens_per_s").set(tok_s)
+        finally:
+            # a raising step must not leave this leg's events unflushed
+            if tele_sink is not None:
+                tele.flush(step=steps)
 
     # optional SEPARATE traced pass (after timing, so trace collection
     # can't inflate the recorded numbers): set D9D_BENCH_PROFILE_DIR and
     # feed the capture to tools/trace_summary.py
-    import os
-
     profile_root = os.environ.get("D9D_BENCH_PROFILE_DIR")
     if profile_root and profile_tag:
         import jax
@@ -94,7 +109,7 @@ def _measure(trainer, data_iter, *, warmup, steps, batch, seq_len,
             for _ in range(2):
                 m = trainer.run_step(next(data_iter))
             host_fetch_sync(m["loss"])
-    return steps * batch * seq_len / dt
+    return tok_s
 
 
 def _peak():
@@ -102,7 +117,7 @@ def _peak():
 
     kind = jax.devices()[0].device_kind.lower()
     return (
-        next((v for k, v in PEAK_FLOPS.items() if k in kind), 197e12),
+        next((v for k, v in PEAK_FLOPS.items() if k in kind), DEFAULT_PEAK),
         kind,
     )
 
@@ -412,27 +427,18 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
         profile_tag=None if tiny else ("hybrid" if hybrid else "moe"),
     )
 
-    # active params: experts scaled by top_k/num_experts, everything else 1x
-    import jax.tree_util as jtu
+    # active params: experts scaled by top_k/num_experts, everything else
+    # 1x — the same shared accounting the trainer's live-MFU gauge uses
+    from d9d_tpu.telemetry.flops import active_param_count
 
-    expert_params = 0
-    total_params = 0
-    for path, leaf in jtu.tree_leaves_with_path(trainer.params):
-        n = int(np.prod(leaf.shape))
-        total_params += n
-        if "grouped_experts" in "/".join(str(p) for p in path):
-            expert_params += n
-    active = (
-        total_params
-        - expert_params
-        + expert_params * cfg.num_experts_per_tok / cfg.num_experts
+    total_params = sum(
+        int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(trainer.params)
     )
+    active = active_param_count([trainer.params], cfg)
     # hybrid: quadratic-attention FLOPs only on the attention layers; the
     # GDN layers' chunked delta rule counted from its matmul inventory
     model_fpt, hw_fpt = _flops_accounting(
         cfg, seq_len=seq_len, active_param_count=active,
-        n_attn_layers=cfg.num_layers - len(cfg.linear_attention_layers),
-        extra_attn_flops=_gdn_flops_per_token(cfg),
     )
     peak, kind = _peak()
     recorded_tbl = RECORDED_HYBRID if hybrid else RECORDED_MOE
@@ -731,14 +737,25 @@ def run_bench_serving(*, tiny: bool = False) -> dict:
         gen_lo=4, gen_hi=gen_hi, mean_interarrival=gen_hi / batch,
     )
     k = int(os.environ.get("D9D_BENCH_SERVE_K", "8"))
-    fused, fused_out = run_mode(
-        model, params, workload, batch_size=batch,
-        chunk_size=k, overlap=True,
-    )
-    per_tok, per_tok_out = run_mode(
-        model, params, workload, batch_size=batch,
-        chunk_size=None, overlap=False,
-    )
+    from d9d_tpu.telemetry import attached_jsonl_sink
+
+    # one sink across both modes; run_mode's post-warmup
+    # reset_instruments() isolates each mode's flush snapshot
+    with attached_jsonl_sink(
+        os.environ.get("D9D_TELEMETRY_DIR"), run_name="bench_serving"
+    ) as (hub, sink):
+
+        def _timed_mode(mode_index, **kw):
+            try:
+                return run_mode(
+                    model, params, workload, batch_size=batch, **kw
+                )
+            finally:
+                if sink is not None:
+                    hub.flush(step=mode_index)
+
+        fused, fused_out = _timed_mode(0, chunk_size=k, overlap=True)
+        per_tok, per_tok_out = _timed_mode(1, chunk_size=None, overlap=False)
     return {
         "metric": "serving_tokens_per_sec_per_chip",
         "value": round(fused["tok_per_s"], 1),

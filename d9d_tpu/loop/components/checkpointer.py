@@ -17,6 +17,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.telemetry import get_telemetry
 
 logger = logging.getLogger("d9d_tpu.checkpointer")
 
@@ -60,26 +61,31 @@ class StateCheckpointer:
 
     def save(self, step: int, arrays: PyTree, meta: dict[str, Any]) -> None:
         logger.info("checkpointing step %d -> %s", step, self.directory)
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                **{
-                    _ARRAYS: ocp.args.StandardSave(arrays),
-                    _META: ocp.args.JsonSave(meta),
-                }
-            ),
-        )
-        # async mode: orbax has already snapshotted the device arrays to
-        # host (so the train step's donated buffers can't race the save);
-        # the disk write continues in the background and the next save /
-        # restore / close waits on it internally. Sync mode keeps the old
-        # barrier for callers that need the files on disk on return.
-        if not self.async_save:
-            self._mgr.wait_until_finished()
+        # the span covers the synchronous part only: under async save
+        # that is the device→host snapshot; the background disk write is
+        # timed by the io/checkpoint_wait span that eventually joins it
+        with get_telemetry().span("io/checkpoint_save", step=step):
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    **{
+                        _ARRAYS: ocp.args.StandardSave(arrays),
+                        _META: ocp.args.JsonSave(meta),
+                    }
+                ),
+            )
+            # async mode: orbax has already snapshotted the device arrays to
+            # host (so the train step's donated buffers can't race the save);
+            # the disk write continues in the background and the next save /
+            # restore / close waits on it internally. Sync mode keeps the old
+            # barrier for callers that need the files on disk on return.
+            if not self.async_save:
+                self._mgr.wait_until_finished()
 
     def wait_until_finished(self) -> None:
         """Block until any in-flight background save hits disk."""
-        self._mgr.wait_until_finished()
+        with get_telemetry().span("io/checkpoint_wait"):
+            self._mgr.wait_until_finished()
 
     # -- load ----------------------------------------------------------
 
@@ -103,16 +109,19 @@ class StateCheckpointer:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_arrays)
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                **{
-                    _ARRAYS: ocp.args.StandardRestore(abstract),
-                    _META: ocp.args.JsonRestore(),
-                }
-            ),
-        )
+        with get_telemetry().span("io/checkpoint_restore", step=step):
+            abstract = jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, abstract_arrays
+            )
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    **{
+                        _ARRAYS: ocp.args.StandardRestore(abstract),
+                        _META: ocp.args.JsonRestore(),
+                    }
+                ),
+            )
         return step, restored[_ARRAYS], restored[_META]
 
     def close(self) -> None:

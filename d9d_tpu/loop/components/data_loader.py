@@ -8,6 +8,7 @@ single-controller JAX the feeder unit is the *process* (each host stages
 its slice of the global batch), so state keys are ``process_{i}``.
 """
 
+import time
 from collections.abc import Callable, Sequence
 from typing import Any
 
@@ -15,6 +16,7 @@ import jax
 import numpy as np
 
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.telemetry import get_telemetry
 
 
 def default_collate(items: Sequence[PyTree]) -> PyTree:
@@ -75,12 +77,20 @@ class StatefulDataLoader:
                 n_batches += 1
             while self._batch_index < n_batches:
                 b = self._batch_index
+                t_fetch = time.perf_counter()
                 idxs = order[b * self.batch_size : (b + 1) * self.batch_size]
                 items = [self.dataset[int(i)] for i in idxs]
+                batch = self.collate_fn(items)
+                # io/* telemetry: the producer-side fetch+collate cost —
+                # distinct from the trainer's train/phase/data_wait, which
+                # only sees this when prefetch is off or falls behind
+                get_telemetry().histogram("io/data_fetch_s").record(
+                    time.perf_counter() - t_fetch
+                )
                 # yield BEFORE advancing: a checkpoint taken after the step
                 # that consumed batch b must record position b+1
                 self._batch_index = b + 1
-                yield self.collate_fn(items)
+                yield batch
             self._epoch += 1
             self._batch_index = 0
 

@@ -54,6 +54,16 @@ class TrainerConfig(pydantic.BaseModel):
     # worker-backed StatefulDataLoader); 0 = fetch/stage on the step path
     prefetch_batches: int = 2
 
+    # runtime telemetry (docs/design/observability.md): the process-local
+    # registry is always on; these knobs attach sinks. telemetry_dir gets
+    # one schema-versioned JSONL event file per process; the tracker
+    # bridge + console summary flush on telemetry_every_steps (default:
+    # the log cadence)
+    telemetry_dir: str | None = None
+    telemetry_every_steps: int | None = pydantic.Field(default=None, ge=1)
+    telemetry_console: bool = True
+    telemetry_console_interval_s: float = 30.0
+
 
 class InferenceConfig(pydantic.BaseModel):
     model_config = pydantic.ConfigDict(extra="forbid")

@@ -55,18 +55,34 @@ against that oracle, for both the fused and the per-token path.
 (With ``temperature > 0`` the two paths consume the RNG stream in
 different orders — per chunk vs per token — so sampled outputs are
 both valid draws but not bitwise-identical across modes.)
+
+Telemetry (docs/design/observability.md): per-request TTFT / TPOT /
+queue-wait and per-chunk slot-occupancy histograms are derived from the
+host clock at the SAME boundaries the token readbacks already happen at
+— the fused path's host-interaction contract (one dispatch + one
+readback per chunk) is untouched; ``tests/telemetry`` pins
+``stats.readbacks`` against it. Host dispatch/readback/admission
+regions carry ``serve.*`` ``core/tracing.annotate`` labels inside
+profiler capture windows (``tools/trace_summary.py`` groups them).
 """
 
 import collections
 import dataclasses
 import inspect
+import time
+import weakref
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import Array
+from d9d_tpu.telemetry import get_telemetry
+
+# slot-occupancy fraction per chunk/step: 20 linear bins over [0, 1]
+_UTIL_EDGES = tuple(i / 20 for i in range(21))
 
 
 @dataclasses.dataclass
@@ -97,6 +113,48 @@ class _ChunkPlan:
     k: int
     rids: list            # rid per slot at dispatch (-1 = idle)
     emit_from: list       # first step index (within the chunk) that emits
+
+
+@dataclasses.dataclass
+class RequestTelemetry:
+    """Host-clock milestones for one request, harvested at the same
+    boundaries the token readbacks already happen at (chunk boundaries
+    on the fused path, per step on the legacy path) — deriving latency
+    telemetry costs ZERO additional device readbacks.
+
+    Granularity contract: on the fused path first-token and finish
+    times are observed at chunk-boundary harvests, so TTFT/TPOT carry
+    up-to-one-chunk quantization — exactly the latency a caller of
+    ``step_chunk``/``drain`` experiences.
+    """
+
+    submit_t: float
+    admit_t: float | None = None
+    first_tok_t: float | None = None
+    finish_t: float | None = None
+    tokens: int = 0
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        if self.admit_t is None:
+            return None
+        return self.admit_t - self.submit_t
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Submit → first emitted token visible on the host."""
+        if self.first_tok_t is None:
+            return None
+        return self.first_tok_t - self.submit_t
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean per-output-token latency after the first token (the
+        serving TPOT convention); None until finished or for
+        single-token requests."""
+        if self.finish_t is None or self.tokens < 2:
+            return None
+        return (self.finish_t - self.first_tok_t) / (self.tokens - 1)
 
 
 @dataclasses.dataclass
@@ -187,6 +245,7 @@ class ContinuousBatcher:
         rng: Optional[jax.Array] = None,
         chunk_size: Optional[int] = 8,
         overlap: bool = True,
+        telemetry=None,
     ):
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
@@ -211,6 +270,34 @@ class ContinuousBatcher:
         self.outputs: dict[int, list[int]] = {}
         self.done: set[int] = set()
         self.stats = ServeStats()
+        # per-request latency telemetry (serve/* namespace): recorded into
+        # the process hub unless an isolated hub is injected
+        self._tele = telemetry if telemetry is not None else get_telemetry()
+        # finished-request state (stats records, output token lists, done
+        # flags) is retained bounded-FIFO (_MAX_FINISHED_STATS): a
+        # long-lived server must not grow host memory linearly with total
+        # requests served — read results within that retention horizon
+        self.request_stats: dict[int, RequestTelemetry] = {}
+        self._finished_rids: collections.deque[int] = collections.deque()
+        # serve/tokens_per_s two-bucket rolling window, evaluated at
+        # snapshot time via gauge_fn: a lifetime average would flatten
+        # into a constant on a long-lived server, and a last-write-wins
+        # gauge would freeze at the last healthy value through a stall —
+        # this way an idle/stalled server's rate decays toward zero.
+        # Registered through a weakref so the hub (whose gauge_fn
+        # registrations are process-lifetime) never pins a discarded
+        # batcher — and its device-resident cache — in memory.
+        now = time.perf_counter()
+        self._rate_win_t0 = now
+        self._rate_win_tokens = 0
+        self._rate_prev_t0 = now
+        self._rate_prev_tokens = 0
+        this = weakref.ref(self)
+        self._tele.gauge_fn(
+            "serve/tokens_per_s",
+            lambda: b._live_rate() if (b := this()) is not None
+            else float("nan"),
+        )
 
         method = getattr(model, "logits_last", None) or model.logits
         self._method = method
@@ -372,6 +459,10 @@ class ContinuousBatcher:
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt, max_new_tokens))
         self.outputs[rid] = []
+        self.request_stats[rid] = RequestTelemetry(
+            submit_t=time.perf_counter()
+        )
+        self._tele.gauge("serve/queued").set(len(self._queue))
         return rid
 
     @property
@@ -381,30 +472,116 @@ class ContinuousBatcher:
     def _busy(self) -> bool:
         return any(s.rid >= 0 for s in self._slots)
 
+    def reset_measurement(self) -> None:
+        """Zero the counters, per-request records, accumulated outputs and
+        the throughput-rate window. Bench harnesses call this after a
+        warmup/compile request so recorded stats (and the
+        ``serve/tokens_per_s`` gauge's window) cover only the timed
+        window. Only valid while idle — live requests still need their
+        ``request_stats`` records."""
+        if self.active:
+            raise RuntimeError(
+                "reset_measurement() with requests queued or in flight"
+            )
+        self.stats.reset()
+        self.request_stats.clear()
+        self._finished_rids.clear()
+        self.outputs.clear()
+        self.done.clear()
+        now = time.perf_counter()
+        self._rate_win_t0 = now
+        self._rate_win_tokens = 0
+        self._rate_prev_t0 = now
+        self._rate_prev_tokens = 0
+
+    # ------------------------------------------------------------------
+    # request latency telemetry (host clock only; see RequestTelemetry)
+
+    def _note_admit(self, rid: int) -> None:
+        rec = self.request_stats[rid]
+        rec.admit_t = time.perf_counter()
+        self._tele.histogram("serve/queue_wait_s").record(rec.queue_wait_s)
+        self._tele.gauge("serve/queued").set(len(self._queue))
+
+    def _note_tokens(self, rid: int, n: int, now: float) -> None:
+        rec = self.request_stats[rid]
+        if rec.first_tok_t is None:
+            rec.first_tok_t = now
+            self._tele.histogram("serve/ttft_s").record(rec.ttft_s)
+        rec.tokens += n
+
+    def _note_finish(self, rid: int, now: float) -> None:
+        rec = self.request_stats[rid]
+        rec.finish_t = now
+        tpot = rec.tpot_s
+        if tpot is not None:
+            self._tele.histogram("serve/tpot_s").record(tpot)
+        self._tele.counter("serve/requests_finished").add(1)
+        # bound the finished-request retention (FIFO) — stats record,
+        # output token list, and done flag together, so host memory stays
+        # flat however many requests a long-lived server processes; the
+        # aggregate histograms above already captured the latencies
+        self._finished_rids.append(rid)
+        while len(self._finished_rids) > self._MAX_FINISHED_STATS:
+            old = self._finished_rids.popleft()
+            self.request_stats.pop(old, None)
+            self.outputs.pop(old, None)
+            self.done.discard(old)
+
+    # rolling-window span for the live throughput gauge: long enough to
+    # average over scheduling noise, short enough that a collapse shows
+    # within seconds on an operator's console/dashboard
+    _RATE_WINDOW_S = 10.0
+    # finished RequestTelemetry records retained for the host stats API
+    _MAX_FINISHED_STATS = 50_000
+
+    def _live_rate(self) -> float:
+        """Tokens over the current + previous window, against the age of
+        the older one — evaluated at flush/snapshot time, so it reflects
+        'now' even when no harvest has run since the last flush."""
+        dt = time.perf_counter() - self._rate_prev_t0
+        if dt <= 0:
+            return float("nan")
+        return (self._rate_win_tokens + self._rate_prev_tokens) / dt
+
+    def _note_throughput(self, new_tokens: int, now: float) -> None:
+        self._tele.counter("serve/tokens").add(new_tokens)
+        self._tele.gauge("serve/slot_utilization").set(
+            self.stats.slot_utilization
+        )
+        self._rate_win_tokens += new_tokens
+        if now - self._rate_win_t0 >= self._RATE_WINDOW_S:
+            self._rate_prev_t0 = self._rate_win_t0
+            self._rate_prev_tokens = self._rate_win_tokens
+            self._rate_win_t0 = now
+            self._rate_win_tokens = 0
+
     # ------------------------------------------------------------------
     # legacy per-token path (chunk_size=None): the exactness oracle for
     # the fused path and the latency-critical single-token mode
 
     def _admit_legacy(self):
-        reset_mask = np.zeros((self._b,), bool)
-        for i, slot in enumerate(self._slots):
-            if slot.rid >= 0 or not self._queue:
-                continue
-            req = self._queue.popleft()
-            self._slots[i] = _Slot(
-                rid=req.rid,
-                pending=list(req.prompt[1:]),
-                pos=0,
-                emitted=0,
-                budget=req.max_new_tokens,
-            )
-            self._tokens[i] = req.prompt[0]
-            reset_mask[i] = True
-        if reset_mask.any():
-            self._cache = self._reset(
-                self._cache, jnp.asarray(reset_mask)
-            )
-            self.stats.host_dispatches += 1
+        with annotate("serve.admit"):
+            reset_mask = np.zeros((self._b,), bool)
+            for i, slot in enumerate(self._slots):
+                if slot.rid >= 0 or not self._queue:
+                    continue
+                req = self._queue.popleft()
+                self._slots[i] = _Slot(
+                    rid=req.rid,
+                    pending=list(req.prompt[1:]),
+                    pos=0,
+                    emitted=0,
+                    budget=req.max_new_tokens,
+                )
+                self._tokens[i] = req.prompt[0]
+                reset_mask[i] = True
+                self._note_admit(req.rid)
+            if reset_mask.any():
+                self._cache = self._reset(
+                    self._cache, jnp.asarray(reset_mask)
+                )
+                self.stats.host_dispatches += 1
 
     def _step_legacy(self) -> dict[int, int]:
         self._admit_legacy()
@@ -415,16 +592,22 @@ class ContinuousBatcher:
         pos = np.asarray([s.pos for s in self._slots], np.int32)
         live = np.asarray([s.rid >= 0 for s in self._slots], bool)
         self._rng, sub = jax.random.split(self._rng)
-        self._cache, nxt = self._step(
-            self._cache, jnp.asarray(self._tokens), jnp.asarray(pos),
-            sub, jnp.asarray(live),
-        )
-        nxt = np.asarray(nxt)
+        with annotate("serve.dispatch"):
+            self._cache, nxt = self._step(
+                self._cache, jnp.asarray(self._tokens), jnp.asarray(pos),
+                sub, jnp.asarray(live),
+            )
+        with annotate("serve.readback"):
+            nxt = np.asarray(nxt)
+        now = time.perf_counter()
         self.stats.host_dispatches += 1
         self.stats.readbacks += 1
         self.stats.device_steps += 1
         self.stats.slot_steps_total += self._b
         self.stats.slot_steps_busy += int(live.sum())
+        self._tele.histogram("serve/slot_util", _UTIL_EDGES).record(
+            live.sum() / self._b
+        )
 
         emitted: dict[int, int] = {}
         evict_mask = np.zeros((self._b,), bool)
@@ -440,16 +623,19 @@ class ContinuousBatcher:
             self.outputs[slot.rid].append(tok)
             slot.emitted += 1
             self.stats.emitted_tokens += 1
+            self._note_tokens(slot.rid, 1, now)
             finished = slot.emitted >= slot.budget or (
                 self._eos is not None and tok == self._eos
             )
             if finished:
+                self._note_finish(slot.rid, now)
                 self.done.add(slot.rid)
                 self._slots[i] = _Slot()
                 self._tokens[i] = 0
                 evict_mask[i] = True
             else:
                 self._tokens[i] = tok
+        self._note_throughput(len(emitted), now)
         if evict_mask.any():
             # reset at EVICTION, not just admission, so the freed row's
             # cache contents can't leak into a same-rid-free diagnostic
@@ -476,18 +662,20 @@ class ContinuousBatcher:
         admit_mask = np.zeros((self._b,), bool)
         admit_budget = np.zeros((self._b,), np.int32)
         if admit:
-            for i, slot in enumerate(self._slots):
-                if slot.rid >= 0 or not self._queue:
-                    continue
-                req = self._queue.popleft()
-                self._slots[i] = _Slot(
-                    rid=req.rid,
-                    feed=list(req.prompt),
-                    emitted=0,
-                    budget=req.max_new_tokens,
-                )
-                admit_mask[i] = True
-                admit_budget[i] = req.max_new_tokens
+            with annotate("serve.admit"):
+                for i, slot in enumerate(self._slots):
+                    if slot.rid >= 0 or not self._queue:
+                        continue
+                    req = self._queue.popleft()
+                    self._slots[i] = _Slot(
+                        rid=req.rid,
+                        feed=list(req.prompt),
+                        emitted=0,
+                        budget=req.max_new_tokens,
+                    )
+                    admit_mask[i] = True
+                    admit_budget[i] = req.max_new_tokens
+                    self._note_admit(req.rid)
 
         forced = np.zeros((self._b, k), np.int32)
         n_forced = np.zeros((self._b,), np.int32)
@@ -516,15 +704,16 @@ class ContinuousBatcher:
             (jnp.asarray(admit_mask), jnp.asarray(admit_budget))
             if with_admit else ()
         )
-        (self._cache, self._tok_d, self._pos_d, self._live_d,
-         self._rem_d, toks) = fused(
-            self._cache, self._tok_d, self._pos_d, self._live_d,
-            self._rem_d, sub,
-            # forced_t: scan xs layout [K, B]
-            jnp.asarray(forced.T), jnp.asarray(n_forced),
-            jnp.asarray(emit_from),
-            *admit_args,
-        )
+        with annotate("serve.dispatch"):
+            (self._cache, self._tok_d, self._pos_d, self._live_d,
+             self._rem_d, toks) = fused(
+                self._cache, self._tok_d, self._pos_d, self._live_d,
+                self._rem_d, sub,
+                # forced_t: scan xs layout [K, B]
+                jnp.asarray(forced.T), jnp.asarray(n_forced),
+                jnp.asarray(emit_from),
+                *admit_args,
+            )
         self._pending.append(
             (toks,
              _ChunkPlan(k=k, rids=rids, emit_from=emit_from.tolist()))
@@ -537,9 +726,13 @@ class ContinuousBatcher:
         """Fetch the oldest in-flight chunk (ONE readback) and replay the
         device's emission/stop logic on it to commit host state."""
         toks_d, plan = self._pending.popleft()
-        toks = np.asarray(toks_d)  # the single [B, K] readback
+        with annotate("serve.readback"):
+            toks = np.asarray(toks_d)  # the single [B, K] readback
+        now = time.perf_counter()
         self.stats.readbacks += 1
         self.stats.slot_steps_total += self._b * plan.k
+        chunk_busy = 0
+        chunk_tokens = 0
         emitted: dict[int, list[int]] = {}
         for i, rid in enumerate(plan.rids):
             if rid < 0 or rid in self.done:
@@ -557,6 +750,7 @@ class ContinuousBatcher:
                 self.outputs[rid].append(tok)
                 slot.emitted += 1
                 self.stats.emitted_tokens += 1
+                chunk_tokens += 1
                 if slot.emitted >= slot.budget or (
                     self._eos is not None and tok == self._eos
                 ):
@@ -565,6 +759,15 @@ class ContinuousBatcher:
                     busy_steps = j + 1
                     break
             self.stats.slot_steps_busy += busy_steps
+            chunk_busy += busy_steps
+            if rid in emitted:
+                self._note_tokens(rid, len(emitted[rid]), now)
+                if rid in self.done:
+                    self._note_finish(rid, now)
+        self._tele.histogram("serve/slot_util", _UTIL_EDGES).record(
+            chunk_busy / (self._b * plan.k)
+        )
+        self._note_throughput(chunk_tokens, now)
         return emitted
 
     def _sync(self) -> dict[int, list[int]]:

@@ -45,6 +45,17 @@ from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.loop.train_step import build_eval_step, build_train_step
 from d9d_tpu.pipelining import PipelineStageInfo
+from d9d_tpu.telemetry import (
+    ConsoleSink,
+    JsonlSink,
+    TrackerBridge,
+    get_telemetry,
+)
+from d9d_tpu.telemetry.flops import (
+    active_param_count,
+    device_peak_flops,
+    model_flops_per_token,
+)
 from d9d_tpu.tracker import NullTracker, Tracker
 
 logger = logging.getLogger("d9d_tpu.trainer")
@@ -178,7 +189,41 @@ class Trainer:
         )
         self._eval_fn = None
         self._merge_fn = None
+
+        # always-on runtime telemetry (docs/design/observability.md):
+        # recording happens regardless; config knobs only attach sinks
+        # (JSONL event log / tracker bridge / console) inside train()
+        self.telemetry = get_telemetry()
+        self._tokens_per_step = config.global_batch_size * config.seq_len
+        self._flops_per_token = model_flops_per_token(
+            self._active_param_count(), seq_len=config.seq_len,
+            config=self._model_config(),
+        )
+        # tok_s is whole-mesh throughput, so MFU normalizes by the whole
+        # mesh's peak (per-chip peak x mesh size), matching bench.py's
+        # single-chip convention at mesh size 1
+        self._peak_flops = device_peak_flops() * int(ctx.mesh.devices.size)
         self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
+
+    # -- live-MFU inputs (telemetry/flops.py roofline convention) ------
+
+    def _active_param_count(self) -> float:
+        """Params that compute per token, via the shared accounting in
+        telemetry/flops.py (MoE experts scaled by top_k/num_experts) —
+        so the live MFU gauge and the bench-reported MFU agree."""
+        if self.pp_engine is not None:
+            trees = [rt.params for rt in self.pp_engine.stages.values()]
+        else:
+            trees = [self.params]
+        if self.base_params is not None:  # PEFT: frozen base still computes
+            trees.append(self.base_params)
+        return active_param_count(trees, self._model_config())
+
+    def _model_config(self):
+        if self.pp_engine is not None:
+            rt = self.pp_engine.stages.get(0)
+            return getattr(rt.module, "config", None) if rt else None
+        return getattr(self.module, "config", None)
 
     # ------------------------------------------------------------------
 
@@ -293,6 +338,24 @@ class Trainer:
         """Run until total_steps or data exhaustion; returns metric history."""
         history: list[dict] = []
         self.run = None
+        tele = self.telemetry
+        tele_sinks = []
+        if self.config.telemetry_dir:
+            tele_sinks.append(tele.add_sink(JsonlSink(
+                self.config.telemetry_dir,
+                run_name=self.config.run_name or "train",
+                process_index=jax.process_index(),
+            )))
+        if self.config.telemetry_console:
+            tele_sinks.append(tele.add_sink(ConsoleSink(
+                min_interval_s=self.config.telemetry_console_interval_s,
+            )))
+        flush_every = (
+            self.config.telemetry_every_steps
+            if self.config.telemetry_every_steps is not None
+            else self.config.log_every
+        )
+        last_tele_flush = None  # step of the loop's most recent flush
         try:
             self.data_loader = self.dataset_provider.build()
             self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
@@ -301,7 +364,11 @@ class Trainer:
             # output at the original run
             self._try_resume()
             self.run.track_hparams(self.config.model_dump())
+            tele_sinks.append(tele.add_sink(TrackerBridge(self.run)))
             t0 = time.perf_counter()
+            session_steps = 0  # steps run by THIS call (excludes resume)
+            tele_sync_t0 = t0  # last host/device sync point (log cadence)
+            steps_since_sync = 0
             data_iter = iter(self.data_loader)
             use_prefetch = self.config.prefetch_batches > 0
             if (
@@ -341,14 +408,24 @@ class Trainer:
                 )
             with self.timeout, self.gc:
                 while not self.stepper.finished:
+                    step = self.stepper.step
+                    tele.set_step(step)
+                    # contiguous phase timeline: data_wait / host_dispatch /
+                    # device_block / metric_flush / checkpoint / other
+                    # partition the step's wall time gap-free (the JSONL
+                    # timeline accounts for the whole step by construction)
+                    clock = tele.phases("train", step=step)
                     try:
                         if self._prefetcher is not None:
                             raw, batch = None, next(self._prefetcher)
                         else:
                             raw = next(data_iter)
                     except StopIteration:
+                        # no step ran — discard the timeline rather than
+                        # emit a phantom train/step span for this step
+                        clock.cancel()
                         break
-                    step = self.stepper.step
+                    clock.mark("data_wait")
                     self.profiler.step_begin(step)
                     with self.events.bounded(ev.EVENT_STEP, trainer=self, step=step):
                         if raw is not None:
@@ -359,13 +436,17 @@ class Trainer:
                             metrics = self._optimizer_step(batch)
                         self.metric_collector.collect(metrics)
                     step = self.stepper.advance()
+                    session_steps += 1
+                    steps_since_sync += 1
                     self.profiler.step_end(step - 1)
                     self.gc.step(step)
+                    clock.mark("host_dispatch")
                     if self.timeout.step_timeout_s is not None:
                         # async dispatch lets the host run ahead of the device;
                         # a heartbeat only counts once this step really finished,
                         # so a hung collective trips the watchdog within one step
                         jax.block_until_ready(metrics)
+                    clock.mark("device_block")
                     self.timeout.set_periodic()
                     if step % self.config.log_every == 0 or self.stepper.finished:
                         # postprocess sees everything (it may derive scalars
@@ -389,6 +470,12 @@ class Trainer:
                         )
                         host_metrics["step"] = step
                         host_metrics["wall_s"] = time.perf_counter() - t0
+                        # throughput from the batch-maths token count — live
+                        # even before any telemetry sink is attached
+                        host_metrics["tokens_per_s"] = (
+                            session_steps * self._tokens_per_step
+                            / max(host_metrics["wall_s"], 1e-9)
+                        )
                         history.append(host_metrics)
                         for k, v in host_metrics.items():
                             if k != "step":
@@ -397,7 +484,35 @@ class Trainer:
                                     context={"subset": "train"},
                                 )
                         logger.info("step %d: %s", step, host_metrics)
+                        # live throughput + MFU gauges (roofline FLOPs
+                        # inventory, telemetry/flops.py), averaged since
+                        # the previous sync point: the metric fetch above
+                        # just drained the device, so the window is an
+                        # honest device-time average — a single step's
+                        # host wall under async dispatch is not
+                        now = time.perf_counter()
+                        window = now - tele_sync_t0
+                        if window > 0 and steps_since_sync:
+                            tok_s = (
+                                steps_since_sync * self._tokens_per_step
+                                / window
+                            )
+                            tele.gauge("train/tokens_per_s").set(tok_s)
+                            tele.gauge("train/mfu").set(
+                                tok_s * self._flops_per_token
+                                / self._peak_flops
+                            )
+                        tele_sync_t0 = now
+                        steps_since_sync = 0
+                    clock.mark("metric_flush")
                     self._save_checkpoint()
+                    clock.mark("checkpoint")
+                    clock.close()
+                    tele.counter("train/tokens").add(self._tokens_per_step)
+                    tele.counter("train/steps").add(1)
+                    if step % flush_every == 0 or self.stepper.finished:
+                        tele.flush(step)
+                        last_tele_flush = step
                 self._save_checkpoint(last=True)
             self.events.emit(ev.EVENT_TRAIN_FINISHED, trainer=self)
         finally:
@@ -407,6 +522,17 @@ class Trainer:
                 self._prefetcher.close()
                 self._prefetcher = None
             self.profiler.close()
+            # final telemetry flush (short runs still get one flush event,
+            # and early exits flush the tail steps) unless the loop already
+            # flushed at this exact step; then detach this run's sinks —
+            # the registry itself stays live
+            try:
+                if last_tele_flush != self.stepper.step:
+                    tele.flush(self.stepper.step)
+            finally:
+                for sink in tele_sinks:
+                    tele.remove_sink(sink)
+                tele.set_step(None)
             if self.run is not None:
                 self.run.close()
             if self.checkpointer is not None:

@@ -33,11 +33,13 @@ memory exactly like the reference's per-microbatch caches.
 """
 
 import dataclasses
+import time
 from typing import Any
 
 import jax
 
 from d9d_tpu.core.tracing import annotate
+from d9d_tpu.telemetry import get_telemetry
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.program.actions import (
     Action,
@@ -133,6 +135,7 @@ class PipelineScheduleExecutor:
         self._last = self.stages[self.num_stages - 1]
         self._sum_aux = None  # built lazily (jit over the aux list pytree)
         self._plan = self._compile_plan()
+        self._tele = get_telemetry()
 
     # ------------------------------------------------------------------
     # plan compilation: one (handler, action, label) triple per action,
@@ -208,6 +211,16 @@ class PipelineScheduleExecutor:
         first = self.stages[0]
         last = self._last
 
+        t_step0 = time.perf_counter()
+        # per-stage busy seconds, host-attributed: time this single
+        # controller spends dispatching each stage's actions. Under XLA
+        # async dispatch this measures the dispatch loop (the quantity the
+        # trace-annotation tables attribute); the residual
+        # ``step − busy`` is that stage's per-step bubble from the host's
+        # point of view — the observable MPMD-pipeline schedule tuning
+        # actually optimizes (docs/design/observability.md).
+        busy = [0.0] * self.num_stages
+
         st = _StepState(self.num_microbatches)
         with annotate("pp.stage_inputs"):
             for mb, micro in enumerate(microbatches):
@@ -230,7 +243,9 @@ class PipelineScheduleExecutor:
 
         for handler, action, label in self._plan:
             with annotate(label):
+                t_act = time.perf_counter()
                 handler(st, action)
+                busy[action.stage] += time.perf_counter() - t_act
 
         loss_sum = weight_sum = None
         metrics_sum: dict[str, Any] = {}
@@ -268,6 +283,22 @@ class PipelineScheduleExecutor:
                                 v if k not in metrics_sum
                                 else metrics_sum[k] + v
                             )
+
+        total = time.perf_counter() - t_step0
+        tele = self._tele
+        tele.registry.record_span(
+            "pp/step", t_step0, total,
+            meta={"stages": self.num_stages, "train": self.train},
+        )
+        for s in range(self.num_stages):
+            bubble = max(total - busy[s], 0.0)
+            tele.gauge(f"pp/s{s}/busy_s").set(busy[s])
+            tele.gauge(f"pp/s{s}/bubble_s").set(bubble)
+            tele.gauge(f"pp/s{s}/bubble_frac").set(
+                bubble / total if total > 0 else 0.0
+            )
+            tele.counter(f"pp/s{s}/busy_total_s").add(busy[s])
+            tele.counter(f"pp/s{s}/bubble_total_s").add(bubble)
 
         return PipelineExecutionResult(
             grads=st.grads if self.train else None,

@@ -1,0 +1,197 @@
+"""Always-on runtime telemetry: registry + sinks + the process hub.
+
+Usage shape (see docs/design/observability.md):
+
+- Instrumented components (trainer, pipeline executor, serving batcher,
+  checkpointer, data loader) call :func:`get_telemetry` and record into
+  its registry. The hub always exists; with no sinks attached the cost
+  is a few host-clock reads per region and in-memory accumulation.
+- A driver (``Trainer`` via its config, bench harnesses via
+  ``D9D_TELEMETRY_DIR``) attaches sinks — JSONL event log, tracker
+  bridge, console summary — and calls :meth:`Telemetry.flush` on its
+  metric cadence.
+- Tests and embedders may install a fresh hub with :func:`set_telemetry`
+  to isolate their measurements.
+
+Metric namespace (enforced by convention, documented in the design doc):
+``train/*`` trainer loop, ``pp/*`` pipeline executor, ``serve/*``
+continuous batching, ``io/*`` checkpoint + data IO.
+"""
+
+import contextlib
+import threading
+from typing import Any
+
+from d9d_tpu.telemetry.flops import (
+    active_param_count,
+    device_peak_flops,
+    model_flops_per_token,
+)
+from d9d_tpu.telemetry.registry import (
+    SCHEMA_VERSION,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    PhaseTimeline,
+    Span,
+    exp_edges,
+)
+from d9d_tpu.telemetry.sinks import (
+    ConsoleSink,
+    JsonlSink,
+    TelemetrySink,
+    TrackerBridge,
+    iter_events,
+    validate_event,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "PhaseTimeline",
+    "Span",
+    "Telemetry",
+    "TelemetrySink",
+    "JsonlSink",
+    "TrackerBridge",
+    "ConsoleSink",
+    "exp_edges",
+    "get_telemetry",
+    "set_telemetry",
+    "attached_jsonl_sink",
+    "iter_events",
+    "validate_event",
+    "model_flops_per_token",
+    "active_param_count",
+    "device_peak_flops",
+]
+
+
+class Telemetry:
+    """One registry + its attached sinks.
+
+    Spans stream to sinks as they complete (via a registry observer);
+    counters/gauges/histograms reach sinks only on :meth:`flush` — the
+    metric-collector cadence, so the hot loop never serializes a
+    snapshot per step.
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.registry.span_observers.append(self._on_span)
+        self._sinks: list[TelemetrySink] = []
+        self._lock = threading.Lock()
+
+    # -- instrument passthrough (the API components actually use) ------
+
+    def counter(self, name: str) -> Counter:
+        return self.registry.counter(name)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.registry.gauge(name)
+
+    def gauge_fn(self, name: str, fn) -> None:
+        self.registry.gauge_fn(name, fn)
+
+    def histogram(self, name: str, edges=None) -> Histogram:
+        return self.registry.histogram(name, edges)
+
+    def span(self, name: str, *, step: int | None = None, **meta: Any):
+        return self.registry.span(name, step=step, **meta)
+
+    def phases(self, prefix: str, *, step: int | None = None) -> PhaseTimeline:
+        return self.registry.phases(prefix, step=step)
+
+    def set_step(self, step: int | None) -> None:
+        """Tag subsequent spans from step-unaware components (executor,
+        checkpointer IO) with the loop's current step."""
+        self.registry.current_step = step
+
+    def reset_instruments(self) -> None:
+        """Drop all counters/gauges/histograms (sinks stay attached) —
+        bench harnesses call this between measurement windows so each
+        flush snapshot covers exactly one window."""
+        self.registry.reset_instruments()
+
+    # -- sinks ---------------------------------------------------------
+
+    def add_sink(self, sink: TelemetrySink) -> TelemetrySink:
+        with self._lock:
+            self._sinks.append(sink)
+        return sink
+
+    def remove_sink(self, sink: TelemetrySink, *, close: bool = True) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+        if close:
+            sink.close()
+
+    @property
+    def sinks(self) -> tuple[TelemetrySink, ...]:
+        with self._lock:
+            return tuple(self._sinks)
+
+    def _on_span(self, span: Span) -> None:
+        for sink in self.sinks:
+            sink.on_span(span)
+
+    def flush(self, step: int | None = None) -> dict[str, Any]:
+        """Snapshot every instrument and hand it to each sink; returns
+        the snapshot (callers fold headline values into their own logs)."""
+        snapshot = self.registry.snapshot()
+        for sink in self.sinks:
+            sink.on_flush(snapshot, step)
+        return snapshot
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            self.remove_sink(sink)
+
+
+_default: Telemetry | None = None
+_default_lock = threading.Lock()
+
+
+def get_telemetry() -> Telemetry:
+    """The process-local hub every instrumented component records into."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Telemetry()
+    return _default
+
+
+def set_telemetry(hub: Telemetry) -> Telemetry:
+    """Replace the process hub (tests, embedders); returns the new hub."""
+    global _default
+    with _default_lock:
+        _default = hub
+    return hub
+
+
+@contextlib.contextmanager
+def attached_jsonl_sink(directory, *, run_name: str):
+    """Attach a :class:`JsonlSink` for ``directory`` to the process hub
+    for the duration and remove it on exit; flush cadence stays with the
+    caller. Yields ``(hub, sink)`` — ``sink`` is ``None`` and nothing is
+    attached when ``directory`` is falsy, so env-gated bench harnesses
+    share one code path either way."""
+    hub = get_telemetry()
+    if not directory:
+        yield hub, None
+        return
+    import jax  # deferred (process_index): the package core stays jax-free
+
+    sink = hub.add_sink(JsonlSink(
+        directory, run_name=run_name, process_index=jax.process_index(),
+    ))
+    try:
+        yield hub, sink
+    finally:
+        hub.remove_sink(sink)

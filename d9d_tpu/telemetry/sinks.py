@@ -1,0 +1,269 @@
+"""Telemetry sinks: JSONL event log, tracker bridge, console summary.
+
+Three composable consumers of one ``MetricRegistry`` (see
+docs/design/observability.md for how they layer with trackers and
+profiler traces):
+
+- :class:`JsonlSink` — one schema-versioned event file per process.
+  Spans stream in as they complete; instrument snapshots land as
+  ``flush`` events on the flush cadence. Writes stay on the Python
+  buffered-IO layer (no per-event fsync/flush) so a span costs ~a dict
+  + one buffered ``write``.
+- :class:`TrackerBridge` — flushes counters/gauges as scalars and
+  histograms through the existing ``TrackerRun`` scalar/histogram API,
+  on the metric-collector cadence. Values are cumulative-since-start
+  (the tracker UI differentiates; the JSONL log carries the same
+  snapshots for offline rate computation).
+- :class:`ConsoleSink` — a periodic one-line summary through
+  ``logging`` for operators tailing the job log, rate-limited by wall
+  seconds so a tight flush cadence cannot spam the console.
+"""
+
+import json
+import logging
+import math
+import os
+import time
+from pathlib import Path
+from typing import Any, Iterator, TextIO
+
+from d9d_tpu.telemetry.registry import SCHEMA_VERSION, Span
+
+__all__ = [
+    "TelemetrySink",
+    "JsonlSink",
+    "TrackerBridge",
+    "ConsoleSink",
+    "iter_events",
+    "validate_event",
+]
+
+logger = logging.getLogger("d9d_tpu.telemetry")
+
+
+class TelemetrySink:
+    """Interface; all hooks optional."""
+
+    def on_span(self, span: Span) -> None: ...
+
+    def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None: ...
+
+    def close(self) -> None: ...
+
+
+def _finite_or_none(v):
+    if v is None:
+        return None
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+class JsonlSink(TelemetrySink):
+    """Appends one JSON object per line to ``{dir}/{run}_proc{i}.jsonl``.
+
+    The first line is a ``meta`` event carrying the schema version and
+    process identity; every subsequent event is ``span`` or ``flush``.
+    ``process_index`` is injected by the caller (the hub) so this module
+    never imports jax.
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        run_name: str = "telemetry",
+        process_index: int = 0,
+    ):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.path = self.directory / f"{run_name}_proc{process_index}.jsonl"
+        self._process_index = process_index
+        self._fh: TextIO | None = None
+
+    def _file(self) -> TextIO:
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+            self._write(
+                {
+                    "kind": "meta",
+                    "schema": SCHEMA_VERSION,
+                    "process_index": self._process_index,
+                    "pid": os.getpid(),
+                    "unix_time": time.time(),
+                    "perf_counter": time.perf_counter(),
+                }
+            )
+        return self._fh
+
+    def _write(self, obj: dict[str, Any]) -> None:
+        fh = self._fh if self._fh is not None else self._file()
+        fh.write(json.dumps(obj) + "\n")
+
+    def on_span(self, span: Span) -> None:
+        ev: dict[str, Any] = {
+            "kind": "span",
+            "name": span.name,
+            "t0": span.t0,
+            "dur_s": span.dur_s,
+        }
+        if span.step is not None:
+            ev["step"] = span.step
+        if span.meta:
+            ev["meta"] = span.meta
+        self._write(ev)
+
+    def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
+        self._file()  # ensure the meta header exists even for span-free runs
+        self._write(
+            {
+                "kind": "flush",
+                "step": step,
+                "unix_time": time.time(),
+                "counters": snapshot["counters"],
+                "gauges": {
+                    k: _finite_or_none(v)
+                    for k, v in snapshot["gauges"].items()
+                },
+                "histograms": {
+                    k: {
+                        "count": h["count"],
+                        "sum": h["sum"],
+                        "min": h["min"],
+                        "max": h["max"],
+                        "p50": h["p50"],
+                        "p99": h["p99"],
+                    }
+                    for k, h in snapshot["histograms"].items()
+                },
+            }
+        )
+        self._fh.flush()  # flush events bound how much a crash can lose
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TrackerBridge(TelemetrySink):
+    """Pushes registry snapshots into an open ``TrackerRun``.
+
+    Scalars land as ``{name}`` (names already carry the ``train/ pp/
+    serve/ io/`` namespace); histograms go through
+    ``track_histogram`` with their fixed bin edges, plus a ``{name}/p50``
+    scalar so percentile trends are plottable without histogram support.
+    """
+
+    def __init__(self, run, *, context: dict[str, str] | None = None):
+        self.run = run
+        self.context = context or {"subset": "telemetry"}
+
+    def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
+        step = step if step is not None else 0
+        for name, value in snapshot["counters"].items():
+            self.run.track_scalar(name, value, step=step, context=self.context)
+        for name, value in snapshot["gauges"].items():
+            if math.isfinite(value):
+                self.run.track_scalar(
+                    name, value, step=step, context=self.context
+                )
+        for name, h in snapshot["histograms"].items():
+            if h["count"] == 0:
+                continue
+            self.run.track_histogram(
+                name, h["counts"], h["edges"], step=step, context=self.context
+            )
+            if h["p50"] is not None:
+                self.run.track_scalar(
+                    f"{name}/p50", h["p50"], step=step, context=self.context
+                )
+
+    def close(self) -> None:
+        pass  # the run is owned by the trainer, not the bridge
+
+
+class ConsoleSink(TelemetrySink):
+    """One-line operator summary per flush, at most every ``min_interval_s``
+    wall seconds. Picks the handful of headline values an operator wants
+    on a tailing terminal; the full detail lives in the JSONL/tracker."""
+
+    _HEADLINE_GAUGES = (
+        "train/tokens_per_s",
+        "train/mfu",
+        "serve/tokens_per_s",
+        "serve/slot_utilization",
+    )
+    _HEADLINE_HISTS = (
+        "train/step",
+        "serve/ttft_s",
+        "serve/tpot_s",
+    )
+
+    def __init__(self, *, min_interval_s: float = 30.0):
+        self.min_interval_s = min_interval_s
+        # first flush always emits; the interval only rate-limits repeats
+        self._last_emit = -math.inf
+
+    def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
+        now = time.monotonic()
+        if now - self._last_emit < self.min_interval_s:
+            return
+        self._last_emit = now
+        parts = [f"step={step}" if step is not None else "step=?"]
+        gauges = snapshot["gauges"]
+        for name in self._HEADLINE_GAUGES:
+            v = gauges.get(name)
+            if v is not None and math.isfinite(v):
+                parts.append(f"{name.split('/', 1)[1]}={v:.4g}")
+        hists = snapshot["histograms"]
+        for name in self._HEADLINE_HISTS:
+            h = hists.get(name)
+            if h and h["count"]:
+                parts.append(
+                    f"{name.split('/', 1)[1]}"
+                    f"[p50={h['p50']:.4g}s p99={h['p99']:.4g}s]"
+                )
+        logger.info("telemetry %s", " ".join(parts))
+
+
+# -- JSONL schema helpers (shared by tests and offline tooling) ---------
+
+_REQUIRED = {
+    "meta": ("schema", "process_index"),
+    "span": ("name", "t0", "dur_s"),
+    "flush": ("step", "counters", "gauges", "histograms"),
+}
+
+
+def validate_event(event: dict[str, Any]) -> None:
+    """Raise ``ValueError`` if ``event`` is not a well-formed schema-v1
+    telemetry event (the contract bench harness tests pin)."""
+    kind = event.get("kind")
+    if kind not in _REQUIRED:
+        raise ValueError(f"unknown event kind {kind!r}")
+    missing = [k for k in _REQUIRED[kind] if k not in event]
+    if missing:
+        raise ValueError(f"{kind} event missing fields {missing}")
+    if kind == "meta" and event["schema"] != SCHEMA_VERSION:
+        raise ValueError(
+            f"schema {event['schema']} != supported {SCHEMA_VERSION}"
+        )
+    if kind == "span" and not (
+        isinstance(event["dur_s"], (int, float)) and event["dur_s"] >= 0
+    ):
+        raise ValueError("span dur_s must be a non-negative number")
+
+
+def iter_events(path: str | Path) -> Iterator[dict[str, Any]]:
+    """Parse + validate a telemetry JSONL file; the first event must be
+    the schema ``meta`` header."""
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            event = json.loads(line)
+            if i == 0 and event.get("kind") != "meta":
+                raise ValueError("first event must be the meta header")
+            validate_event(event)
+            yield event

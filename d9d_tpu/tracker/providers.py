@@ -172,8 +172,30 @@ class AimTrackerRun(TrackerRun):  # pragma: no cover - needs aim installed
         self._run.track(float(value), name=name, step=step, context=context or {})
 
     def track_histogram(self, name, counts, bin_edges, *, step, context=None):
+        counts = np.asarray(counts, dtype=float)
+        edges = np.asarray(bin_edges, dtype=float)
+        widths = np.diff(edges)
+        if len(widths) > 1 and not np.allclose(widths, widths[0]):
+            # aim.Distribution assumes UNIFORM bins over bin_range; re-bin
+            # non-uniform (e.g. log-spaced latency) histograms by spreading
+            # each source bin's mass over the uniform bins it overlaps, so
+            # the rendered distribution stays honest (if coarse) instead
+            # of silently mislabeling every bin's position
+            n = len(counts)
+            uni = np.linspace(edges[0], edges[-1], n + 1)
+            out = np.zeros(n)
+            for c, lo, hi in zip(counts, edges[:-1], edges[1:]):
+                if c == 0 or hi <= lo:
+                    continue
+                i0 = max(int(np.searchsorted(uni, lo, side="right")) - 1, 0)
+                i1 = min(int(np.searchsorted(uni, hi, side="left")), n)
+                for i in range(i0, i1):
+                    overlap = min(hi, uni[i + 1]) - max(lo, uni[i])
+                    if overlap > 0:
+                        out[i] += c * overlap / (hi - lo)
+            counts = out
         dist = self._aim.Distribution(
-            hist=np.asarray(counts), bin_range=(bin_edges[0], bin_edges[-1])
+            hist=counts, bin_range=(edges[0], edges[-1])
         )
         self._run.track(dist, name=name, step=step, context=context or {})
 

@@ -1,0 +1,43 @@
+"""JobProfiler.close(): a capture window in flight when the loop exits
+(return or raise) must be stopped and its annotation flag reset — a
+trace spanning shutdown would otherwise be left open and lost."""
+
+import pytest
+
+from d9d_tpu.core.tracing import annotations_enabled
+from d9d_tpu.loop.components.job_profiler import JobProfiler
+
+
+def test_close_mid_window_stops_trace_and_resets_flag(tmp_path):
+    prof = JobProfiler(
+        tmp_path, every_steps=100, active_steps=5, wait_steps=0
+    )
+    prof.step_begin(0)  # opens a 5-step window
+    assert prof._tracing_until == 5
+    assert annotations_enabled()
+
+    prof.close()  # trainer's finally, mid-window
+    assert prof._tracing_until is None
+    assert not annotations_enabled()
+    # the interrupted window's trace directory was created (the capture
+    # is flushed, not lost)
+    assert any(tmp_path.iterdir())
+
+    # close is idempotent and a later profiler can start a fresh window
+    prof.close()
+    prof2 = JobProfiler(
+        tmp_path, every_steps=100, active_steps=1, wait_steps=0
+    )
+    prof2.step_begin(0)
+    assert annotations_enabled()
+    prof2.step_end(0)  # window completes normally
+    assert prof2._tracing_until is None
+    assert not annotations_enabled()
+
+
+def test_close_without_window_is_noop(tmp_path):
+    prof = JobProfiler(tmp_path, every_steps=None)
+    prof.step_begin(0)
+    assert prof._tracing_until is None
+    prof.close()
+    assert not annotations_enabled()

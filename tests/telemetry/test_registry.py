@@ -1,0 +1,209 @@
+"""Telemetry primitives: histogram bin/percentile math, instruments,
+span timeline, and the gap-free phase partition (quick tier — no jax)."""
+
+import math
+import time
+
+import pytest
+
+from d9d_tpu.telemetry import (
+    MetricRegistry,
+    Telemetry,
+    exp_edges,
+)
+from d9d_tpu.telemetry.registry import Histogram
+
+
+class TestHistogram:
+    def test_bin_assignment_and_clamping(self):
+        h = Histogram("h", edges=[0.0, 1.0, 2.0, 4.0])
+        for v in (-5.0, 0.0, 0.5):   # below/at first edge → bin 0
+            h.record(v)
+        h.record(1.5)                 # bin 1
+        for v in (2.0, 3.9):          # bin 2
+            h.record(v)
+        h.record(99.0)                # above last edge → clamped to last bin
+        assert h.counts == [3, 1, 3]
+        assert h.count == 7 == sum(h.counts)
+        assert h.min == -5.0 and h.max == 99.0
+        assert h.total == pytest.approx(-5.0 + 0.5 + 1.5 + 2.0 + 3.9 + 99.0)
+
+    def test_percentiles(self):
+        h = Histogram("h", edges=[0.0, 10.0, 20.0, 30.0])
+        for v in range(10):      # 0..9 → bin 0
+            h.record(float(v))
+        for v in range(10, 20):  # 10..19 → bin 1
+            h.record(float(v))
+        assert h.percentile(0.0) == pytest.approx(0.0)
+        assert h.percentile(1.0) == pytest.approx(19.0)  # capped at max
+        # p50 sits at the bin-0/bin-1 boundary
+        assert h.percentile(0.5) == pytest.approx(9.0, abs=1.01)
+        assert 10.0 <= h.percentile(0.9) <= 19.0
+        assert math.isnan(Histogram("e", edges=[0, 1]).percentile(0.5))
+        with pytest.raises(ValueError):
+            h.percentile(1.5)
+
+    def test_percentiles_stay_within_min_max_outside_edges(self):
+        # values below the first edge (or above the last) land in the
+        # edge bins; percentiles must still respect the recorded range
+        h = Histogram("h")  # DEFAULT_LATENCY_EDGES: lo = 1e-6
+        h.record(2e-7)
+        s = h.snapshot()
+        assert s["min"] <= s["p50"] <= s["max"]
+        assert s["min"] <= s["p99"] <= s["max"]
+        h2 = Histogram("h2", edges=[0.0, 1.0, 2.0])
+        h2.record(5.0)  # above the last edge
+        s2 = h2.snapshot()
+        assert s2["min"] <= s2["p50"] <= s2["max"] == 5.0
+
+    def test_mean_and_snapshot(self):
+        h = Histogram("h", edges=[0.0, 1.0, 2.0])
+        h.record(0.5)
+        h.record(1.5)
+        assert h.mean == pytest.approx(1.0)
+        snap = h.snapshot()
+        assert snap["count"] == 2
+        assert snap["counts"] == [1, 1]
+        assert len(snap["edges"]) == len(snap["counts"]) + 1
+        assert snap["p50"] is not None and snap["p99"] is not None
+
+    def test_bad_edges(self):
+        with pytest.raises(ValueError):
+            Histogram("h", edges=[1.0])
+        with pytest.raises(ValueError):
+            Histogram("h", edges=[1.0, 1.0])
+
+    def test_exp_edges(self):
+        edges = exp_edges(1e-3, 1.0, 3)
+        assert len(edges) == 4
+        assert edges[0] == pytest.approx(1e-3)
+        assert edges[-1] == pytest.approx(1.0)
+        # log-uniform: constant ratio between consecutive edges
+        ratios = [b / a for a, b in zip(edges, edges[1:])]
+        assert all(r == pytest.approx(ratios[0]) for r in ratios)
+        with pytest.raises(ValueError):
+            exp_edges(0.0, 1.0, 4)
+
+
+class TestRegistry:
+    def test_instruments_get_or_create(self):
+        reg = MetricRegistry()
+        c = reg.counter("a")
+        c.add(2)
+        c.add(3.5)
+        assert reg.counter("a") is c and c.value == 5.5
+        reg.gauge("g").set(7)
+        assert reg.gauge("g").value == 7.0
+        assert reg.histogram("h") is reg.histogram("h")
+
+    def test_span_records_timeline_and_histogram(self):
+        reg = MetricRegistry()
+        with reg.span("io/x", step=3, tag="t"):
+            time.sleep(0.002)
+        (span,) = reg.spans
+        assert span.name == "io/x" and span.step == 3
+        assert span.meta == {"tag": "t"}
+        assert span.dur_s >= 0.002
+        assert reg.histogram("io/x").count == 1
+
+    def test_current_step_tags_unstepped_spans(self):
+        reg = MetricRegistry()
+        reg.current_step = 9
+        with reg.span("pp/x"):
+            pass
+        assert reg.spans[-1].step == 9
+
+    def test_span_observers_stream(self):
+        hub = Telemetry()
+        seen = []
+        hub.registry.span_observers.append(seen.append)
+        with hub.span("a"):
+            pass
+        assert [s.name for s in seen] == ["a"]
+
+    def test_timeline_bounded(self):
+        reg = MetricRegistry(timeline_capacity=4)
+        for i in range(10):
+            reg.record_span("x", 0.0, 0.1, step=i)
+        assert len(reg.spans) == 4
+        assert [s.step for s in reg.spans] == [6, 7, 8, 9]
+
+    def test_gauge_fn_evaluated_at_snapshot(self):
+        reg = MetricRegistry()
+        v = {"x": 1.5}
+        reg.gauge_fn("live/rate", lambda: v["x"])
+        assert reg.snapshot()["gauges"]["live/rate"] == 1.5
+        v["x"] = 3.0  # no re-registration needed: evaluated per snapshot
+        assert reg.snapshot()["gauges"]["live/rate"] == 3.0
+        # NaN = absent; exceptions skip the gauge, not the flush
+        reg.gauge_fn("live/nan", lambda: float("nan"))
+        reg.gauge_fn("live/boom", lambda: 1 / 0)
+        snap = reg.snapshot()
+        assert "live/nan" not in snap["gauges"]
+        assert "live/boom" not in snap["gauges"]
+        assert snap["gauges"]["live/rate"] == 3.0
+        # registrations are wiring, not accumulated state
+        reg.reset_instruments()
+        assert reg.snapshot()["gauges"]["live/rate"] == 3.0
+
+    def test_reset_instruments_clears_and_recreates(self):
+        reg = MetricRegistry()
+        reg.counter("c").add(5)
+        reg.gauge("g").set(1.0)
+        reg.histogram("h").record(0.5)
+        with reg.span("s"):
+            pass
+        reg.reset_instruments()
+        snap = reg.snapshot()
+        assert snap["counters"] == {}
+        assert snap["gauges"] == {}
+        assert snap["histograms"] == {}
+        # the span timeline and observers survive; instruments reappear
+        # empty on next lookup
+        assert len(reg.spans) == 1
+        reg.counter("c").add(1)
+        assert reg.snapshot()["counters"] == {"c": 1.0}
+
+
+class TestPhaseTimeline:
+    def test_phases_partition_gap_free(self):
+        reg = MetricRegistry()
+        clock = reg.phases("train", step=1)
+        time.sleep(0.002)
+        clock.mark("data_wait")
+        time.sleep(0.002)
+        clock.mark("host_dispatch")
+        time.sleep(0.001)
+        total = clock.close()
+        spans = {s.name: s for s in reg.spans}
+        phases = [s for s in reg.spans if "/phase/" in s.name]
+        assert {s.name for s in phases} == {
+            "train/phase/data_wait",
+            "train/phase/host_dispatch",
+            "train/phase/other",
+        }
+        # gap-free by construction: phases sum to the enclosing span
+        assert sum(s.dur_s for s in phases) == pytest.approx(
+            spans["train/step"].dur_s, rel=1e-6
+        )
+        assert total == pytest.approx(spans["train/step"].dur_s)
+        # contiguity: each phase starts where the previous ended
+        ordered = sorted(phases, key=lambda s: s.t0)
+        for a, b in zip(ordered, ordered[1:]):
+            assert a.t0 + a.dur_s == pytest.approx(b.t0)
+
+    def test_close_idempotent(self):
+        reg = MetricRegistry()
+        clock = reg.phases("t")
+        clock.close()
+        n = len(reg.spans)
+        assert clock.close() == 0.0
+        assert len(reg.spans) == n
+
+    def test_cancel_emits_nothing(self):
+        reg = MetricRegistry()
+        clock = reg.phases("t", step=4)
+        clock.cancel()
+        assert len(reg.spans) == 0
+        assert clock.close() == 0.0  # closed: later close is a no-op
+        assert len(reg.spans) == 0

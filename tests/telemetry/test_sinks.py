@@ -1,0 +1,139 @@
+"""Telemetry sinks: JSONL schema round-trip, tracker-bridge flush
+cadence, console rate limit (quick tier — no jax)."""
+
+import json
+import logging
+
+import pytest
+
+from d9d_tpu.telemetry import (
+    SCHEMA_VERSION,
+    ConsoleSink,
+    JsonlSink,
+    Telemetry,
+    TrackerBridge,
+    iter_events,
+    validate_event,
+)
+from d9d_tpu.tracker.providers import MemoryTrackerRun
+
+
+class TestJsonlSink:
+    def test_schema_round_trip(self, tmp_path):
+        hub = Telemetry()
+        sink = hub.add_sink(
+            JsonlSink(tmp_path, run_name="t", process_index=3)
+        )
+        hub.counter("train/tokens").add(64)
+        hub.gauge("train/tokens_per_s").set(123.0)
+        hub.histogram("serve/ttft_s").record(0.5)
+        with hub.span("io/x", step=2, tag="v"):
+            pass
+        hub.flush(step=2)
+        hub.close()
+
+        assert sink.path.name == "t_proc3.jsonl"
+        events = list(iter_events(sink.path))  # validates every line
+        assert events[0]["kind"] == "meta"
+        assert events[0]["schema"] == SCHEMA_VERSION
+        assert events[0]["process_index"] == 3
+        spans = [e for e in events if e["kind"] == "span"]
+        assert spans[0]["name"] == "io/x"
+        assert spans[0]["step"] == 2 and spans[0]["meta"] == {"tag": "v"}
+        (flush,) = [e for e in events if e["kind"] == "flush"]
+        assert flush["step"] == 2
+        assert flush["counters"]["train/tokens"] == 64.0
+        assert flush["gauges"]["train/tokens_per_s"] == 123.0
+        assert flush["histograms"]["serve/ttft_s"]["count"] == 1
+
+    def test_append_keeps_file_valid(self, tmp_path):
+        for _ in range(2):  # two sessions appending to the same file
+            hub = Telemetry()
+            hub.add_sink(JsonlSink(tmp_path, run_name="t"))
+            hub.flush(step=0)
+            hub.close()
+        events = list(iter_events(tmp_path / "t_proc0.jsonl"))
+        assert [e["kind"] for e in events] == ["meta", "flush", "meta", "flush"]
+
+    def test_validate_event_rejects_malformed(self):
+        with pytest.raises(ValueError, match="unknown event kind"):
+            validate_event({"kind": "nope"})
+        with pytest.raises(ValueError, match="missing fields"):
+            validate_event({"kind": "span", "name": "x"})
+        with pytest.raises(ValueError, match="schema"):
+            validate_event(
+                {"kind": "meta", "schema": 999, "process_index": 0}
+            )
+        with pytest.raises(ValueError, match="dur_s"):
+            validate_event(
+                {"kind": "span", "name": "x", "t0": 0.0, "dur_s": -1.0}
+            )
+
+    def test_iter_events_requires_meta_header(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text(json.dumps({"kind": "span", "name": "x", "t0": 0,
+                                 "dur_s": 0.1}) + "\n")
+        with pytest.raises(ValueError, match="meta header"):
+            list(iter_events(p))
+
+
+class TestTrackerBridge:
+    def test_flush_cadence_and_shapes(self):
+        hub = Telemetry()
+        run = MemoryTrackerRun()
+        hub.add_sink(TrackerBridge(run))
+        hub.counter("serve/tokens").add(10)
+        hub.gauge("train/mfu").set(0.25)
+        h = hub.histogram("serve/ttft_s", edges=[0.0, 1.0, 2.0])
+        h.record(0.5)
+        # nothing reaches the run until a flush — the cadence is the
+        # caller's (metric-collector) cadence, not per-record
+        assert run.scalars == [] and run.histograms == []
+        hub.flush(step=10)
+        hub.counter("serve/tokens").add(5)
+        hub.flush(step=20)
+
+        by_step = {}
+        for s in run.scalars:
+            by_step.setdefault(s["step"], {})[s["name"]] = s["value"]
+        assert by_step[10]["serve/tokens"] == 10.0
+        assert by_step[20]["serve/tokens"] == 15.0  # cumulative
+        assert by_step[10]["train/mfu"] == 0.25
+        assert by_step[10]["serve/ttft_s/p50"] is not None
+        # histogram payload matches the tracker API contract
+        hist = run.histograms[0]
+        assert len(hist["bin_edges"]) == len(hist["counts"]) + 1
+        assert sum(hist["counts"]) == 1
+
+    def test_empty_histograms_not_tracked(self):
+        hub = Telemetry()
+        run = MemoryTrackerRun()
+        hub.add_sink(TrackerBridge(run))
+        hub.histogram("never_recorded")
+        hub.flush(step=0)
+        assert run.histograms == []
+
+
+class TestConsoleSink:
+    def test_rate_limited_one_line(self, caplog):
+        hub = Telemetry()
+        hub.add_sink(ConsoleSink(min_interval_s=0.0))
+        hub.gauge("train/tokens_per_s").set(1000.0)
+        hub.histogram("train/step").record(0.25)
+        with caplog.at_level(logging.INFO, logger="d9d_tpu.telemetry"):
+            hub.flush(step=5)
+        (rec,) = caplog.records
+        line = rec.getMessage()
+        assert "step=5" in line
+        assert "tokens_per_s=1000" in line
+        assert "\n" not in line
+
+    def test_first_flush_emits_then_interval_suppresses(self, caplog):
+        hub = Telemetry()
+        hub.add_sink(ConsoleSink(min_interval_s=3600.0))
+        with caplog.at_level(logging.INFO, logger="d9d_tpu.telemetry"):
+            hub.flush(step=1)  # first flush always emits
+            hub.flush(step=2)  # inside the interval: suppressed
+        assert [r.getMessage() for r in caplog.records] == [
+            "telemetry step=1"
+        ]

@@ -20,7 +20,10 @@ def _load_bench():
     return load_repo_module("bench", "bench.py")
 
 
-def test_bench_tiny_runs(devices):
+def test_bench_tiny_runs(devices, tmp_path, monkeypatch):
+    # the bench leg emits the telemetry JSONL alongside its row when
+    # D9D_TELEMETRY_DIR is set (docs/design/observability.md)
+    monkeypatch.setenv("D9D_TELEMETRY_DIR", str(tmp_path))
     bench = _load_bench()
     result = bench.run_bench(tiny=True)
     assert result["metric"] == "dense_lm_tokens_per_sec_per_chip"
@@ -28,6 +31,15 @@ def test_bench_tiny_runs(devices):
     assert result["unit"] == "tokens/s"
     assert "vs_baseline" in result
     assert result["detail"]["mfu"] >= 0
+    from d9d_tpu.telemetry import iter_events
+
+    (jsonl,) = tmp_path.glob("*.jsonl")
+    events = list(iter_events(jsonl))  # schema-validates every line
+    kinds = {e["kind"] for e in events}
+    assert {"meta", "span", "flush"} <= kinds
+    assert any(
+        e["kind"] == "span" and e["name"] == "bench/dispatch" for e in events
+    )
 
 
 def test_bench_pp_tiny_runs(devices):
@@ -156,17 +168,19 @@ def test_bench_serving_tiny_runs(devices):
     )
 
 
-def test_bench_serve_tool_tiny_runs(devices):
+def test_bench_serve_tool_tiny_runs(devices, tmp_path):
     """tools/bench_serve.py: the CPU serving microbench end-to-end —
-    every mode must emit identical tokens and the summary must report
-    the fused dispatch reduction."""
+    every mode must emit identical tokens, the summary must report the
+    fused dispatch reduction, and --telemetry-out must produce a
+    schema-valid JSONL with the serving latency histograms."""
     import json as _json
     import subprocess
 
     root = pathlib.Path(__file__).resolve().parent.parent
     out = subprocess.run(
         [sys.executable, str(root / "tools" / "bench_serve.py"), "--tiny",
-         "--requests", "4", "--ks", "8"],
+         "--requests", "4", "--ks", "8",
+         "--telemetry-out", str(tmp_path)],
         capture_output=True, text=True, timeout=560,
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
              "PYTHONPATH": str(root)},
@@ -177,6 +191,16 @@ def test_bench_serve_tool_tiny_runs(devices):
     summary = next(r["summary"] for r in rows if "summary" in r)
     assert summary["all_modes_exact"] is True
     assert summary["dispatch_reduction_vs_per_token"] >= 4
+
+    from d9d_tpu.telemetry import iter_events
+
+    (jsonl,) = tmp_path.glob("*.jsonl")
+    events = list(iter_events(jsonl))  # schema-validates every line
+    flushes = [e for e in events if e["kind"] == "flush"]
+    assert len(flushes) == 2  # one per mode: per_token + fused_k8
+    for e in flushes:
+        assert e["histograms"]["serve/ttft_s"]["count"] > 0
+        assert e["histograms"]["serve/queue_wait_s"]["count"] > 0
 
 
 def test_bench_pp_overhead_tiny_runs(devices):

@@ -16,6 +16,10 @@ def test_trace_summary_attribution_helpers():
         {"name": "pp.fwd.s1.mb2", "dur": 10},
         {"name": "pp_opt.update", "dur": 7},
         {"name": "loop.batch_staging", "dur": 5},
+        {"name": "serve.dispatch", "dur": 11},
+        {"name": "serve.dispatch", "dur": 9},
+        {"name": "serve.readback", "dur": 4},
+        {"name": "serve.admit", "dur": 2},
         {"name": "unrelated", "dur": 99},
         {"name": "pp.bwd.s0.mb1", "dur": 0},  # zero-dur dropped
     ]
@@ -24,6 +28,9 @@ def test_trace_summary_attribution_helpers():
     assert regions["pp.fwd"] == (10, 1)
     assert regions["pp_opt.update"] == (7, 1)
     assert regions["loop.batch_staging"] == (5, 1)
+    assert regions["serve.dispatch"] == (20, 2)
+    assert regions["serve.readback"] == (4, 1)
+    assert regions["serve.admit"] == (2, 1)
     assert "unrelated" not in regions
 
     assert ts.scope_of({"name": "jit(wrapped)/pp_s0/fwd/dot_general"}) == "pp_s0/fwd"

@@ -22,6 +22,10 @@ TPU (window):   python tools/bench_serve.py
 
 Prints one JSON line per (mode, K) plus a "summary" line with the
 fused-vs-per-token ratios; BASELINE.md records the measured numbers.
+With ``--telemetry-out DIR`` (or ``$D9D_TELEMETRY_DIR``) the run also
+emits the schema-versioned telemetry JSONL event log — TTFT/TPOT/
+queue-wait/slot-util histograms, one flush event per mode
+(docs/design/observability.md).
 """
 
 import argparse
@@ -87,10 +91,18 @@ def make_workload(*, vocab, requests, seed, prompt_lo, prompt_hi,
     return arrivals
 
 
-def run_mode(model, params, workload, *, batch_size, chunk_size, overlap):
+def run_mode(model, params, workload, *, batch_size, chunk_size, overlap,
+             reset_telemetry=True):
     """Drive the arrival schedule through one batcher; arrivals are
-    released against the batcher's own device-step clock."""
+    released against the batcher's own device-step clock.
+
+    ``reset_telemetry`` (default on, for the bench harnesses) clears the
+    PROCESS-GLOBAL telemetry hub's instruments after the warmup request,
+    so each mode's flush snapshot is warmup-free and per-mode — pass
+    False when embedding run_mode next to other instrumented components
+    whose counters must survive."""
     from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.telemetry import get_telemetry
 
     batcher = ContinuousBatcher(
         model, params, batch_size=batch_size,
@@ -99,14 +111,16 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap):
     # warmup: compile every executable this run will use — the budget
     # spans at least two chunks so BOTH fused variants (the admit-
     # boundary one and the steady-state no-admit one) trace before the
-    # timed window — then reset counters
+    # timed window — then reset counters AND telemetry instruments so
+    # neither the stats row nor the flushed histograms carry the warmup
+    # request's compile-dominated latencies (or a previous mode's data)
     batcher.submit(
         workload[0][1], max_new_tokens=2 * (chunk_size or 1) + 2
     )
     batcher.drain()
-    batcher.stats.reset()
-    batcher.outputs.clear()
-    batcher.done.clear()
+    batcher.reset_measurement()
+    if reset_telemetry:
+        get_telemetry().reset_instruments()
 
     pending = list(workload)
     rids = {}
@@ -147,12 +161,20 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap):
 
 
 def main():
+    import os
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
                     help="CI-sized model + workload (CPU-friendly)")
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--ks", type=int, nargs="*", default=[1, 8, 16])
+    ap.add_argument(
+        "--telemetry-out", default=os.environ.get("D9D_TELEMETRY_DIR"),
+        help="directory for the schema-versioned telemetry JSONL event "
+        "log (TTFT/TPOT/queue-wait/slot-util histograms per mode); "
+        "defaults to $D9D_TELEMETRY_DIR, off when unset",
+    )
     args = ap.parse_args()
 
     model, params, cfg = build_model(args.tiny)
@@ -164,24 +186,38 @@ def main():
         gen_lo=4, gen_hi=gen_hi, mean_interarrival=gen_hi / args.batch_size,
     )
 
+    from d9d_tpu.telemetry import attached_jsonl_sink
+
     rows = {}
     want = None
-    for label, chunk, overlap in (
-        [("per_token", None, False)]
-        + [(f"fused_k{k}", k, True) for k in args.ks]
-    ):
-        row, outputs = run_mode(
-            model, params, workload,
-            batch_size=args.batch_size, chunk_size=chunk, overlap=overlap,
-        )
-        if want is None:
-            want = outputs
-        row["exact_vs_per_token"] = outputs == want
-        rows[label] = row
-        print(json.dumps({"mode": label, **{
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in row.items()
-        }}), flush=True)
+    # one sink for the whole sweep; per-mode isolation comes from
+    # run_mode's post-warmup reset_instruments(), so each mode's flush
+    # event carries that mode's histograms only
+    with attached_jsonl_sink(
+        args.telemetry_out, run_name="bench_serve"
+    ) as (tele_hub, tele_sink):
+        for mode_index, (label, chunk, overlap) in enumerate(
+            [("per_token", None, False)]
+            + [(f"fused_k{k}", k, True) for k in args.ks]
+        ):
+            try:
+                row, outputs = run_mode(
+                    model, params, workload, batch_size=args.batch_size,
+                    chunk_size=chunk, overlap=overlap,
+                )
+            finally:
+                if tele_sink is not None:
+                    # one flush event per mode: the JSONL carries the
+                    # latency histograms the one-line rows summarize
+                    tele_hub.flush(step=mode_index)
+            if want is None:
+                want = outputs
+            row["exact_vs_per_token"] = outputs == want
+            rows[label] = row
+            print(json.dumps({"mode": label, **{
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in row.items()
+            }}), flush=True)
 
     base = rows["per_token"]
     fused = [r for name, r in rows.items() if name != "per_token"]

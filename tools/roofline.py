@@ -275,7 +275,7 @@ def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True,
     tokens = batch * seq
     active = dense_params + expert_params * topk / n_experts
     attn_f = 6 * n_attn * heads * hd * seq
-    # bench.py _gdn_flops_per_token convention (fwd+bwd ~ 3x)
+    # telemetry/flops.py gdn_flops_per_token convention (fwd+bwd ~ 3x)
     gdn_f = 3 * n_gdn * heads * (
         4 * chunk * hd + 3 * chunk * hd + 6 * hd * hd
     )
@@ -306,7 +306,7 @@ def _gdn_layer(inv, n, h, qk_heads, v_heads, dk, dv, dtype_b, passes,
     )
     conv_ch = qk_heads * dk * 2 + v_heads * dv
     inv.add("gdn.conv", bytes_=passes * dtype_b * n * conv_ch * 2)
-    # chunked delta rule per head per token (bench.py _gdn_flops_per_token
+    # chunked delta rule per head per token (telemetry/flops.py gdn_flops_per_token
     # inventory), fp32 -> x2 FLOPs-equivalent on the bf16 roofline
     per_tok = v_heads * (4 * chunk * dk + 3 * chunk * dv + 6 * dk * dv)
     inv.add(

@@ -15,9 +15,10 @@ Two attribution tables ride the repo's own instrumentation
 (core/tracing.py — VERDICT r3 item 3, the ``record_function`` analogue):
 
 - **host regions**: TraceAnnotation events named ``pp.*`` (one per pipeline
-  action, by kind/stage/microbatch), ``pp_opt.*`` (optimizer phases) and
-  ``loop.*`` (batch staging), collapsed over stage/microbatch — shows where
-  the single-controller dispatch loop spends host time;
+  action, by kind/stage/microbatch), ``pp_opt.*`` (optimizer phases),
+  ``loop.*`` (batch staging) and ``serve.*`` (continuous-batching dispatch /
+  readback / admission, loop/serve.py), collapsed over stage/microbatch —
+  shows where the single-controller dispatch loop spends host time;
 - **device scopes**: device ops whose HLO metadata carries a
   ``jax.named_scope`` path (``pp_s0/fwd``, ``ep/dispatch_a2a``,
   ``train/optimizer``, …), grouped by the leading path components.
@@ -80,7 +81,7 @@ def load_events(run_dir: str):
     return events, processes, threads
 
 
-REGION_PREFIXES = ("pp.", "pp_opt.", "loop.")
+REGION_PREFIXES = ("pp.", "pp_opt.", "loop.", "serve.")
 _MB_SUFFIX = re.compile(r"\.s\d+\.mb\d+$|\.mb\d+$")
 # named-scope paths as stamped by this repo's instrumentation; matched
 # anywhere in the op metadata because JAX prepends jit(<fn>)/ components
@@ -224,8 +225,8 @@ def main():
         ):
             print(f"{tot/1e3:>10.3f}  {cnt:>6}  {tot/cnt/1e3:>9.4f}  {label}")
     else:
-        print("\n(no pp./pp_opt./loop. trace-annotation regions in this "
-              "trace — capture with set_trace_annotations(True) or via "
+        print("\n(no pp./pp_opt./loop./serve. trace-annotation regions in "
+              "this trace — capture with set_trace_annotations(True) or via "
               "JobProfiler)")
 
 
