@@ -153,3 +153,28 @@ def unshard_tree(shards: list[PyTree], spec: PyTree) -> PyTree:
         else:
             raise TypeError(f"unknown sharding spec leaf: {s!r}")
     return jax.tree.unflatten(spec_treedef, merged)
+
+
+def replicate_uncommitted(tree: PyTree, mesh) -> PyTree:
+    """Pin every *uncommitted* (single-default-device) array leaf to a
+    mesh-replicated NamedSharding; committed/sharded leaves pass through.
+
+    A ``jax.jit`` output that no input sharding constrains (e.g. a fresh
+    optimizer step counter) comes back uncommitted on the default
+    device. The live step tolerates that — jit relocates uncommitted
+    operands freely — but the placement round-trips through a checkpoint
+    as a *committed* single-device array, which then conflicts with the
+    mesh-placed parameters at the first post-restore step. Normalizing
+    at init keeps the job state's placement stable across
+    save/restore (docs/design/resilience.md, checkpoint fallback).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def fix(x):
+        if isinstance(x, jax.Array) and not x.committed:
+            return jax.device_put(x, replicated)
+        return x
+
+    return jax.tree.map(fix, tree)

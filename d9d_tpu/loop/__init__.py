@@ -1,6 +1,10 @@
 from d9d_tpu.loop.components.batch_maths import BatchMaths
 from d9d_tpu.loop.components.checkpointer import StateCheckpointer
-from d9d_tpu.loop.components.data_loader import StatefulDataLoader, default_collate
+from d9d_tpu.loop.components.data_loader import (
+    DataFetchError,
+    StatefulDataLoader,
+    default_collate,
+)
 from d9d_tpu.loop.components.garbage_collector import ManualGarbageCollector
 from d9d_tpu.loop.components.job_profiler import JobProfiler
 from d9d_tpu.loop.components.stepper import StepActionPeriod, Stepper
@@ -15,7 +19,11 @@ from d9d_tpu.loop.control.providers import (
 from d9d_tpu.loop.control.task import PipelineTrainTask, TrainTask
 from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.generate import generate
-from d9d_tpu.loop.serve import ContinuousBatcher
+from d9d_tpu.loop.serve import (
+    ContinuousBatcher,
+    QueueFullError,
+    ServeStalledError,
+)
 from d9d_tpu.loop.speculative import speculative_generate
 from d9d_tpu.loop.inference import (
     Inference,
@@ -38,6 +46,7 @@ __all__ = [
     "PipelineInferenceTask",
     "PipelineTrainTask",
     "StateCheckpointer",
+    "DataFetchError",
     "StatefulDataLoader",
     "default_collate",
     "ManualGarbageCollector",
@@ -61,5 +70,7 @@ __all__ = [
     "build_train_step",
     "generate",
     "ContinuousBatcher",
+    "QueueFullError",
+    "ServeStalledError",
     "speculative_generate",
 ]

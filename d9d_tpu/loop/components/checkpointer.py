@@ -7,6 +7,16 @@ scalars (stepper, dataloader position, tracker run hash, task state) ride
 a JSON item. Directory layout mirrors the reference contract (orbax
 spelling): ``{dir}/save_{step}/`` with ``num_to_keep`` rotation and
 resume = latest.
+
+Integrity (docs/design/resilience.md): every finalized step directory
+gets a ``d9d_manifest.json`` — content checksums over the meta item and
+small index files plus a size inventory of the array files — written
+*after* the step's data is durable (at the next async barrier).
+``restore()`` validates the newest step against its manifest and walks
+back through the rotation history to the newest step that both
+validates and restores, instead of crashing on a truncated directory —
+covering the machine-died-mid-async-save case the finalize rename alone
+cannot.
 """
 
 import logging
@@ -17,6 +27,11 @@ import jax
 import orbax.checkpoint as ocp
 
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.resilience.manifest import (
+    CheckpointIntegrityError,
+    validate_checkpoint_dir,
+    write_manifest,
+)
 from d9d_tpu.telemetry import get_telemetry
 
 logger = logging.getLogger("d9d_tpu.checkpointer")
@@ -37,6 +52,12 @@ class StateCheckpointer:
         self.directory = Path(directory).absolute()
         self.save_every_steps = save_every_steps
         self.async_save = async_save
+        # steps saved but whose manifest is not yet written (async saves
+        # may still be writing array files in the background); and the
+        # most recent step handed to save() — lets the trainer's
+        # emergency/final save skip a duplicate same-step save
+        self._manifest_pending: set[int] = set()
+        self.last_saved_step: int | None = None
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -47,6 +68,40 @@ class StateCheckpointer:
             ),
             item_names=(_ARRAYS, _META),
         )
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / f"save_{step}"
+
+    def _finalize_manifests(self) -> None:
+        """Write manifests for every pending step whose directory has
+        been finalized (tmp → rename done). Call only behind a barrier
+        or where orbax guarantees prior saves completed.
+
+        Multi-host: the checkpoint directory is shared storage, so only
+        the primary process writes manifests (concurrent writers would
+        race the identical tmp path and install a torn file — which
+        validation would then reject as corruption on an intact step).
+        """
+        if jax.process_index() != 0:
+            self._manifest_pending.clear()
+            return
+        for step in sorted(self._manifest_pending):
+            step_dir = self._step_dir(step)
+            if not step_dir.is_dir():
+                # rotated away before its manifest barrier, or the save
+                # never finalized — either way nothing to describe
+                self._manifest_pending.discard(step)
+                continue
+            try:
+                write_manifest(step_dir, step=step)
+            except OSError as e:
+                # racing the rotation delete of an old step: the step is
+                # gone (or going); an unmanifested step still restores
+                # through the unverified path
+                logger.warning(
+                    "could not write manifest for step %d: %s", step, e
+                )
+            self._manifest_pending.discard(step)
 
     # -- save ----------------------------------------------------------
 
@@ -74,6 +129,8 @@ class StateCheckpointer:
                     }
                 ),
             )
+            self.last_saved_step = step
+            self._manifest_pending.add(step)
             # async mode: orbax has already snapshotted the device arrays to
             # host (so the train step's donated buffers can't race the save);
             # the disk write continues in the background and the next save /
@@ -81,11 +138,20 @@ class StateCheckpointer:
             # barrier for callers that need the files on disk on return.
             if not self.async_save:
                 self._mgr.wait_until_finished()
+                self._finalize_manifests()
+            else:
+                # entering save() means orbax just waited for any PRIOR
+                # in-flight save — earlier steps are finalized and may
+                # take their manifests now (this step's stays pending)
+                self._manifest_pending.discard(step)
+                self._finalize_manifests()
+                self._manifest_pending.add(step)
 
     def wait_until_finished(self) -> None:
         """Block until any in-flight background save hits disk."""
         with get_telemetry().span("io/checkpoint_wait"):
             self._mgr.wait_until_finished()
+        self._finalize_manifests()
 
     # -- load ----------------------------------------------------------
 
@@ -95,20 +161,12 @@ class StateCheckpointer:
         # (verified against orbax 0.11 source) — barrier here so callers
         # never see (or race) a step whose directory is still a tmp path
         if self.async_save:
-            self._mgr.wait_until_finished()
+            self.wait_until_finished()
         return self._mgr.latest_step()
 
-    def restore(
-        self, abstract_arrays: PyTree, step: int | None = None
-    ) -> tuple[int, PyTree, dict[str, Any]] | None:
-        """Restore (step, arrays, meta); arrays land with the shardings of
-        ``abstract_arrays`` (pass the live state — jax.eval_shape-style
-        ShapeDtypeStructs with shardings also work)."""
-        if self.async_save:
-            self._mgr.wait_until_finished()  # see latest_step
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
+    def _restore_one(
+        self, step: int, abstract_arrays: PyTree
+    ) -> tuple[int, PyTree, dict[str, Any]]:
         with get_telemetry().span("io/checkpoint_restore", step=step):
             abstract = jax.tree.map(
                 ocp.utils.to_shape_dtype_struct, abstract_arrays
@@ -124,5 +182,94 @@ class StateCheckpointer:
             )
         return step, restored[_ARRAYS], restored[_META]
 
+    def restore(
+        self, abstract_arrays: PyTree, step: int | None = None
+    ) -> tuple[int, PyTree, dict[str, Any]] | None:
+        """Restore (step, arrays, meta); arrays land with the shardings of
+        ``abstract_arrays`` (pass the live state — jax.eval_shape-style
+        ShapeDtypeStructs with shardings also work).
+
+        With ``step=None`` (resume-latest), candidate steps are tried
+        newest-first: each must pass manifest validation (steps without
+        a manifest are attempted unverified) and actually restore;
+        corrupt or truncated steps are logged, counted in
+        ``resilience/checkpoint_fallback`` telemetry, and skipped —
+        manifest-CONFIRMED corrupt steps newer than the restored one are
+        then pruned from the rotation. Returns None only when no steps
+        exist at all; raises when checkpoints exist but none restores
+        (silently training from scratch would be quiet data loss). An
+        explicit ``step`` keeps strict semantics: validation/restore
+        errors raise.
+        """
+        if self.async_save:
+            self._mgr.wait_until_finished()
+        self._finalize_manifests()
+        if step is not None:
+            validate_checkpoint_dir(self._step_dir(step))
+            result = self._restore_one(step, abstract_arrays)
+            self.last_saved_step = None  # the save timeline restarts here
+            return result
+
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        confirmed_corrupt: list[int] = []
+        last_error: Exception | None = None
+        for s in candidates:
+            try:
+                verified = validate_checkpoint_dir(self._step_dir(s))
+                if not verified:
+                    logger.warning(
+                        "checkpoint step %d has no integrity manifest; "
+                        "attempting unverified restore", s,
+                    )
+                result = self._restore_one(s, abstract_arrays)
+            except Exception as e:  # noqa: BLE001 — classified below
+                get_telemetry().counter(
+                    "resilience/checkpoint_fallback"
+                ).add(1)
+                logger.error(
+                    "checkpoint step %d is not restorable (%s: %s); "
+                    "falling back to the previous rotation entry",
+                    s, type(e).__name__, e,
+                )
+                # only a manifest-confirmed corruption may be pruned
+                # later; a transient restore failure (storage blip,
+                # momentary OOM) must never cost an intact checkpoint
+                if isinstance(e, CheckpointIntegrityError):
+                    confirmed_corrupt.append(s)
+                last_error = e
+                continue
+            # restored by walking back: drop the CONFIRMED-corrupt newer
+            # steps so (a) training replayed past them can re-save at
+            # the same step numbers and (b) they can never shadow this
+            # intact step as rotation's "latest" again; and forget the
+            # same-step save guard — it described the abandoned timeline
+            # primary-only on shared storage: concurrent deleters (or a
+            # deleter racing another process's validation pass) must not
+            # turn a coordinated walk-back into divergent restores
+            if jax.process_index() == 0:
+                for dead in confirmed_corrupt:
+                    try:
+                        self._mgr.delete(dead)
+                    except Exception as e:  # noqa: BLE001 — best effort
+                        logger.warning(
+                            "could not prune corrupt checkpoint step "
+                            "%d: %s", dead, e,
+                        )
+            self.last_saved_step = None
+            return result
+        if candidates:
+            # checkpoints exist but none restored: silently training
+            # from scratch (and eventually rotating the old run's data
+            # away) would be quiet data loss — fail for the operator
+            raise RuntimeError(
+                f"none of the checkpoint steps {candidates} could be "
+                "restored; refusing to silently start from scratch"
+            ) from last_error
+        return None
+
     def close(self) -> None:
+        # flush any in-flight save AND its pending integrity manifest —
+        # a direct save()+close() user must not leave the newest step
+        # permanently unverified
+        self.wait_until_finished()
         self._mgr.close()

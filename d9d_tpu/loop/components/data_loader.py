@@ -25,6 +25,20 @@ def default_collate(items: Sequence[PyTree]) -> PyTree:
     return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *items)
 
 
+class DataFetchError(RuntimeError):
+    """A batch fetch failed after exhausting its retry budget.
+
+    Carries the failing position so the consumer-side re-raise (possibly
+    on the other end of a prefetch queue) names exactly which batch died
+    instead of surfacing a bare timeout.
+    """
+
+    def __init__(self, message: str, *, epoch: int, batch_index: int):
+        super().__init__(message)
+        self.epoch = epoch
+        self.batch_index = batch_index
+
+
 class StatefulDataLoader:
     """Map-style dataset → batch iterator with exact-resume state.
 
@@ -43,9 +57,14 @@ class StatefulDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         num_epochs: int | None = 1,
+        retry_attempts: int = 0,
+        retry_backoff_s: float = 0.05,
+        retry_max_backoff_s: float = 5.0,
     ):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if retry_attempts < 0:
+            raise ValueError("retry_attempts must be >= 0")
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn
@@ -53,8 +72,41 @@ class StatefulDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.num_epochs = num_epochs
+        # transient-fetch resilience (docs/design/resilience.md): each
+        # batch fetch retries up to retry_attempts times with capped
+        # exponential backoff before failing the run with a
+        # DataFetchError naming the epoch/batch position
+        self.retry_attempts = retry_attempts
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_max_backoff_s = retry_max_backoff_s
         self._epoch = 0
         self._batch_index = 0
+
+    def _fetch_batch(self, idxs: np.ndarray, b: int) -> PyTree:
+        """One batch fetch + collate with capped-exponential retry.
+        Retries restart the whole batch (a flaky source may fail partway
+        through the item list) and count into ``io/data_retries``."""
+        attempt = 0
+        while True:
+            try:
+                items = [self.dataset[int(i)] for i in idxs]
+                return self.collate_fn(items)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt >= self.retry_attempts:
+                    raise DataFetchError(
+                        f"batch fetch failed at epoch {self._epoch} batch "
+                        f"{b} after {attempt + 1} attempt(s): "
+                        f"{type(e).__name__}: {e}",
+                        epoch=self._epoch,
+                        batch_index=b,
+                    ) from e
+                delay = min(
+                    self.retry_backoff_s * (2.0 ** attempt),
+                    self.retry_max_backoff_s,
+                )
+                get_telemetry().counter("io/data_retries").add(1)
+                attempt += 1
+                time.sleep(delay)
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -79,8 +131,7 @@ class StatefulDataLoader:
                 b = self._batch_index
                 t_fetch = time.perf_counter()
                 idxs = order[b * self.batch_size : (b + 1) * self.batch_size]
-                items = [self.dataset[int(i)] for i in idxs]
-                batch = self.collate_fn(items)
+                batch = self._fetch_batch(idxs, b)
                 # io/* telemetry: the producer-side fetch+collate cost —
                 # distinct from the trainer's train/phase/data_wait, which
                 # only sees this when prefetch is off or falls behind

@@ -30,6 +30,7 @@ from typing import Any
 
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
+from d9d_tpu.telemetry import get_telemetry
 
 __all__ = ["BatchPrefetcher"]
 
@@ -103,6 +104,7 @@ class BatchPrefetcher:
                 if not self._put(("batch", staged, pos)):
                     return
         except BaseException as e:  # noqa: BLE001 — reraised in consumer
+            get_telemetry().counter("io/prefetch_errors").add(1)
             self._put(("error", e, None))
 
     # -- consumer ------------------------------------------------------
@@ -111,11 +113,37 @@ class BatchPrefetcher:
         return self
 
     def __next__(self) -> PyTree:
-        item = self._q.get()
+        # bounded waits + liveness checks: a producer thread that dies
+        # without delivering its sentinel (injected crash, interpreter
+        # teardown racing a worker) must surface as an immediate,
+        # explanatory error — not an unbounded q.get() hang
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # the producer may have enqueued its final item (a
+                    # real error, end-of-data) and exited between our
+                    # timeout and this liveness check — drain once more
+                    # before declaring a silent death, or the generic
+                    # message would shadow the real diagnostic
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    raise RuntimeError(
+                        "prefetch producer thread died without delivering "
+                        "a batch, an error, or end-of-data (last consumed "
+                        f"position: {self.consumed_position})"
+                    ) from None
         if item is _DONE:
             raise StopIteration
         kind, payload, pos = item
         if kind == "error":
+            # the producer's exception travels intact (DataFetchError
+            # carries the failing epoch/batch position in its message)
             raise payload
         if self._finish_fn is not None:
             payload = self._finish_fn(payload)

@@ -5,8 +5,12 @@ timeouts (generous at init, tight per-step) so a hung collective kills the
 job fast instead of burning a pod for hours. JAX has no per-collective
 timeout knob, so the TPU equivalent is a host watchdog: the trainer pets it
 at every step boundary; if no heartbeat arrives within the active window
-the watchdog dumps all Python stacks and hard-exits, letting the job
-scheduler restart-and-resume (the reference's recovery model).
+the watchdog flushes the telemetry sinks (with a final
+``resilience/watchdog_timeout`` event, so the JSONL log explains the
+death), dumps all Python stacks and hard-exits with a configurable,
+documented exit code (docs/design/resilience.md exit-code contract),
+letting the job scheduler restart-and-resume (the reference's recovery
+model).
 """
 
 import faulthandler
@@ -15,6 +19,8 @@ import os
 import sys
 import threading
 import time
+
+from d9d_tpu.telemetry import get_telemetry
 
 logger = logging.getLogger("d9d_tpu.timeout")
 
@@ -25,9 +31,11 @@ class TimeoutManager:
         *,
         init_timeout_s: float | None = None,
         step_timeout_s: float | None = None,
+        exit_code: int = 42,
     ):
         self.init_timeout_s = init_timeout_s
         self.step_timeout_s = step_timeout_s
+        self.exit_code = exit_code
         self._deadline: float | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -49,16 +57,45 @@ class TimeoutManager:
     def disarm(self) -> None:
         self._arm(None)
 
+    def _flush_telemetry(self) -> None:
+        """Best-effort: a final watchdog_timeout event + sink flush so
+        the on-disk JSONL records *why* the process died. The main
+        thread is wedged (that is why we are here), so only the host-
+        side registry/sinks are touched — never the device."""
+        try:
+            tele = get_telemetry()
+            tele.counter("resilience/watchdog_timeout").add(1)
+            # spans stream to the JSONL sink as they complete: this is
+            # the "final event" an operator greps for post-mortem
+            tele.registry.record_span(
+                "resilience/watchdog_timeout",
+                time.perf_counter(),
+                0.0,
+                meta={"exit_code": self.exit_code},
+            )
+            tele.flush(tele.registry.current_step)
+        except Exception:  # noqa: BLE001 — never block the hard exit
+            logger.exception("telemetry flush failed during watchdog exit")
+
     def _watch(self) -> None:
         while not self._stop.wait(1.0):
             with self._lock:
                 deadline = self._deadline
             if deadline is not None and time.monotonic() > deadline:
                 logger.critical(
-                    "watchdog timeout: no step heartbeat — dumping stacks and exiting"
+                    "watchdog timeout: no step heartbeat — dumping stacks "
+                    "and exiting with code %d", self.exit_code,
                 )
+                # the flush itself may block (a hung storage mount is a
+                # classic cause of the missed heartbeat): bound it with a
+                # helper thread so the guaranteed-exit contract holds
+                flusher = threading.Thread(
+                    target=self._flush_telemetry, daemon=True
+                )
+                flusher.start()
+                flusher.join(timeout=5.0)
                 faulthandler.dump_traceback(file=sys.stderr)
-                os._exit(42)
+                os._exit(self.exit_code)
 
     def __enter__(self):
         if self.init_timeout_s is not None or self.step_timeout_s is not None:

@@ -4,6 +4,8 @@ Flat pydantic config; sub-knob groups (checkpoint/profile/watchdog/gc)
 default to off so the minimum slice stays one-screen simple.
 """
 
+from typing import Literal
+
 import pydantic
 
 from d9d_tpu.pipelining.factory import PipelineScheduleConfig
@@ -42,9 +44,33 @@ class TrainerConfig(pydantic.BaseModel):
     profile_active_steps: int = 3
     profile_wait_steps: int = 10
 
-    # hang watchdog (reference component/timeout_manager.py:15)
+    # hang watchdog (reference component/timeout_manager.py:15); the exit
+    # code distinguishes a watchdog kill from a crash for the scheduler
+    # (docs/design/resilience.md exit-code contract)
     init_timeout_s: float | None = None
     step_timeout_s: float | None = None
+    watchdog_exit_code: int = 42
+
+    # resilience (docs/design/resilience.md): step anomaly guard.
+    # None = guard compiled out entirely (seed behavior). "warn" flags
+    # non-finite steps, "skip_step" additionally freezes params and
+    # optimizer moments for anomalous steps in-device, "rollback"
+    # restores the newest intact checkpoint after `anomaly_rollback_after`
+    # consecutive anomalies (device streak or host loss-spike streak)
+    anomaly_policy: Literal["warn", "skip_step", "rollback"] | None = None
+    anomaly_rollback_after: int = pydantic.Field(default=3, ge=1)
+    # host-side loss-spike detector: loss > factor x rolling-window
+    # median counts as an anomaly; None disables spike detection
+    anomaly_spike_factor: float | None = pydantic.Field(default=10.0, gt=1.0)
+    anomaly_spike_window: int = pydantic.Field(default=32, ge=4)
+    # consecutive rollbacks before giving up (a fault that survives the
+    # restore is not transient; keep restarting forever helps nobody)
+    anomaly_max_rollbacks: int = pydantic.Field(default=3, ge=1)
+
+    # preemption-safe exit: SIGTERM/SIGINT → flag → step-boundary
+    # emergency synchronous checkpoint → TrainingPreempted(exit_code)
+    handle_preemption: bool = True
+    preemption_exit_code: int = 83
 
     # manual GC (reference component/garbage_collector.py:13)
     gc_every_steps: int | None = 100

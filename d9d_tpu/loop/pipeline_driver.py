@@ -191,12 +191,22 @@ class PipelineTrainEngine:
         max_grad_norm: float | None = 1.0,
         grad_dtype=jnp.float32,
         peft_method=None,
+        anomaly_policy: str | None = None,
     ):
         if not isinstance(task, PipelineTrainTask):
             raise TypeError(
                 "pipeline parallelism needs a PipelineTrainTask (the task "
                 "defines the stage carry decomposition); got "
                 f"{type(task).__name__}"
+            )
+        from d9d_tpu.resilience.anomaly import ANOMALY_POLICIES
+
+        if anomaly_policy is not None and anomaly_policy not in ANOMALY_POLICIES:
+            # same check as build_train_step: a typo must not silently
+            # downgrade freeze protection to warn-only
+            raise ValueError(
+                f"anomaly_policy must be one of {ANOMALY_POLICIES} or "
+                f"None, got {anomaly_policy!r}"
             )
         self.ctx = ctx
         self.task = task
@@ -256,6 +266,7 @@ class PipelineTrainEngine:
             train=True,
         )
         self._eval_executor = None
+        self.anomaly_policy = anomaly_policy
         self.optimizer = PipelinedOptimizer(
             optimizer=optimizer,
             scalar_shardings={
@@ -263,9 +274,17 @@ class PipelineTrainEngine:
                 for s in range(self.num_stages)
             },
             max_grad_norm=max_grad_norm,
+            anomaly_freeze=anomaly_policy in ("skip_step", "rollback"),
         )
         self.opt_states = self.optimizer.init(
             {s: rt.params for s, rt in self.stages.items()}
+        )
+        # anomaly-guard device carry ([streak, total] on the last stage);
+        # None when the guard is off
+        self._guard_state = (
+            self.optimizer.init_guard_state()
+            if anomaly_policy is not None
+            else None
         )
         logger.info(
             "pipeline engine: %d stages over pp=%d (%s), %d microbatches",
@@ -314,9 +333,17 @@ class PipelineTrainEngine:
         """One optimizer step over the microbatch list → device metrics."""
         result = self.executor.step(microbatches)
         params = {s: rt.params for s, rt in self.stages.items()}
-        new_params, self.opt_states, grad_norm = self.optimizer.step(
-            params, self.opt_states, result.grads, result.weight_sum
-        )
+        guard_metrics = {}
+        if self.anomaly_policy is not None:
+            (new_params, self.opt_states, grad_norm, guard_metrics,
+             self._guard_state) = self.optimizer.step_guarded(
+                params, self.opt_states, result.grads, result.weight_sum,
+                result.loss_sum, self._guard_state,
+            )
+        else:
+            new_params, self.opt_states, grad_norm = self.optimizer.step(
+                params, self.opt_states, result.grads, result.weight_sum
+            )
         for s, rt in self.stages.items():
             rt.params = new_params[s]
         with compat.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
@@ -326,8 +353,14 @@ class PipelineTrainEngine:
             "loss": loss,
             "grad_norm": grad_norm,
             "loss_weight": result.weight_sum,
+            **guard_metrics,
             **{f"task/{k}": v for k, v in result.metrics.items()},
         }
+
+    def reset_guard(self) -> None:
+        """Zero the anomaly carry (trainer rollback path)."""
+        if self.anomaly_policy is not None:
+            self._guard_state = self.optimizer.init_guard_state()
 
     # -- state surface for checkpoint/export ---------------------------
 

@@ -66,9 +66,11 @@ regions carry ``serve.*`` ``core/tracing.annotate`` labels inside
 profiler capture windows (``tools/trace_summary.py`` groups them).
 """
 
+import _thread
 import collections
 import dataclasses
 import inspect
+import threading
 import time
 import weakref
 from typing import Optional, Sequence
@@ -85,6 +87,21 @@ from d9d_tpu.telemetry import get_telemetry
 _UTIL_EDGES = tuple(i / 20 for i in range(21))
 
 
+class QueueFullError(RuntimeError):
+    """``submit()`` rejected: the bounded admission queue is full.
+
+    Degraded-mode backpressure (docs/design/resilience.md): an overload
+    becomes an explicit, retryable rejection the caller can shed or
+    redirect — not an unbounded host-memory queue that dies later.
+    """
+
+
+class ServeStalledError(RuntimeError):
+    """``drain()`` aborted by the stall watchdog: no dispatch/readback
+    progress within ``stall_timeout_s`` while work was outstanding —
+    a wedged device/runtime surfaces as an error, not a silent hang."""
+
+
 @dataclasses.dataclass
 class _Slot:
     rid: int = -1            # active request id, -1 = idle
@@ -95,6 +112,7 @@ class _Slot:
     feed: list = dataclasses.field(default_factory=list)
     emitted: int = 0         # committed (harvested) emissions
     budget: int = 0          # max_new_tokens for the active request
+    deadline_t: float | None = None  # absolute perf_counter deadline
 
 
 @dataclasses.dataclass
@@ -102,6 +120,7 @@ class _Request:
     rid: int
     prompt: list
     max_new_tokens: int
+    deadline_t: float | None = None
 
 
 @dataclasses.dataclass
@@ -175,6 +194,10 @@ class ServeStats:
     emitted_tokens: int = 0
     slot_steps_busy: int = 0
     slot_steps_total: int = 0
+    # degraded-mode counters: submits rejected by the bounded queue,
+    # requests expired by their deadline (queued or running)
+    rejected: int = 0
+    expired: int = 0
 
     def reset(self) -> None:
         for f in dataclasses.fields(self):
@@ -246,11 +269,27 @@ class ContinuousBatcher:
         chunk_size: Optional[int] = 8,
         overlap: bool = True,
         telemetry=None,
+        max_queue: Optional[int] = None,
+        stall_timeout_s: Optional[float] = None,
     ):
+        """Degraded-mode knobs (docs/design/resilience.md): ``max_queue``
+        bounds the admission queue — ``submit()`` past it raises
+        :class:`QueueFullError` (explicit backpressure). Requests may
+        carry per-request deadlines (``submit(..., deadline_s=...)``)
+        that expire them cleanly whether queued or running.
+        ``stall_timeout_s`` arms a drain watchdog: no host
+        dispatch/readback progress for that long with work outstanding
+        raises :class:`ServeStalledError` instead of hanging."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
         if chunk_size is not None and chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if stall_timeout_s is not None and stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0, got {stall_timeout_s}"
+            )
         self._model = model
         self._params = params
         self._b = batch_size
@@ -269,6 +308,14 @@ class ContinuousBatcher:
         self._tokens = np.zeros((batch_size,), np.int32)  # legacy inputs
         self.outputs: dict[int, list[int]] = {}
         self.done: set[int] = set()
+        # degraded-mode state: rid → failure reason ("deadline") for
+        # requests retired without completing; done includes them so
+        # drain() terminates and harvests skip their rows
+        self.failed: dict[int, str] = {}
+        self._max_queue = max_queue
+        self._stall_timeout_s = stall_timeout_s
+        self._progress_t = time.perf_counter()
+        self._stalled = False
         self.stats = ServeStats()
         # per-request latency telemetry (serve/* namespace): recorded into
         # the process hub unless an isolated hub is injected
@@ -438,10 +485,22 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     def submit(
-        self, prompt: Sequence[int], *, max_new_tokens: int
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
     ) -> int:
         """Queue a request; returns its request id. Admission happens at
-        the next step/chunk boundary with a free slot."""
+        the next step/chunk boundary with a free slot.
+
+        ``deadline_s`` (relative, host clock) expires the request at the
+        next boundary after the deadline passes — whether it is still
+        queued or already decoding (partial output is kept, the request
+        lands in ``failed[rid] == "deadline"``). With ``max_queue``
+        configured, a full queue rejects with :class:`QueueFullError`
+        before a rid is allocated.
+        """
         prompt = [int(x) for x in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -449,19 +508,34 @@ class ContinuousBatcher:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         need = len(prompt) + max_new_tokens - 1
         if need > self._dml:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens}"
                 f" - 1 = {need} exceeds decode_max_length={self._dml}"
             )
+        now = time.perf_counter()
+        if self._max_queue is not None:
+            # count only live waiters: requests whose deadline already
+            # passed must not hold queue capacity against new traffic
+            self._expire_queued(now)
+            if len(self._queue) >= self._max_queue:
+                self.stats.rejected += 1
+                self._tele.counter("serve/rejected").add(1)
+                raise QueueFullError(
+                    f"admission queue full ({len(self._queue)} >= "
+                    f"max_queue={self._max_queue}); retry after drain"
+                )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        self._queue.append(_Request(
+            rid, prompt, max_new_tokens,
+            deadline_t=now + deadline_s if deadline_s is not None else None,
+        ))
         self.outputs[rid] = []
-        self.request_stats[rid] = RequestTelemetry(
-            submit_t=time.perf_counter()
-        )
+        self.request_stats[rid] = RequestTelemetry(submit_t=now)
         self._tele.gauge("serve/queued").set(len(self._queue))
         return rid
 
@@ -488,6 +562,7 @@ class ContinuousBatcher:
         self._finished_rids.clear()
         self.outputs.clear()
         self.done.clear()
+        self.failed.clear()
         now = time.perf_counter()
         self._rate_win_t0 = now
         self._rate_win_tokens = 0
@@ -517,16 +592,68 @@ class ContinuousBatcher:
         if tpot is not None:
             self._tele.histogram("serve/tpot_s").record(tpot)
         self._tele.counter("serve/requests_finished").add(1)
-        # bound the finished-request retention (FIFO) — stats record,
-        # output token list, and done flag together, so host memory stays
-        # flat however many requests a long-lived server processes; the
-        # aggregate histograms above already captured the latencies
+        self._retire(rid)
+
+    def _retire(self, rid: int) -> None:
+        # bound the finished/failed-request retention (FIFO) — stats
+        # record, output token list, done and failed flags together, so
+        # host memory stays flat however many requests a long-lived
+        # server processes; the aggregate histograms already captured
+        # the latencies
         self._finished_rids.append(rid)
         while len(self._finished_rids) > self._MAX_FINISHED_STATS:
             old = self._finished_rids.popleft()
             self.request_stats.pop(old, None)
             self.outputs.pop(old, None)
             self.done.discard(old)
+            self.failed.pop(old, None)
+
+    # -- degraded mode: deadlines (docs/design/resilience.md) ----------
+
+    def _fail(self, rid: int, reason: str, now: float) -> None:
+        self.failed[rid] = reason
+        self.done.add(rid)
+        self.stats.expired += 1
+        self._tele.counter("serve/expired").add(1)
+        rec = self.request_stats.get(rid)
+        if rec is not None and rec.finish_t is None:
+            rec.finish_t = now
+        self._retire(rid)
+
+    def _expire_queued(self, now: float) -> None:
+        """Drop queued requests whose deadline passed — an explicit
+        failure the caller can observe, not a silent never-ran."""
+        if not self._queue:
+            return
+        live = collections.deque()
+        for req in self._queue:
+            if req.deadline_t is not None and now >= req.deadline_t:
+                self._fail(req.rid, "deadline", now)
+            else:
+                live.append(req)
+        if len(live) != len(self._queue):
+            self._queue = live
+            self._tele.gauge("serve/queued").set(len(self._queue))
+
+    def _expire_running(self, now: float) -> np.ndarray:
+        """Evict running rows past their deadline at a boundary; returns
+        the evicted-row mask (legacy mode resets those cache rows; fused
+        mode leaves the device row decoding into the void until the slot
+        is reused — emissions for a done rid are dropped at harvest)."""
+        evict = np.zeros((self._b,), bool)
+        for i, slot in enumerate(self._slots):
+            if (
+                slot.rid < 0
+                or slot.deadline_t is None
+                or now < slot.deadline_t
+                or slot.rid in self.done
+            ):
+                continue
+            self._fail(slot.rid, "deadline", now)
+            self._slots[i] = _Slot()
+            self._tokens[i] = 0
+            evict[i] = True
+        return evict
 
     # rolling-window span for the live throughput gauge: long enough to
     # average over scheduling noise, short enough that a collapse shows
@@ -562,7 +689,9 @@ class ContinuousBatcher:
 
     def _admit_legacy(self):
         with annotate("serve.admit"):
-            reset_mask = np.zeros((self._b,), bool)
+            now = time.perf_counter()
+            self._expire_queued(now)
+            reset_mask = self._expire_running(now)
             for i, slot in enumerate(self._slots):
                 if slot.rid >= 0 or not self._queue:
                     continue
@@ -573,6 +702,7 @@ class ContinuousBatcher:
                     pos=0,
                     emitted=0,
                     budget=req.max_new_tokens,
+                    deadline_t=req.deadline_t,
                 )
                 self._tokens[i] = req.prompt[0]
                 reset_mask[i] = True
@@ -600,6 +730,7 @@ class ContinuousBatcher:
         with annotate("serve.readback"):
             nxt = np.asarray(nxt)
         now = time.perf_counter()
+        self._progress_t = now
         self.stats.host_dispatches += 1
         self.stats.readbacks += 1
         self.stats.device_steps += 1
@@ -663,6 +794,9 @@ class ContinuousBatcher:
         admit_budget = np.zeros((self._b,), np.int32)
         if admit:
             with annotate("serve.admit"):
+                now = time.perf_counter()
+                self._expire_queued(now)
+                self._expire_running(now)
                 for i, slot in enumerate(self._slots):
                     if slot.rid >= 0 or not self._queue:
                         continue
@@ -672,6 +806,7 @@ class ContinuousBatcher:
                         feed=list(req.prompt),
                         emitted=0,
                         budget=req.max_new_tokens,
+                        deadline_t=req.deadline_t,
                     )
                     admit_mask[i] = True
                     admit_budget[i] = req.max_new_tokens
@@ -721,6 +856,7 @@ class ContinuousBatcher:
         self.stats.host_dispatches += 1
         self.stats.chunks += 1
         self.stats.device_steps += k
+        self._progress_t = time.perf_counter()
 
     def _harvest_one(self) -> dict[int, list[int]]:
         """Fetch the oldest in-flight chunk (ONE readback) and replay the
@@ -729,6 +865,7 @@ class ContinuousBatcher:
         with annotate("serve.readback"):
             toks = np.asarray(toks_d)  # the single [B, K] readback
         now = time.perf_counter()
+        self._progress_t = now
         self.stats.readbacks += 1
         self.stats.slot_steps_total += self._b * plan.k
         chunk_busy = 0
@@ -839,7 +976,89 @@ class ContinuousBatcher:
         chunk's tokens are fetched, overlapping the host readback with
         device compute (XLA async dispatch). Admission needs an exact
         slot view, so a non-empty queue forces a synchronous boundary.
+
+        With ``stall_timeout_s`` set, a watchdog thread monitors
+        dispatch/readback progress and converts a wedge into
+        :class:`ServeStalledError`. (The interrupt lands between Python
+        bytecodes: it catches host-visible stalls — a retry loop, a
+        deadlocked lock, a sleeping fake — immediately; a readback
+        hard-wedged inside the runtime's C++ is additionally covered by
+        the process-level ``TimeoutManager`` watchdog.)
         """
+        if self._stall_timeout_s is None:
+            return self._drain_impl(max_steps)
+        if threading.current_thread() is not threading.main_thread():
+            # the watchdog interrupts via a signal to the MAIN thread; a
+            # drain on a worker thread cannot be safely interrupted that
+            # way (the exception would land in an unrelated thread)
+            import warnings
+
+            warnings.warn(
+                "serve stall watchdog disabled: drain() is not on the "
+                "main thread", stacklevel=2,
+            )
+            return self._drain_impl(max_steps)
+        self._stalled = False
+        self._progress_t = time.perf_counter()
+        stop = threading.Event()
+
+        main_ident = threading.main_thread().ident
+
+        def watch():
+            tick = min(0.05, self._stall_timeout_s / 4)
+            fired = 0
+            while not stop.wait(tick):
+                if self.stats.readbacks == 0:
+                    # nothing has ever round-tripped: the gap is almost
+                    # certainly first-call XLA compilation, which can
+                    # legitimately run minutes — interrupting it would
+                    # fail a healthy cold start (and land the signal
+                    # inside the compiler). A wedge this early is the
+                    # process-level TimeoutManager's job.
+                    continue
+                if (
+                    time.perf_counter() - self._progress_t
+                    > self._stall_timeout_s * (1 + fired)
+                ):
+                    if stop.is_set():  # drain just finished: stand down
+                        return
+                    self._stalled = True
+                    if fired == 0:
+                        self._tele.counter("serve/stalls").add(1)
+                    fired += 1
+                    try:
+                        # a real signal: wakes blocking C calls (sleeps,
+                        # waits) via EINTR, unlike interrupt_main's
+                        # between-bytecodes flag. Keep re-firing on a
+                        # backoff rather than one-shot: an embedder's
+                        # own SIGINT handler (graceful-shutdown servers,
+                        # PreemptionGuard) swallows the first delivery
+                        # without raising KeyboardInterrupt.
+                        import signal
+
+                        signal.pthread_kill(main_ident, signal.SIGINT)
+                    except (OSError, AttributeError, ValueError):
+                        _thread.interrupt_main()
+
+        watchdog = threading.Thread(
+            target=watch, name="d9d-serve-stall-watchdog", daemon=True
+        )
+        watchdog.start()
+        try:
+            return self._drain_impl(max_steps)
+        except KeyboardInterrupt:
+            if self._stalled:
+                raise ServeStalledError(
+                    f"serving drain made no dispatch/readback progress "
+                    f"for {self._stall_timeout_s}s with "
+                    f"{self.active} request(s) outstanding"
+                ) from None
+            raise
+        finally:
+            stop.set()
+            watchdog.join(timeout=1.0)
+
+    def _drain_impl(self, max_steps: int) -> dict[int, list[int]]:
         if self._k is None:
             steps = 0
             while self.active:

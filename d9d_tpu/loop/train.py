@@ -20,6 +20,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from d9d_tpu.core.mesh import MeshContext
 from d9d_tpu.core.offload import SleepTag, offload_tree, onload_tree
+from d9d_tpu.core.tree_sharding import replicate_uncommitted
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.loop import event as ev
 from d9d_tpu.loop.components.batch_maths import BatchMaths
@@ -45,6 +46,11 @@ from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.loop.train_step import build_eval_step, build_train_step
 from d9d_tpu.pipelining import PipelineStageInfo
+from d9d_tpu.resilience import (
+    HostAnomalyGuard,
+    PreemptionGuard,
+    TrainingPreempted,
+)
 from d9d_tpu.telemetry import (
     ConsoleSink,
     JsonlSink,
@@ -116,6 +122,7 @@ class Trainer:
                 init_rng=self.init_rng,
                 max_grad_norm=config.max_grad_norm,
                 peft_method=peft_method,
+                anomaly_policy=config.anomaly_policy,
             )
             self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
@@ -141,7 +148,14 @@ class Trainer:
                 self.task = task = PeftTask(task, peft_method, self.base_params)
             self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
 
-            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+            # normalize placement: a fresh jit(init) leaves constraint-free
+            # scalars (step counters) uncommitted on one device, which
+            # round-trips through a checkpoint as a committed placement
+            # that conflicts with the mesh-placed params at the first
+            # post-restore step (core/tree_sharding.replicate_uncommitted)
+            self.opt_state = replicate_uncommitted(
+                jax.jit(self.optimizer.init)(self.params), ctx.mesh
+            )
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
 
             self.step_fn = build_train_step(
@@ -150,6 +164,7 @@ class Trainer:
                 optimizer=self.optimizer,
                 num_microbatches=self.batch_maths.num_microbatches,
                 max_grad_norm=config.max_grad_norm,
+                anomaly_policy=config.anomaly_policy,
             )
 
         self.dataset_provider = dataset_provider
@@ -174,7 +189,22 @@ class Trainer:
         self.timeout = TimeoutManager(
             init_timeout_s=config.init_timeout_s,
             step_timeout_s=config.step_timeout_s,
+            exit_code=config.watchdog_exit_code,
         )
+        # resilience (docs/design/resilience.md): host half of the step
+        # anomaly guard + the preemption signal flag; both no-ops unless
+        # their config knobs enable them
+        self.anomaly_guard = (
+            HostAnomalyGuard(
+                policy=config.anomaly_policy,
+                rollback_after=config.anomaly_rollback_after,
+                spike_factor=config.anomaly_spike_factor,
+                spike_window=config.anomaly_spike_window,
+            )
+            if config.anomaly_policy is not None
+            else None
+        )
+        self.preemption = PreemptionGuard(enabled=config.handle_preemption)
         self.gc = ManualGarbageCollector(config.gc_every_steps)
         self.metric_collector = MetricCollector(self.task)
         self.run = None  # tracker run, opened in train()
@@ -301,7 +331,10 @@ class Trainer:
         if not self.checkpointer.should_checkpoint(step, last=last):
             return
         with self.events.bounded(ev.EVENT_CHECKPOINT, trainer=self, step=step):
-            self.checkpointer.save(step, self._job_arrays(), self._job_meta())
+            if self.checkpointer.last_saved_step != step:
+                self.checkpointer.save(
+                    step, self._job_arrays(), self._job_meta()
+                )
             if last:
                 # intermediate saves overlap training (async write-back);
                 # the FINAL one must be durable when train() returns — the
@@ -309,12 +342,15 @@ class Trainer:
                 # on the last step's checkpoint existing
                 self.checkpointer.wait_until_finished()
 
-    def _try_resume(self) -> None:
-        if self.checkpointer is None or not self.config.resume:
-            return
+    def _restore_state(self) -> int | None:
+        """Restore the newest intact checkpoint into the live job state
+        (arrays, stepper, loader position, tracker run); returns the
+        restored step or None. Shared by resume and anomaly rollback."""
+        if self.checkpointer is None:
+            return None
         restored = self.checkpointer.restore(self._job_arrays())
         if restored is None:
-            return
+            return None
         step, arrays, meta = restored
         if self.pp_engine is not None:
             self.pp_engine.load_job_arrays(arrays)
@@ -330,7 +366,23 @@ class Trainer:
             self.data_loader.load_state_dict(meta["data_loader"])
         if "tracker" in meta and self.run is not None:
             self.run.load_state_dict(meta["tracker"])
-        logger.info("resumed from checkpoint at step %d", step)
+        return step
+
+    def _try_resume(self) -> None:
+        if self.checkpointer is None or not self.config.resume:
+            return
+        step = self._restore_state()
+        if step is not None:
+            logger.info("resumed from checkpoint at step %d", step)
+
+    def _reset_guard_state(self) -> None:
+        """Zero both halves of the anomaly guard (post-rollback)."""
+        if self.anomaly_guard is not None:
+            self.anomaly_guard.reset()
+        if self.pp_engine is not None:
+            self.pp_engine.reset_guard()
+        elif self.step_fn is not None:
+            self.step_fn.reset_guard()
 
     # -- the loop ------------------------------------------------------
 
@@ -386,11 +438,12 @@ class Trainer:
                     stacklevel=2,
                 )
                 use_prefetch = False
-            if use_prefetch:
+            def spawn_prefetcher(batch_iter):
                 # producer thread runs fetch + prepare (+ device staging
                 # when that is collective-free) prefetch_batches ahead;
-                # must start AFTER _try_resume so it iterates from the
-                # restored loader position. Multi-process non-PP staging
+                # must start AFTER _try_resume (and restart after an
+                # anomaly rollback) so it iterates from the restored
+                # loader position. Multi-process non-PP staging
                 # device_puts onto multi-process shardings — a hidden
                 # collective — so it moves to the consumer thread
                 # (finish_fn); PP staging is host-only and stays in the
@@ -400,13 +453,18 @@ class Trainer:
                 else:
                     produce, finish = self._stage_batch, None
                 self._prefetcher = BatchPrefetcher(
-                    data_iter,
+                    batch_iter,
                     produce,
                     depth=self.config.prefetch_batches,
                     position_fn=getattr(self.data_loader, "position", None),
                     finish_fn=finish,
                 )
-            with self.timeout, self.gc:
+
+            if use_prefetch:
+                spawn_prefetcher(data_iter)
+            rollbacks = 0
+            last_rollback_trigger: int | None = None
+            with self.timeout, self.gc, self.preemption:
                 while not self.stepper.finished:
                     step = self.stepper.step
                     tele.set_step(step)
@@ -448,7 +506,22 @@ class Trainer:
                         jax.block_until_ready(metrics)
                     clock.mark("device_block")
                     self.timeout.set_periodic()
-                    if step % self.config.log_every == 0 or self.stepper.finished:
+                    guard_action = "ok"
+                    # the guard must also observe on checkpoint steps that
+                    # fall between log cadences — otherwise anomalous
+                    # state could be persisted unexamined (the metric
+                    # fetch this forces costs nothing extra: the save
+                    # itself snapshots device state anyway)
+                    will_save = (
+                        self.anomaly_guard is not None
+                        and self.checkpointer is not None
+                        and self.checkpointer.should_checkpoint(step)
+                    )
+                    if (
+                        step % self.config.log_every == 0
+                        or self.stepper.finished
+                        or will_save
+                    ):
                         # postprocess sees everything (it may derive scalars
                         # from vector stats, e.g. expert-load counts); only
                         # scalars survive into history/tracker — remaining
@@ -469,6 +542,14 @@ class Trainer:
                             self.metric_collector.flush(self.run, step)
                         )
                         host_metrics["step"] = step
+                        # anomaly guard, host half: the metrics are on
+                        # host anyway at this cadence, so inspecting the
+                        # device guard's flags (and the loss for spikes)
+                        # costs no extra sync (docs/design/resilience.md)
+                        if self.anomaly_guard is not None:
+                            guard_action = self.anomaly_guard.observe(
+                                step, host_metrics
+                            )
                         host_metrics["wall_s"] = time.perf_counter() - t0
                         # throughput from the batch-maths token count — live
                         # even before any telemetry sink is attached
@@ -505,7 +586,14 @@ class Trainer:
                         tele_sync_t0 = now
                         steps_since_sync = 0
                     clock.mark("metric_flush")
-                    self._save_checkpoint()
+                    if guard_action == "ok":
+                        # never persist state the guard flagged: under a
+                        # spike streak the params keep updating (finite
+                        # losses never trip the device freeze), so a
+                        # cadence save during "warn" steps would hand a
+                        # later rollback the exploded checkpoint it was
+                        # meant to discard
+                        self._save_checkpoint()
                     clock.mark("checkpoint")
                     clock.close()
                     tele.counter("train/tokens").add(self._tokens_per_step)
@@ -513,6 +601,78 @@ class Trainer:
                     if step % flush_every == 0 or self.stepper.finished:
                         tele.flush(step)
                         last_tele_flush = step
+                    if guard_action == "rollback":
+                        # "consecutive" semantics: progressing PAST the
+                        # previous rollback's trigger step means that
+                        # fault was cleared — a later, independent fault
+                        # starts a fresh streak instead of inheriting a
+                        # month of unrelated history
+                        if (
+                            last_rollback_trigger is not None
+                            and step > last_rollback_trigger
+                        ):
+                            rollbacks = 0
+                        last_rollback_trigger = step
+                        rollbacks += 1
+                        tele.counter("resilience/rollbacks").add(1)
+                        if rollbacks > self.config.anomaly_max_rollbacks:
+                            raise RuntimeError(
+                                "anomaly guard: rollback triggered "
+                                f"{rollbacks} times (anomaly_max_rollbacks="
+                                f"{self.config.anomaly_max_rollbacks}); the "
+                                "fault survives restores — failing fast"
+                            )
+                        # the producer thread must not race the restore's
+                        # loader-state mutation; rewinding makes its
+                        # run-ahead batches moot anyway
+                        if self._prefetcher is not None:
+                            self._prefetcher.close()
+                            self._prefetcher = None
+                        # a large restore can take longer than the tight
+                        # per-step watchdog window — recovery must not be
+                        # hard-killed as a hang
+                        self.timeout.disarm()
+                        restored_step = self._restore_state()
+                        self.timeout.set_periodic()
+                        self._reset_guard_state()
+                        if restored_step is None:
+                            logger.error(
+                                "anomaly rollback requested at step %d but "
+                                "no restorable checkpoint exists; continuing "
+                                "under skip/warn semantics (prefetched "
+                                "batches in flight were dropped)", step,
+                            )
+                        else:
+                            logger.warning(
+                                "anomaly rollback: restored step %d state "
+                                "(anomalies began before step %d)",
+                                restored_step, step,
+                            )
+                            data_iter = iter(self.data_loader)
+                        if use_prefetch:
+                            spawn_prefetcher(data_iter)
+                        continue
+                    if self.preemption.triggered:
+                        # step boundary reached with the flag set: write
+                        # the emergency checkpoint (synchronous — durable
+                        # before the raise) and exit with the documented
+                        # code; resume picks this checkpoint up unchanged
+                        logger.warning(
+                            "preemption: emergency checkpoint at step %d, "
+                            "exiting with code %d",
+                            step, self.config.preemption_exit_code,
+                        )
+                        tele.counter("resilience/preemptions").add(1)
+                        # the emergency save's durability barrier can
+                        # outlast the per-step watchdog window; exiting
+                        # with the watchdog code mid-save would waste the
+                        # preemption grace period
+                        self.timeout.disarm()
+                        self._save_checkpoint(last=True)
+                        raise TrainingPreempted(
+                            self.config.preemption_exit_code, step=step
+                        )
+                self.timeout.disarm()  # final durable save, same reason
                 self._save_checkpoint(last=True)
             self.events.emit(ev.EVENT_TRAIN_FINISHED, trainer=self)
         finally:

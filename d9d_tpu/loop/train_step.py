@@ -32,16 +32,39 @@ from jax import lax
 from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.resilience.anomaly import ANOMALY_POLICIES
 
 
 @dataclasses.dataclass
 class TrainStepFn:
-    """A compiled train step plus its metadata."""
+    """A compiled train step plus its metadata.
+
+    With the anomaly guard compiled in (``guarded``), the jitted function
+    additionally threads a tiny device-resident ``[streak, total]``
+    anomaly carry through every call — held here so callers keep the
+    4-argument step signature. The carry never visits the host: its
+    values surface through the step's metric dict, which the trainer
+    fetches on its ordinary log cadence.
+    """
 
     fn: Callable[..., tuple[PyTree, PyTree, dict[str, Any]]]
+    guarded: bool = False
+    guard_state: Any = None  # device int32[2]: [anomaly streak, total]
 
     def __call__(self, params, opt_state, batch, rng):
-        return self.fn(params, opt_state, batch, rng)
+        if not self.guarded:
+            return self.fn(params, opt_state, batch, rng)
+        if self.guard_state is None:
+            self.guard_state = jnp.zeros((2,), jnp.int32)
+        params, opt_state, metrics, self.guard_state = self.fn(
+            params, opt_state, batch, rng, self.guard_state
+        )
+        return params, opt_state, metrics
+
+    def reset_guard(self) -> None:
+        """Zero the anomaly carry (after a rollback restored state the
+        pre-rollback streak no longer describes)."""
+        self.guard_state = None
 
 
 def global_grad_norm(grads: PyTree) -> Array:
@@ -57,6 +80,7 @@ def build_train_step(
     max_grad_norm: float | None = 1.0,
     grad_dtype: jnp.dtype | None = jnp.float32,
     donate: bool = True,
+    anomaly_policy: str | None = None,
 ) -> TrainStepFn:
     """Build the jitted step.
 
@@ -64,7 +88,21 @@ def build_train_step(
     ``[num_microbatches, microbatch_size, ...]`` (the trainer reshapes).
     ``grad_dtype`` overrides the accumulation dtype (reference
     GradientManager's grad-dtype override, gradient_manager.py:16).
+
+    ``anomaly_policy`` compiles the step anomaly guard into the same XLA
+    program (docs/design/resilience.md): non-finite loss/grad-norm is
+    detected from the already-computed values — zero extra dispatches or
+    readbacks — and under ``skip_step``/``rollback`` the parameter and
+    optimizer-moment update is frozen for that step via an in-device
+    select (``warn`` applies the update and only flags). The metric dict
+    gains ``resilience/anomaly`` / ``anomaly_streak`` / ``anomaly_total``.
     """
+    if anomaly_policy is not None and anomaly_policy not in ANOMALY_POLICIES:
+        raise ValueError(
+            f"anomaly_policy must be one of {ANOMALY_POLICIES} or None, "
+            f"got {anomaly_policy!r}"
+        )
+    freeze_on_anomaly = anomaly_policy in ("skip_step", "rollback")
 
     def microbatch_grads(params, mb, rng):
         def scalar_loss(p):
@@ -77,7 +115,7 @@ def build_train_step(
             )(params)
         return loss_sum, weight, metrics, grads
 
-    def step(params, opt_state, batch, rng):
+    def step(params, opt_state, batch, rng, guard_state=None):
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, grad_dtype or p.dtype), params
         )
@@ -135,9 +173,11 @@ def build_train_step(
                 grads = jax.tree.map(
                     lambda g, p: g.astype(p.dtype), grads, params
                 )
-            updates, opt_state = optimizer.update(grads, opt_state, params)
+            updates, new_opt_state = optimizer.update(
+                grads, opt_state, params
+            )
             apply = getattr(optimizer, "apply_updates", optax.apply_updates)
-            params = apply(params, updates)
+            new_params = apply(params, updates)
 
         out_metrics = {
             "loss": loss,
@@ -145,10 +185,46 @@ def build_train_step(
             "loss_weight": weight_sum,
             **{f"task/{k}": v for k, v in metrics.items()},
         }
-        return params, opt_state, out_metrics
+        if anomaly_policy is None:
+            return new_params, new_opt_state, out_metrics
 
-    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
-    return TrainStepFn(fn=jitted)
+        # step anomaly guard (device half): both operands were already
+        # computed for the metric dict / clipping, so detection is free.
+        # A NaN/inf anywhere in the grads reaches grad_norm by
+        # construction (the global norm sums every leaf).
+        with jax.named_scope("train/anomaly_guard"):
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            if freeze_on_anomaly:
+                # freeze params AND optimizer moments for the step: a
+                # NaN that reached Adam's second moment would poison
+                # every later step despite finite grads
+                new_params = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    new_params, params,
+                )
+                new_opt_state = jax.tree.map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    new_opt_state, opt_state,
+                )
+            anomaly = jnp.logical_not(ok).astype(jnp.int32)
+            streak = jnp.where(ok, 0, guard_state[0] + 1)
+            total = guard_state[1] + anomaly
+            out_metrics["resilience/anomaly"] = anomaly.astype(jnp.float32)
+            out_metrics["resilience/anomaly_streak"] = streak.astype(
+                jnp.float32
+            )
+            out_metrics["resilience/anomaly_total"] = total.astype(
+                jnp.float32
+            )
+        return new_params, new_opt_state, out_metrics, jnp.stack(
+            [streak, total]
+        )
+
+    if anomaly_policy is None:
+        jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        return TrainStepFn(fn=jitted)
+    jitted = jax.jit(step, donate_argnums=(0, 1, 4) if donate else ())
+    return TrainStepFn(fn=jitted, guarded=True)
 
 
 def build_eval_step(

@@ -11,22 +11,32 @@ from d9d_tpu.lr_scheduler.config import (
     piecewise_scheduler_from_config,
 )
 from d9d_tpu.lr_scheduler.curves import (
+    CosineAnneal,
     CurveBase,
     CurveCosine,
     CurveExponential,
     CurveLinear,
     CurvePoly,
+    LinearInterp,
+    LogSpaceInterp,
+    PowerInterp,
+    ScheduleCurve,
 )
 from d9d_tpu.lr_scheduler.engine import PiecewiseScheduleEngine, SchedulePhase
 from d9d_tpu.lr_scheduler.visualizer import sample_schedule, visualize_schedule
 
 __all__ = [
     "AnyCurveConfig",
+    "CosineAnneal",
     "CurveBase",
     "CurveCosine",
     "CurveExponential",
     "CurveLinear",
     "CurvePoly",
+    "LinearInterp",
+    "LogSpaceInterp",
+    "PowerInterp",
+    "ScheduleCurve",
     "PhaseConfig",
     "PiecewiseScheduleBuilder",
     "PiecewiseScheduleEngine",

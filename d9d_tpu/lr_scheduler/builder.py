@@ -10,7 +10,7 @@ learning-rate schedule, pluggable into any optax optimizer.
 from typing import Callable
 
 from d9d_tpu.core.types import Array
-from d9d_tpu.lr_scheduler.curves import CurveBase
+from d9d_tpu.lr_scheduler.curves import ScheduleCurve
 from d9d_tpu.lr_scheduler.engine import PiecewiseScheduleEngine, SchedulePhase
 
 Schedule = Callable[[int | Array], Array]
@@ -25,7 +25,7 @@ class PiecewiseScheduleBuilder:
         self._total_steps = total_steps
         self._cursor = (0, initial_multiplier)  # (step, multiplier)
 
-    def _push(self, steps: int, target: float, curve: CurveBase) -> None:
+    def _push(self, steps: int, target: float, curve: ScheduleCurve) -> None:
         at, value = self._cursor
         self._phases.append(
             SchedulePhase(
@@ -39,14 +39,14 @@ class PiecewiseScheduleBuilder:
         self._cursor = (at + steps, target)
 
     def for_steps(
-        self, steps: int, target_multiplier: float, curve: CurveBase
+        self, steps: int, target_multiplier: float, curve: ScheduleCurve
     ) -> "PiecewiseScheduleBuilder":
         """Add a phase lasting ``steps`` steps ending at ``target_multiplier``."""
         self._push(steps, target_multiplier, curve)
         return self
 
     def until_percentage(
-        self, p: float, target_multiplier: float, curve: CurveBase
+        self, p: float, target_multiplier: float, curve: ScheduleCurve
     ) -> "PiecewiseScheduleBuilder":
         """Add a phase ending at fraction ``p`` of total_steps."""
         if self._total_steps is None:
@@ -67,7 +67,7 @@ class PiecewiseScheduleBuilder:
         return self
 
     def fill_rest(
-        self, target_multiplier: float, curve: CurveBase
+        self, target_multiplier: float, curve: ScheduleCurve
     ) -> "PiecewiseScheduleBuilder":
         """Add a phase from the cursor to the end of training."""
         return self.until_percentage(1.0, target_multiplier, curve)

@@ -11,11 +11,11 @@ from pydantic import BaseModel, Field, PositiveInt
 
 from d9d_tpu.lr_scheduler.builder import Schedule, piecewise_schedule
 from d9d_tpu.lr_scheduler.curves import (
-    CurveBase,
-    CurveCosine,
-    CurveExponential,
-    CurveLinear,
-    CurvePoly,
+    CosineAnneal,
+    LinearInterp,
+    LogSpaceInterp,
+    PowerInterp,
+    ScheduleCurve,
 )
 
 
@@ -47,16 +47,16 @@ AnyCurveConfig = Annotated[
 ]
 
 
-def curve_from_config(config: AnyCurveConfig) -> CurveBase:
+def curve_from_config(config: AnyCurveConfig) -> ScheduleCurve:
     match config:
         case CurveLinearConfig():
-            return CurveLinear()
+            return LinearInterp()
         case CurvePolyConfig():
-            return CurvePoly(config.power)
+            return PowerInterp(config.power)
         case CurveExponentialConfig():
-            return CurveExponential()
+            return LogSpaceInterp()
         case CurveCosineConfig():
-            return CurveCosine()
+            return CosineAnneal()
     raise TypeError(f"unknown curve config: {config!r}")
 
 

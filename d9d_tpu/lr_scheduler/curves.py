@@ -1,9 +1,13 @@
 """Interpolation curves for piecewise LR schedules.
 
-Parity: reference d9d/lr_scheduler/piecewise/curves.py (CurveBase and the
-linear/cosine/poly/exponential family). TPU-native difference: ``compute``
-uses jnp ops on traced scalars so a whole schedule stays inside the jitted
-train step (the reference computes factors in Python per step on the host).
+Functional parity with the reference d9d piecewise curve family, with a
+TPU-native twist: :meth:`ScheduleCurve.blend` uses jnp ops on traced
+scalars so a whole schedule stays inside the jitted train step (the
+reference computes factors in Python per step on the host).
+
+Each curve maps a phase-progress fraction ``frac`` in [0, 1] to a value
+blended between the phase's boundary values ``lo`` (start) and ``hi``
+(end).
 """
 
 import abc
@@ -14,45 +18,66 @@ import jax.numpy as jnp
 from d9d_tpu.core.types import Array
 
 
-class CurveBase(abc.ABC):
-    """Interpolates between phase start/end values.
+class ScheduleCurve(abc.ABC):
+    """Blends between a phase's start/end values.
 
-    ``step_p`` is the progress fraction through the phase in [0, 1].
+    ``frac`` is the progress fraction through the phase in [0, 1].
+    Implement :meth:`blend`; subclasses written against the pre-rename
+    API that implement only ``compute()`` keep working — each spelling
+    forwards to whichever one the subclass actually overrode.
     """
 
-    @abc.abstractmethod
+    def blend(self, lo: float, hi: float, frac: Array) -> Array:
+        if type(self).compute is not ScheduleCurve.compute:
+            return self.compute(lo, hi, frac)
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement blend()"
+        )
+
+    # reference-era spelling, kept callable so older schedules built
+    # against compute() keep working
     def compute(self, start: float, end: float, step_p: Array) -> Array:
-        ...
+        return self.blend(start, end, step_p)
 
 
-class CurveLinear(CurveBase):
-    def compute(self, start: float, end: float, step_p: Array) -> Array:
-        return start + (end - start) * step_p
+class LinearInterp(ScheduleCurve):
+    """Straight-line blend from ``lo`` to ``hi``."""
+
+    def blend(self, lo: float, hi: float, frac: Array) -> Array:
+        return lo + (hi - lo) * frac
 
 
-class CurveCosine(CurveBase):
-    """Half-period cosine annealing from start to end."""
+class CosineAnneal(ScheduleCurve):
+    """Half-period cosine annealing from ``lo`` to ``hi``."""
 
-    def compute(self, start: float, end: float, step_p: Array) -> Array:
-        cos_out = (1.0 + jnp.cos(jnp.pi * step_p)) / 2.0
-        return end + (start - end) * cos_out
+    def blend(self, lo: float, hi: float, frac: Array) -> Array:
+        cosine_mix = (1.0 + jnp.cos(jnp.pi * frac)) / 2.0
+        return hi + (lo - hi) * cosine_mix
 
 
 @dataclasses.dataclass(frozen=True)
-class CurvePoly(CurveBase):
-    """Polynomial interpolation; power=1 is linear, 2 quadratic, etc."""
+class PowerInterp(ScheduleCurve):
+    """Power-law blend; ``power=1`` is linear, 2 quadratic, etc."""
 
     power: float = 2.0
 
-    def compute(self, start: float, end: float, step_p: Array) -> Array:
-        return start + (end - start) * step_p**self.power
+    def blend(self, lo: float, hi: float, frac: Array) -> Array:
+        return lo + (hi - lo) * frac**self.power
 
 
-class CurveExponential(CurveBase):
-    """Log-space linear interpolation (values clamped away from zero)."""
+class LogSpaceInterp(ScheduleCurve):
+    """Log-space linear blend (operands clamped away from zero)."""
 
-    def compute(self, start: float, end: float, step_p: Array) -> Array:
-        eps = 1e-8
-        ls = jnp.log(jnp.maximum(start, eps))
-        le = jnp.log(jnp.maximum(end, eps))
-        return jnp.exp(ls + (le - ls) * step_p)
+    def blend(self, lo: float, hi: float, frac: Array) -> Array:
+        tiny = 1e-8
+        log_lo = jnp.log(jnp.maximum(lo, tiny))
+        log_hi = jnp.log(jnp.maximum(hi, tiny))
+        return jnp.exp(log_lo + (log_hi - log_lo) * frac)
+
+
+# compatibility aliases (pre-rename public names; zero behavior change)
+CurveBase = ScheduleCurve
+CurveLinear = LinearInterp
+CurveCosine = CosineAnneal
+CurvePoly = PowerInterp
+CurveExponential = LogSpaceInterp

@@ -12,7 +12,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from d9d_tpu.core.types import Array
-from d9d_tpu.lr_scheduler.curves import CurveBase
+from d9d_tpu.lr_scheduler.curves import ScheduleCurve
 
 
 @dataclasses.dataclass(frozen=True)
@@ -24,7 +24,7 @@ class SchedulePhase:
     end_step: int
     start_value: float
     end_value: float
-    curve: CurveBase
+    curve: ScheduleCurve
 
 
 class PiecewiseScheduleEngine:
@@ -47,7 +47,7 @@ class PiecewiseScheduleEngine:
         for phase in reversed(self._phases):
             phase_len = max(phase.end_step - phase.start_step, 1)
             progress = (step - phase.start_step) / phase_len
-            value = phase.curve.compute(
+            value = phase.curve.blend(
                 phase.start_value, phase.end_value, jnp.clip(progress, 0.0, 1.0)
             )
             inside = (step >= phase.start_step) & (step < phase.end_step)

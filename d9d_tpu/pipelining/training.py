@@ -43,6 +43,12 @@ class PipelinedOptimizer:
     optimizer: "optax.GradientTransformation | OptimizerProtocol"
     scalar_shardings: dict[int, Any]
     max_grad_norm: float | None = 1.0
+    # step anomaly guard (docs/design/resilience.md): when True,
+    # step_guarded() freezes every stage's param/moment update on a
+    # non-finite global grad norm or loss via an in-device select —
+    # the ok flag rides the same scalar hops as the clip factor, so the
+    # guard adds no dispatches and no readbacks to the step
+    anomaly_freeze: bool = False
 
     def __post_init__(self) -> None:
         opt = self.optimizer
@@ -76,20 +82,72 @@ class PipelinedOptimizer:
                 updates, opt_state = opt.update(grads, opt_state, params)
                 return apply_updates(params, updates), opt_state
 
+        def combine_guarded(sq_norms, weight_sum, loss_sum, guard, max_norm):
+            # the unguarded combine, plus finiteness of the two scalars
+            # the step already materializes and a [streak, total] device
+            # carry — nothing here forces a host sync
+            with jax.named_scope("pp_opt/combine_guarded"):
+                norm, factor = combine(sq_norms, weight_sum, max_norm)
+                ok = jnp.isfinite(norm) & jnp.isfinite(loss_sum)
+                anomaly = jnp.logical_not(ok).astype(jnp.int32)
+                streak = jnp.where(ok, 0, guard[0] + 1)
+                total = guard[1] + anomaly
+                new_guard = jnp.stack([streak, total])
+                # metric copies come out of the same jit: the guard adds
+                # zero eager op dispatches to the engine's step
+                metrics = {
+                    "resilience/anomaly": anomaly.astype(jnp.float32),
+                    "resilience/anomaly_streak": streak.astype(jnp.float32),
+                    "resilience/anomaly_total": total.astype(jnp.float32),
+                }
+                return norm, factor, ok, new_guard, metrics
+
+        freeze = self.anomaly_freeze
+
+        def update_guarded(params, opt_state, grads, factor, ok):
+            with jax.named_scope("pp_opt/update_guarded"):
+                new_params, new_state = update(
+                    params, opt_state, grads, factor
+                )
+                if freeze:
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(ok, new, old),
+                        new_params, params,
+                    )
+                    new_state = jax.tree.map(
+                        lambda new, old: jnp.where(ok, new, old),
+                        new_state, opt_state,
+                    )
+                return new_params, new_state
+
         self._sq_norm = jax.jit(sq_norm)
         self._combine = jax.jit(
             functools.partial(combine, max_norm=self.max_grad_norm)
         )
         self._update = jax.jit(update, donate_argnums=(0, 1, 2))
+        self._combine_guarded = jax.jit(
+            functools.partial(combine_guarded, max_norm=self.max_grad_norm)
+        )
+        self._update_guarded = jax.jit(
+            update_guarded, donate_argnums=(0, 1, 2)
+        )
 
     def _scoped(self, stage: int):
         return compat.set_mesh(self.scalar_shardings[stage].mesh)
 
     def init(self, stage_params: dict[int, PyTree]) -> dict[int, PyTree]:
+        from d9d_tpu.core.tree_sharding import replicate_uncommitted
+
         out = {}
         for s, p in stage_params.items():
             with self._scoped(s):
-                out[s] = jax.jit(self.optimizer.init)(p)
+                # replicate constraint-free scalars (step counters) onto
+                # the stage submesh so their placement survives a
+                # checkpoint round-trip (see trainer init note)
+                out[s] = replicate_uncommitted(
+                    jax.jit(self.optimizer.init)(p),
+                    self.scalar_shardings[s].mesh,
+                )
         return out
 
     def step(
@@ -123,3 +181,60 @@ class PipelinedOptimizer:
                         stage_params[s], opt_states[s], stage_grads[s], f
                     )
         return new_params, new_states, norm
+
+    # -- anomaly-guarded stepping (docs/design/resilience.md) ----------
+
+    def init_guard_state(self) -> jax.Array:
+        """Fresh device-resident [streak, total] carry on the anchor
+        (last) stage's devices."""
+        last = max(self.scalar_shardings)
+        with self._scoped(last):
+            return jnp.zeros((2,), jnp.int32)
+
+    def step_guarded(
+        self,
+        stage_params: dict[int, PyTree],
+        opt_states: dict[int, PyTree],
+        stage_grads: dict[int, PyTree],
+        weight_sum: jax.Array,
+        loss_sum: jax.Array,
+        guard_state: jax.Array,
+    ) -> tuple[
+        dict[int, PyTree], dict[int, PyTree], jax.Array, dict, jax.Array
+    ]:
+        """:meth:`step` with the step anomaly guard threaded through:
+        → (new_params, new_opt_states, grad_norm, guard_metrics,
+        guard_state).
+
+        ``guard_metrics`` (``resilience/*`` f32 scalars on the anchor
+        stage) and the carry stay on device; the engine folds them into
+        its metric dict for the trainer's cadence-rate host inspection.
+        """
+        last = max(self.scalar_shardings)
+        anchor = self.scalar_shardings[last]
+        with annotate("pp_opt.sq_norms"):
+            sq_local = []
+            for s in sorted(stage_grads):
+                with self._scoped(s):
+                    sq_local.append(self._sq_norm(stage_grads[s]))
+            sq_norms = put_compat(sq_local, anchor)
+        with annotate("pp_opt.combine"), self._scoped(last):
+            norm, factor, ok, guard_state, guard_metrics = (
+                self._combine_guarded(
+                    sq_norms, weight_sum, loss_sum, guard_state
+                )
+            )
+
+        new_params: dict[int, PyTree] = {}
+        new_states: dict[int, PyTree] = {}
+        with annotate("pp_opt.update"):
+            for s in sorted(stage_params):
+                # the ok flag rides the same hop as the clip factor: one
+                # put per stage either way, no extra dispatches
+                f, ok_s = put_compat((factor, ok), self.scalar_shardings[s])
+                with self._scoped(s):
+                    new_params[s], new_states[s] = self._update_guarded(
+                        stage_params[s], opt_states[s], stage_grads[s],
+                        f, ok_s,
+                    )
+        return new_params, new_states, norm, guard_metrics, guard_state

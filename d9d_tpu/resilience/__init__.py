@@ -1,0 +1,71 @@
+"""Fault tolerance for the training/serving loops.
+
+The reference d9d's recovery model is fail-fast restart-and-resume:
+two-phase NCCL timeouts kill a hung job, the scheduler restarts it, and
+the checkpointer resumes from the latest rotation entry. This package is
+the TPU rebuild's full version of that story, caught uniformly at the
+single-controller host (docs/design/resilience.md):
+
+- :mod:`~d9d_tpu.resilience.anomaly` — step anomaly guard. Non-finite
+  loss/grad-norm is detected *inside* the jitted step (reusing the
+  already-computed global grad norm: zero extra device dispatches or
+  readbacks on the happy path) and optionally frozen out via an
+  in-device select; a host-side rolling detector additionally catches
+  finite-but-exploding loss spikes at the metric cadence. Policies:
+  ``warn`` / ``skip_step`` / ``rollback``.
+- :mod:`~d9d_tpu.resilience.preemption` — SIGTERM/SIGINT set a flag the
+  trainer checks at step boundaries; an emergency synchronous checkpoint
+  is written and the process exits with a distinct, documented code that
+  the existing ``resume`` path picks up.
+- :mod:`~d9d_tpu.resilience.manifest` — per-save integrity manifests
+  (meta-item checksums + array file inventory) and validation, so
+  restore can walk back through the rotation history to the newest
+  intact step instead of crashing on a truncated one.
+- :mod:`~d9d_tpu.resilience.chaos` — deterministic fault injectors (NaN
+  grads, loss spikes, checkpoint truncation, prefetch-thread death,
+  SIGTERM mid-run, queue overflow) driving ``tests/resilience/``.
+  Imported on demand only; it pulls in the loop task surface.
+
+Exit-code contract (see docs/design/resilience.md):
+
+- ``EXIT_PREEMPTED`` (83): preemption signal received, emergency
+  checkpoint durable on disk, resume will continue from it.
+- ``EXIT_WATCHDOG`` (42): hang watchdog fired (no step heartbeat);
+  state is whatever the last rotation checkpoint holds.
+
+Both are configurable knobs on ``TrainerConfig``
+(``preemption_exit_code`` / ``watchdog_exit_code``); the constants are
+the documented defaults.
+"""
+
+from d9d_tpu.resilience.anomaly import (
+    ANOMALY_POLICIES,
+    AnomalyPolicy,
+    HostAnomalyGuard,
+)
+from d9d_tpu.resilience.manifest import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    validate_checkpoint_dir,
+    write_manifest,
+)
+from d9d_tpu.resilience.preemption import (
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+    PreemptionGuard,
+    TrainingPreempted,
+)
+
+__all__ = [
+    "ANOMALY_POLICIES",
+    "AnomalyPolicy",
+    "HostAnomalyGuard",
+    "MANIFEST_NAME",
+    "CheckpointIntegrityError",
+    "validate_checkpoint_dir",
+    "write_manifest",
+    "EXIT_PREEMPTED",
+    "EXIT_WATCHDOG",
+    "PreemptionGuard",
+    "TrainingPreempted",
+]

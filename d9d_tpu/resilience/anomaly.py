@@ -1,0 +1,146 @@
+"""Host half of the step anomaly guard.
+
+The device half lives inside the jitted step (``loop/train_step.py`` for
+the single-program path, ``pipelining/training.py`` for PP): it computes
+``ok = isfinite(loss) & isfinite(grad_norm)`` from values the step
+already materializes, freezes the parameter/optimizer update via an
+in-device select when the policy calls for it, and carries a consecutive
+-anomaly streak plus a cumulative total as device-resident state. None
+of that costs a dispatch or a readback — the guard state rides the step
+call, and the flags surface as ordinary metric-dict entries.
+
+This module is the host side: it inspects those flags whenever the
+trainer fetches metrics anyway (the log cadence — the guard never forces
+an extra sync), layers a rolling loss-spike detector on top (finite but
+exploding losses pass the device finiteness check), counts everything
+into ``resilience/*`` telemetry, and decides when a ``rollback`` policy
+should actually restore the last checkpoint.
+
+Latency contract: device-side anomalies are *acted on* (skipped/frozen)
+the step they happen; the host *notices* them — and can trigger a
+rollback — only at the next metric fetch, i.e. within ``log_every``
+steps. Chaos tests run with ``log_every=1`` to make this exact.
+"""
+
+import collections
+import logging
+import math
+import statistics
+from typing import Any, Literal
+
+from d9d_tpu.telemetry import get_telemetry
+
+logger = logging.getLogger("d9d_tpu.resilience")
+
+AnomalyPolicy = Literal["warn", "skip_step", "rollback"]
+ANOMALY_POLICIES = ("warn", "skip_step", "rollback")
+
+# metric-dict keys the device half publishes (both step backends)
+METRIC_ANOMALY = "resilience/anomaly"
+METRIC_STREAK = "resilience/anomaly_streak"
+METRIC_TOTAL = "resilience/anomaly_total"
+
+
+class HostAnomalyGuard:
+    """Cadence-rate observer over the device guard's flags + host losses.
+
+    ``observe()`` returns the action the trainer should take *now*:
+    ``"ok"``, ``"warn"`` (anomaly seen, update policy already handled it
+    on device), or ``"rollback"`` (restore the last checkpoint and
+    rewind). The caller resets the guard (``reset()``) after acting on a
+    rollback so one burst cannot trigger twice.
+    """
+
+    def __init__(
+        self,
+        *,
+        policy: AnomalyPolicy,
+        rollback_after: int = 3,
+        spike_factor: float | None = 10.0,
+        spike_window: int = 32,
+        telemetry=None,
+    ):
+        if policy not in ANOMALY_POLICIES:
+            raise ValueError(
+                f"anomaly policy must be one of {ANOMALY_POLICIES}, "
+                f"got {policy!r}"
+            )
+        if rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        self.policy = policy
+        self.rollback_after = rollback_after
+        self.spike_factor = spike_factor
+        self._losses: collections.deque[float] = collections.deque(
+            maxlen=max(spike_window, 4)
+        )
+        self._spike_streak = 0
+        self._last_device_total = 0.0
+        self._tele = telemetry if telemetry is not None else get_telemetry()
+
+    # -- detectors -----------------------------------------------------
+
+    def _is_spike(self, loss: float) -> bool:
+        """Rolling-median spike test. The baseline window only ever
+        absorbs non-spiking losses, so a plateau of spikes cannot
+        normalize itself into the new baseline."""
+        if self.spike_factor is None or not math.isfinite(loss):
+            return False
+        if len(self._losses) < 4:
+            self._losses.append(loss)
+            return False
+        baseline = statistics.median(self._losses)
+        if loss > self.spike_factor * max(baseline, 1e-12):
+            return True
+        self._losses.append(loss)
+        return False
+
+    # -- the cadence hook ----------------------------------------------
+
+    def observe(self, step: int, host_metrics: dict[str, Any]) -> str:
+        """Feed one fetched metric dict; returns ``ok|warn|rollback``."""
+        device_flag = float(host_metrics.get(METRIC_ANOMALY, 0.0) or 0.0)
+        device_streak = float(host_metrics.get(METRIC_STREAK, 0.0) or 0.0)
+        device_total = float(host_metrics.get(METRIC_TOTAL, 0.0) or 0.0)
+        loss = host_metrics.get("loss")
+
+        # the device total is cumulative across the run: counter-ize the
+        # delta so anomalies between cadences are not lost, only late
+        delta = max(0.0, device_total - self._last_device_total)
+        self._last_device_total = device_total
+        if delta:
+            self._tele.counter("resilience/anomalies").add(delta)
+
+        spike = loss is not None and self._is_spike(float(loss))
+        if spike:
+            self._spike_streak += 1
+            self._tele.counter("resilience/loss_spikes").add(1)
+            logger.warning(
+                "loss spike at step %d: loss=%.6g (rolling median %.6g)",
+                step, loss, statistics.median(self._losses),
+            )
+        elif device_flag == 0.0:
+            self._spike_streak = 0
+
+        anomalous = spike or device_flag > 0.0 or delta > 0.0
+        if anomalous and not spike:
+            logger.warning(
+                "non-finite step anomaly observed at step %d "
+                "(streak=%d, total=%d, policy=%s)",
+                step, int(device_streak), int(device_total), self.policy,
+            )
+        if not anomalous:
+            return "ok"
+
+        if self.policy == "rollback" and (
+            device_streak >= self.rollback_after
+            or self._spike_streak >= self.rollback_after
+        ):
+            return "rollback"
+        return "warn"
+
+    def reset(self) -> None:
+        """Forget streak state (after a rollback restored a checkpoint
+        the pre-rollback history no longer describes the live run)."""
+        self._losses.clear()
+        self._spike_streak = 0
+        self._last_device_total = 0.0

@@ -1,0 +1,143 @@
+"""Checkpoint integrity manifests: write-after-finalize, validate-on-restore.
+
+Orbax finalizes a step atomically (tmp dir → rename) but says nothing
+about what is *inside* the directory: a machine that dies mid-write of
+one tensorstore chunk, a filesystem that truncates on quota, or a stray
+``rm`` leaves a step that lists as restorable and explodes (or worse,
+half-loads) at restore time. The manifest closes that gap:
+
+- After a save is durably finalized, :func:`write_manifest` records the
+  full file inventory of the step directory — relative path + byte size
+  for every file, plus a SHA-256 content checksum for small files (the
+  JSON meta item, orbax/tensorstore index metadata). The manifest itself
+  is written atomically (tmp + rename) *after* everything it describes.
+- On restore, :func:`validate_checkpoint_dir` re-walks the directory and
+  raises :class:`CheckpointIntegrityError` on any missing file, size
+  mismatch, or checksum mismatch. The checkpointer walks back through
+  the rotation history to the newest step that validates AND restores,
+  instead of crashing on the newest directory.
+
+A step directory without a manifest (pre-manifest checkpoints, or a
+save whose process died between finalize and manifest write) is treated
+as *unverified*, not invalid: restore still attempts it inside the same
+walk-back guard, so a corrupt unverified step degrades to a fallback,
+not a crash.
+"""
+
+import hashlib
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger("d9d_tpu.resilience")
+
+MANIFEST_NAME = "d9d_manifest.json"
+MANIFEST_VERSION = 1
+
+# files at or under this size get full content checksums (the meta item
+# and the orbax/tensorstore index files all qualify); bigger array chunk
+# files are inventoried by size — truncation and deletion are caught,
+# and the array payloads don't pay a full re-read on every save/restore
+_CHECKSUM_MAX_BYTES = 4 * 1024 * 1024
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint step directory failed manifest validation."""
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _inventory(step_dir: Path) -> list[dict[str, Any]]:
+    files = []
+    for path in sorted(step_dir.rglob("*")):
+        if not path.is_file() or path.name == MANIFEST_NAME:
+            continue
+        size = path.stat().st_size
+        entry: dict[str, Any] = {
+            "path": path.relative_to(step_dir).as_posix(),
+            "size": size,
+        }
+        if size <= _CHECKSUM_MAX_BYTES:
+            entry["sha256"] = _sha256(path)
+        files.append(entry)
+    return files
+
+
+def write_manifest(step_dir: str | Path, *, step: int) -> Path:
+    """Inventory a *finalized* step directory and write its manifest
+    atomically. Returns the manifest path."""
+    step_dir = Path(step_dir)
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "step": step,
+        "files": _inventory(step_dir),
+    }
+    path = step_dir / MANIFEST_NAME
+    tmp = step_dir / (MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(step_dir: str | Path) -> dict[str, Any] | None:
+    """The parsed manifest, or None when the step has none (unverified)."""
+    path = Path(step_dir) / MANIFEST_NAME
+    if not path.exists():
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable checkpoint manifest {path}: {e}"
+        ) from e
+
+
+def validate_checkpoint_dir(step_dir: str | Path) -> bool:
+    """Validate a step directory against its manifest.
+
+    Returns True when the manifest exists and every inventoried file
+    matches (path present, size equal, checksum equal where recorded);
+    False when no manifest exists (unverified — caller may still try
+    it). Raises :class:`CheckpointIntegrityError` naming every problem
+    when validation *fails*.
+    """
+    step_dir = Path(step_dir)
+    if not step_dir.is_dir():
+        raise CheckpointIntegrityError(f"checkpoint dir {step_dir} missing")
+    manifest = read_manifest(step_dir)
+    if manifest is None:
+        return False
+    problems: list[str] = []
+    for entry in manifest["files"]:
+        path = step_dir / entry["path"]
+        if not path.is_file():
+            problems.append(f"missing file {entry['path']}")
+            continue
+        size = path.stat().st_size
+        if size != entry["size"]:
+            problems.append(
+                f"size mismatch {entry['path']}: "
+                f"{size} != recorded {entry['size']}"
+            )
+            continue
+        digest = entry.get("sha256")
+        if digest is not None and _sha256(path) != digest:
+            problems.append(f"checksum mismatch {entry['path']}")
+    if problems:
+        raise CheckpointIntegrityError(
+            f"checkpoint {step_dir.name} failed integrity validation: "
+            + "; ".join(problems)
+        )
+    return True

@@ -1,0 +1,129 @@
+"""Preemption-safe exit: signal flag → boundary checkpoint → clean exit.
+
+Cloud TPU pools preempt with SIGTERM and a grace window; operators
+interrupt with SIGINT. Either way the right move is the same and the
+single-controller host is the one place to make it: finish the step in
+flight, write a *synchronous* (durable-on-return) checkpoint, and exit
+with a distinct code so the job scheduler can tell "preempted, resume
+me" from "crashed, investigate". The existing ``resume`` path picks the
+emergency checkpoint up unchanged.
+
+``PreemptionGuard`` only sets a flag from the handler (async-signal
+safe); all real work happens at the trainer's step boundary.
+``TrainingPreempted`` subclasses ``SystemExit``: uncaught, it terminates
+the process with the documented code and no traceback; embedders that
+drive ``Trainer.train()`` themselves can catch it like any exception
+(the trainer's cleanup — telemetry flush, checkpoint barrier — has
+already run by the time it propagates).
+"""
+
+import logging
+import signal
+import threading
+import time
+
+from d9d_tpu.telemetry import get_telemetry
+
+logger = logging.getLogger("d9d_tpu.resilience")
+
+# documented defaults for the exit-code contract (configurable on
+# TrainerConfig; docs/design/resilience.md)
+EXIT_PREEMPTED = 83
+EXIT_WATCHDOG = 42
+
+
+class TrainingPreempted(SystemExit):
+    """Raised by the trainer after the emergency checkpoint is durable.
+
+    ``code`` is the process exit code (``SystemExit`` semantics);
+    ``step`` is the step the checkpoint was written at.
+    """
+
+    def __init__(self, code: int, *, step: int | None = None):
+        super().__init__(code)
+        self.step = step
+
+    def __str__(self) -> str:
+        return (
+            f"training preempted (exit code {self.code}, "
+            f"checkpoint at step {self.step})"
+        )
+
+
+class PreemptionGuard:
+    """Context manager installing SIGTERM/SIGINT flag-setting handlers.
+
+    Handlers chain nowhere on the first signal — they record it and
+    return, letting the step in flight finish. A *second* SIGINT falls
+    through to an immediate ``KeyboardInterrupt`` (the operator really
+    means it). Signal handlers are only installable on the main thread;
+    elsewhere (tests driving a trainer from a worker thread, embedders)
+    the guard degrades to an inert no-op with a warning.
+    """
+
+    def __init__(
+        self,
+        *,
+        signals: tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+        enabled: bool = True,
+        telemetry=None,
+    ):
+        self._signals = signals
+        self._enabled = enabled
+        self._previous: dict[int, object] = {}
+        self._triggered_at: float | None = None
+        self._signum: int | None = None
+        self._tele = telemetry if telemetry is not None else get_telemetry()
+
+    # -- flag surface ---------------------------------------------------
+
+    @property
+    def triggered(self) -> bool:
+        return self._triggered_at is not None
+
+    @property
+    def signum(self) -> int | None:
+        return self._signum
+
+    def trip(self, signum: int = signal.SIGTERM) -> None:
+        """Set the flag programmatically (chaos injection, tests)."""
+        self._handle(signum, None)
+
+    def _handle(self, signum, frame) -> None:
+        if self._triggered_at is not None and signum == signal.SIGINT:
+            # second Ctrl-C: stop waiting for the boundary
+            raise KeyboardInterrupt
+        first = self._triggered_at is None
+        self._triggered_at = time.monotonic()
+        self._signum = signum
+        if first:
+            # counters are async-signal tolerant (plain float adds); the
+            # heavyweight work (checkpoint, flush) stays at the boundary
+            self._tele.counter("resilience/preempt_signals").add(1)
+            logger.warning(
+                "received signal %d: will checkpoint and exit at the "
+                "next step boundary", signum,
+            )
+
+    # -- install/restore ------------------------------------------------
+
+    def __enter__(self) -> "PreemptionGuard":
+        if not self._enabled:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "preemption guard disabled: signal handlers need the "
+                "main thread (trainer is running on %s)",
+                threading.current_thread().name,
+            )
+            self._enabled = False
+            return self
+        for signum in self._signals:
+            self._previous[signum] = signal.signal(signum, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        for signum, prev in self._previous.items():
+            signal.signal(signum, prev)
+        self._previous.clear()
+        return False

@@ -60,6 +60,7 @@ def _prompt(b, p, seed=0):
 
 
 @pytest.mark.parametrize("chunk", [1, 3, 4, 7, 16])
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_dense_chunked_matches_unchunked(chunk):
     dec = _dense(decode_max_length=24)
     params = _init_params(dec)
@@ -85,6 +86,7 @@ def test_windowed_chunked_matches_unchunked():
 
 
 @pytest.mark.parametrize("backend", ["eager", "pallas"])
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_ragged_chunked_matches_unchunked(backend, monkeypatch):
     """Left-padded ragged rows: pad slots stay masked across chunks —
     including through the flash-decode kernel's kv_valid path with
@@ -141,6 +143,7 @@ def _hybrid_moe(decode_max_length=0, mla=False):
     )
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_hybrid_gdn_chunked_matches_unchunked():
     """GDN layers thread recurrent state + conv tail across chunks."""
     dec = _hybrid_moe(decode_max_length=24)

@@ -93,6 +93,7 @@ class TestDecodeParity:
                 rtol=2e-5, atol=2e-5,
             )
 
+    @pytest.mark.slow  # >20s compile-bound on the 2-core rig; e2e tier covers it
     def test_greedy_generate_matches_full_forward_argmax(self):
         full, dec, params = _models(decode_max_length=16)
         rng = np.random.default_rng(1)
@@ -133,6 +134,7 @@ class TestDecodeParity:
         hit = np.argmax(out[0] == eos)
         assert (out[0, hit:] == eos).all()
 
+    @pytest.mark.slow  # >20s compile-bound on the 2-core rig; e2e tier covers it
     def test_hybrid_gdn_decode_matches_full_forward(self):
         """The hybrid family decodes through GDN recurrent state + conv
         tail + KV caches on the attention layers; teacher-forced step
@@ -179,6 +181,7 @@ class TestDecodeParity:
                 rtol=5e-5, atol=5e-5,
             )
 
+    @pytest.mark.slow  # >20s compile-bound on the 2-core rig; e2e tier covers it
     def test_hybrid_generate_greedy(self):
         from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
 
@@ -276,6 +279,7 @@ class TestDecodeParity:
         np.testing.assert_array_equal(got[0], want_short[0])
         np.testing.assert_array_equal(got[1], want_long[0])
 
+    @pytest.mark.slow  # >20s compile-bound on the 2-core rig; e2e tier covers it
     def test_ragged_prompts_hybrid(self):
         """Same ragged contract through the GDN hybrid (padding_mask
         threads to the linear-attention layers)."""
@@ -310,6 +314,7 @@ class TestDecodeParity:
         np.testing.assert_array_equal(got[0], want_short[0])
         np.testing.assert_array_equal(got[1], want_long[0])
 
+    @pytest.mark.slow  # >20s compile-bound on the 2-core rig; e2e tier covers it
     def test_top_p_sampling(self):
         _, dec, params = _models(decode_max_length=16)
         prompt = jnp.ones((2, 4), jnp.int32)

@@ -104,6 +104,7 @@ def test_inference_runs_and_scores(devices):
     assert all(np.isfinite(r).all() for r in results)
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_inference_with_trainer_params_consistent(devices, tmp_path):
     """Scores computed via Inference equal the trainer's eval loss."""
     ctx = MeshParameters(dp_shard=4).build(devices[:4])
@@ -186,6 +187,7 @@ class _PipelineScoreTask(CausalLMTask, PipelineInferenceTask):
         return outputs["nll"].tolist()
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_pipeline_inference_matches_single_program(devices):
     """pp=2 forward-only program == single-program scores on the same
     weights (VERDICT r2 item 6), and Trainer.loss_on_batch works under PP

@@ -69,6 +69,7 @@ def _prompts(seed, count, lo=2, hi=7):
     ]
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_staggered_admission_matches_generate():
     model = _dense()
     params = _params(model)
@@ -118,6 +119,7 @@ def test_eos_evicts_and_slot_refills():
         assert outputs[rid] == want, rid
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_hybrid_gdn_serving_matches_generate():
     """GDN recurrent state + conv tail are per-row; slot resets must
     clear them (a polluted state changes every subsequent token)."""

@@ -90,6 +90,7 @@ def hf_and_ours(tmp_path_factory):
     return hf, model, params, cfg, tmp_path
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_logits_match_hf(hf_and_ours):
     torch = pytest.importorskip("torch")
     hf, model, params, cfg, _ = hf_and_ours
@@ -111,6 +112,7 @@ def test_logits_match_hf(hf_and_ours):
     )
 
 
+@pytest.mark.slow  # shares the HF-model fixture with test_logits_match_hf
 def test_roundtrip_back_to_hf(hf_and_ours, tmp_path):
     torch = pytest.importorskip("torch")
     hf, model, params, cfg, _ = hf_and_ours

@@ -32,6 +32,7 @@ def _models(dml=0):
     return full, model, params
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_forward_loss_and_grads():
     full, _, params = _models()
     b, t = 2, 8
@@ -58,6 +59,7 @@ def float_sum(model, params, ids, pos):
     )
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_greedy_generate_matches_full_forward_argmax():
     """Teacher-forced rollout through the FULL forward must equal the
     cached decode loop token for token (MLA latent-cache + absorbed
@@ -83,6 +85,7 @@ def test_greedy_generate_matches_full_forward_argmax():
     assert got.tolist() == want
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_serving_and_speculative():
     full, dec, params = _models(dml=24)
     prompts = [

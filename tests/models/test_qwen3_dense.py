@@ -32,6 +32,7 @@ def test_forward_loss_shape(tiny_cfg):
     assert np.isfinite(np.asarray(loss)).all()
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_grads_flow(tiny_cfg):
     model = make_model(tiny_cfg)
     tokens = jnp.arange(16).reshape(2, 8) % 128
@@ -146,6 +147,7 @@ def test_hf_parity(tiny_cfg):
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_remat_policy_variants_match(devices):
     """remat off / full / dots_no_batch compute identical losses."""
     import dataclasses

@@ -86,6 +86,7 @@ class TestStochasticAdamW:
             return {"w": g.astype(jnp.float32), "b": jnp.ones((8,), jnp.float32)}
         return params, grads_at
 
+    @pytest.mark.slow  # compile-bound minutes-class on the 2-core rig; e2e tier covers it
     def test_tracks_fp32_adamw(self):
         lr, wd = 1e-2, 0.1
         params_bf, grads_at = self._problem(jnp.bfloat16)

@@ -1,0 +1,172 @@
+"""Shared micro-scale fixtures for the chaos suite.
+
+The fault-injection tests (docs/design/resilience.md catalogue) run in
+the quick tier, so everything here is deliberately tiny: a 2-layer
+MicroLM instead of a Qwen stack (compile cost ~seconds on the 2-core
+rig) and a ToyDecodeLM whose next token is ``(tok + 1) % vocab`` — a
+real flax decode cache (``cache_index`` + a written memory leaf) with
+exactly predictable emissions, so degraded-mode scheduling asserts
+exact outputs without an oracle model.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop import (
+    AdamWProvider,
+    CausalLMTask,
+    DatasetProvider,
+    ModelProvider,
+    StatefulDataLoader,
+    Trainer,
+    TrainerConfig,
+)
+from d9d_tpu.loop.tasks import LM_IGNORE_INDEX
+from d9d_tpu.parallel import replicate_plan
+
+VOCAB = 16
+
+
+class MicroLM(nn.Module):
+    """Embed → Dense → Dense next-token model returning per-token loss
+    (the CausalLM contract CausalLMTask drives)."""
+
+    vocab: int = VOCAB
+    dim: int = 16
+
+    @nn.compact
+    def __call__(self, tokens, positions, labels):
+        h = nn.Embed(self.vocab, self.dim)(tokens)
+        h = nn.Dense(self.dim)(jax.nn.relu(h))
+        logits = nn.Dense(self.vocab)(h)
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(
+            logp, jnp.clip(labels, 0)[..., None], axis=-1
+        )[..., 0]
+        valid = (labels != LM_IGNORE_INDEX).astype(jnp.float32)
+        return -(ll * valid)
+
+
+class MicroProvider(ModelProvider):
+    def build_module(self, stage):
+        return MicroLM()
+
+    def build_plan(self, ctx):
+        return replicate_plan(ctx)
+
+    def sample_inputs(self, batch_size, seq_len):
+        z = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return (z, z, z)
+
+
+class MicroLoaderProvider(DatasetProvider):
+    """Stateful (exact-resume) loader over a fixed random token table."""
+
+    def __init__(self, n_items=64, seq=8, batch=8, dataset_wrap=None):
+        self.n, self.seq, self.batch = n_items, seq, batch
+        self.dataset_wrap = dataset_wrap
+        self.loader_kwargs = {}
+
+    def build(self):
+        rng = np.random.RandomState(0)
+        ds = [
+            {"input_ids": rng.randint(0, VOCAB, self.seq + 1)}
+            for _ in range(self.n)
+        ]
+        if self.dataset_wrap is not None:
+            ds = self.dataset_wrap(ds)
+        return StatefulDataLoader(
+            ds, self.batch, shuffle=True, seed=0, num_epochs=100,
+            **self.loader_kwargs,
+        )
+
+
+def make_micro_trainer(task, *, dataset_provider=None, **config_overrides):
+    """A Trainer over MicroLM on the 8-device replicate mesh with
+    chaos-friendly defaults (log_every=1 so the host guard observes
+    every step; prefetch off unless a test opts in)."""
+    ctx = MeshParameters(dp_replicate=8).build(jax.devices())
+    defaults = dict(
+        global_batch_size=8,
+        microbatch_size=8,
+        seq_len=8,
+        total_steps=12,
+        log_every=1,
+        prefetch_batches=0,
+        telemetry_console=False,
+        gc_every_steps=None,
+    )
+    defaults.update(config_overrides)
+    config = TrainerConfig(**defaults)
+    return Trainer(
+        ctx=ctx,
+        config=config,
+        model_provider=MicroProvider(),
+        dataset_provider=(
+            dataset_provider
+            if dataset_provider is not None
+            else MicroLoaderProvider()
+        ),
+        task=task,
+        optimizer_provider=AdamWProvider(),
+    )
+
+
+SERVE_VOCAB = 32
+
+
+class ToyDecodeLM(nn.Module):
+    """Deterministic decode model: next token = (tok + 1) % vocab.
+
+    Carries a real decode cache (scalar ``cache_index`` the batcher
+    reseeds per-row, plus a written [B, L] memory leaf) so the serving
+    loop's cache zeroing/pinning machinery is exercised for real.
+    """
+
+    vocab: int = SERVE_VOCAB
+    decode_max_length: int = 32
+
+    @nn.compact
+    def __call__(self, tokens, positions, labels=None, mask=None):
+        b = tokens.shape[0]
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        mem = self.variable(
+            "cache", "mem",
+            lambda: jnp.zeros((b, self.decode_max_length), jnp.int32),
+        )
+        i = jnp.broadcast_to(idx.value, (b,))
+        mem.value = mem.value.at[
+            jnp.arange(b), jnp.clip(i, 0, self.decode_max_length - 1)
+        ].set(tokens[:, 0])
+        idx.value = idx.value + 1
+        return jax.nn.one_hot((tokens + 1) % self.vocab, self.vocab) * 20.0
+
+    def logits(self, tokens, positions, mask=None):
+        return self(tokens, positions)
+
+
+@pytest.fixture
+def toy_batcher_factory():
+    from d9d_tpu.loop.serve import ContinuousBatcher
+
+    model = ToyDecodeLM()
+    z = jnp.zeros((2, 1), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), z, z, z).get("params", {})
+
+    def make(**kwargs):
+        kwargs.setdefault("batch_size", 2)
+        kwargs.setdefault("chunk_size", 4)
+        return ContinuousBatcher(model, params, **kwargs)
+
+    return make
+
+
+def toy_expected(prompt, n):
+    """The tokens ToyDecodeLM greedy-decodes after ``prompt``."""
+    return [(prompt[-1] + 1 + i) % SERVE_VOCAB for i in range(n)]

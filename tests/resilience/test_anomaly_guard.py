@@ -1,0 +1,190 @@
+"""Step anomaly guard: device detection/freeze, host spike detection,
+policy behavior through the trainer, and the zero-extra-sync pin.
+
+Acceptance contract (ISSUE 5): NaN grads and loss spikes are survived —
+training continues with the anomaly counted in telemetry — and the
+guard's happy path adds zero device dispatches/readbacks per step
+(pinned here with jax's transfer guard: a device→host transfer inside
+the guarded step loop would raise).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from tests.resilience.conftest import make_micro_trainer
+
+from d9d_tpu.loop import CausalLMTask
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.train_step import build_train_step
+from d9d_tpu.resilience import HostAnomalyGuard
+from d9d_tpu.resilience.chaos import ChaosScaleTask
+from d9d_tpu.telemetry import Telemetry, set_telemetry
+
+
+# -- direct step-fn level -------------------------------------------------
+
+class _ToyTask(TrainTask):
+    def prepare_batch(self, batch):
+        return batch
+
+    def loss_fn(self, module, params, mb, rng):
+        y = module.apply(params, mb["x"])
+        return jnp.sum((y - mb["y"]) ** 2), jnp.float32(mb["x"].shape[0]), {}
+
+
+def _toy_setup(policy):
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    module = Lin()
+    opt = optax.adam(1e-2)
+    x = jnp.ones((2, 4, 8))
+    y = jnp.zeros((2, 4, 4))
+    params = module.init(jax.random.PRNGKey(0), x[0])
+    opt_state = jax.jit(opt.init)(params)
+    step = build_train_step(
+        module=module, task=_ToyTask(), optimizer=opt,
+        num_microbatches=2, anomaly_policy=policy,
+    )
+    return step, params, opt_state, {"x": x, "y": y}
+
+
+def test_skip_step_freezes_params_and_moments_bitwise():
+    step, params, opt_state, batch = _toy_setup("skip_step")
+    rng = jax.random.PRNGKey(1)
+    params, opt_state, _ = step(params, opt_state, batch, rng)
+    p_host = jax.tree.map(np.asarray, params)
+    s_host = jax.tree.map(np.asarray, opt_state)
+    bad = {"x": batch["x"] * jnp.nan, "y": batch["y"]}
+    params, opt_state, m = step(params, opt_state, bad, rng)
+    assert float(m["resilience/anomaly"]) == 1.0
+    assert float(m["resilience/anomaly_streak"]) == 1.0
+    for a, b in zip(jax.tree.leaves(p_host), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s_host), jax.tree.leaves(opt_state)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    # streak resets on the next clean step, total persists
+    params, opt_state, m = step(params, opt_state, batch, rng)
+    assert float(m["resilience/anomaly_streak"]) == 0.0
+    assert float(m["resilience/anomaly_total"]) == 1.0
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_warn_policy_applies_the_poisoned_update():
+    step, params, opt_state, batch = _toy_setup("warn")
+    rng = jax.random.PRNGKey(1)
+    bad = {"x": batch["x"] * jnp.nan, "y": batch["y"]}
+    params, opt_state, m = step(params, opt_state, bad, rng)
+    assert float(m["resilience/anomaly"]) == 1.0
+    # warn only flags: the NaN update went through
+    leaves = [np.asarray(x) for x in jax.tree.leaves(params)]
+    assert any(not np.isfinite(x).all() for x in leaves)
+
+
+def test_happy_path_adds_zero_dispatches_and_readbacks():
+    """The serve-style pin: after warmup, guarded steps run under a
+    device→host transfer guard — any readback the guard added would
+    raise — and the jitted call count is exactly one per step."""
+    step, params, opt_state, batch = _toy_setup("skip_step")
+    rng = jax.random.PRNGKey(1)
+    params, opt_state, m = step(params, opt_state, batch, rng)  # compile
+    jax.block_until_ready(m["loss"])
+
+    calls = 0
+    inner = step.fn
+
+    def counting(*args):
+        nonlocal calls
+        calls += 1
+        return inner(*args)
+
+    step.fn = counting
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(3):
+            params, opt_state, m = step(params, opt_state, batch, rng)
+    jax.block_until_ready(m["loss"])
+    assert calls == 3  # one dispatch per step, nothing extra
+
+
+# -- host-side spike detector --------------------------------------------
+
+def test_host_spike_detector_rolls_and_triggers():
+    tele = Telemetry()
+    guard = HostAnomalyGuard(
+        policy="rollback", rollback_after=2, spike_factor=10.0,
+        spike_window=8, telemetry=tele,
+    )
+    for s in range(6):
+        assert guard.observe(s, {"loss": 1.0 + 0.01 * s}) == "ok"
+    # a single 100x spike warns; the second consecutive one rolls back
+    assert guard.observe(6, {"loss": 100.0}) == "warn"
+    assert guard.observe(7, {"loss": 100.0}) == "rollback"
+    assert tele.registry.counter("resilience/loss_spikes").value == 2
+    # the spike never entered the baseline window
+    assert guard.observe(8, {"loss": 1.0}) == "ok"
+
+
+def test_host_guard_counts_device_totals():
+    tele = Telemetry()
+    guard = HostAnomalyGuard(policy="skip_step", telemetry=tele)
+    guard.observe(1, {"loss": float("nan"), "resilience/anomaly": 1.0,
+                      "resilience/anomaly_streak": 1.0,
+                      "resilience/anomaly_total": 1.0})
+    # cadence gap: device total jumped by 3 — the counter keeps the delta
+    guard.observe(5, {"loss": 2.0, "resilience/anomaly": 1.0,
+                      "resilience/anomaly_streak": 2.0,
+                      "resilience/anomaly_total": 4.0})
+    assert tele.registry.counter("resilience/anomalies").value == 4.0
+
+
+# -- trainer e2e ----------------------------------------------------------
+
+def test_trainer_survives_nan_steps_with_skip_step():
+    task = ChaosScaleTask(
+        CausalLMTask(), {3: float("nan"), 4: float("nan")}
+    )
+    trainer = make_micro_trainer(task, anomaly_policy="skip_step")
+    history = trainer.train()
+    assert history[-1]["step"] == trainer.config.total_steps
+    anomalous = [h for h in history if h.get("resilience/anomaly") == 1.0]
+    assert len(anomalous) == 2
+    assert history[-1]["resilience/anomaly_total"] == 2.0
+    # training continued and recovered: every post-anomaly loss is finite
+    post = [h["loss"] for h in history if h["step"] > anomalous[-1]["step"]]
+    assert post and all(np.isfinite(v) for v in post)
+
+
+def test_trainer_rollback_restores_and_completes(tmp_path):
+    hub = set_telemetry(Telemetry())
+    try:
+        task = ChaosScaleTask(
+            CausalLMTask(),
+            {5: float("nan"), 6: float("nan"), 7: float("nan")},
+        )
+        trainer = make_micro_trainer(
+            task,
+            anomaly_policy="rollback",
+            anomaly_rollback_after=2,
+            checkpoint_dir=str(tmp_path),
+            checkpoint_every_steps=2,
+            checkpoint_async=False,
+        )
+        history = trainer.train()
+        trainer.close()
+        assert hub.registry.counter("resilience/rollbacks").value >= 1
+        assert history[-1]["step"] == trainer.config.total_steps
+        assert np.isfinite(history[-1]["loss"])
+        # the rolled-back step re-ran: its step id appears twice
+        steps = [h["step"] for h in history]
+        assert len(steps) > len(set(steps))
+    finally:
+        set_telemetry(Telemetry())  # fresh hub for later tests

@@ -1,0 +1,82 @@
+"""Data-path chaos through the trainer: transient fetch errors are
+retried with backoff and counted; a dead source (prefetch-producer
+death) surfaces as an explanatory DataFetchError, not a hang; finite
+loss spikes are detected by the host guard."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from tests.resilience.conftest import MicroLoaderProvider, make_micro_trainer
+
+from d9d_tpu.loop import CausalLMTask, DataFetchError
+from d9d_tpu.loop.components.prefetch import BatchPrefetcher
+from d9d_tpu.resilience.chaos import ChaosScaleTask, FlakyDataset
+from d9d_tpu.telemetry import Telemetry, set_telemetry
+
+
+def test_transient_fetch_errors_retried_and_counted():
+    hub = set_telemetry(Telemetry())
+    try:
+        provider = MicroLoaderProvider(
+            dataset_wrap=lambda ds: FlakyDataset(ds, fail_calls={10, 30})
+        )
+        provider.loader_kwargs = dict(
+            retry_attempts=2, retry_backoff_s=0.01
+        )
+        trainer = make_micro_trainer(
+            CausalLMTask(), dataset_provider=provider, total_steps=6,
+            prefetch_batches=2,
+        )
+        history = trainer.train()
+        assert history[-1]["step"] == 6
+        assert all(np.isfinite(h["loss"]) for h in history)
+        assert hub.registry.counter("io/data_retries").value == 2
+    finally:
+        set_telemetry(Telemetry())
+
+
+def test_dead_source_fails_with_position_not_timeout():
+    provider = MicroLoaderProvider(
+        dataset_wrap=lambda ds: FlakyDataset(ds, dead_from=20)
+    )
+    provider.loader_kwargs = dict(retry_attempts=1, retry_backoff_s=0.01)
+    trainer = make_micro_trainer(
+        CausalLMTask(), dataset_provider=provider, total_steps=10,
+        prefetch_batches=2,
+    )
+    with pytest.raises(DataFetchError, match=r"epoch \d+ batch \d+"):
+        trainer.train()
+
+
+def test_prefetch_producer_death_surfaces_not_hangs():
+    """A producer thread that dies without delivering batch, error, or
+    end-of-data must raise promptly on the consumer."""
+
+    class DyingPrefetcher(BatchPrefetcher):
+        def _produce(self):  # silent thread death — no sentinel
+            return
+
+    pf = DyingPrefetcher(iter([]), lambda x: x, depth=1)
+    pf._thread.join(timeout=5.0)
+    with pytest.raises(RuntimeError, match="producer thread died"):
+        next(pf)
+    pf.close()
+
+
+def test_finite_loss_spike_detected_and_survived():
+    hub = set_telemetry(Telemetry())
+    try:
+        task = ChaosScaleTask(CausalLMTask(), {4: 500.0})
+        trainer = make_micro_trainer(
+            task, anomaly_policy="warn", anomaly_spike_factor=10.0,
+            total_steps=8,
+        )
+        history = trainer.train()
+        assert history[-1]["step"] == 8
+        assert hub.registry.counter("resilience/loss_spikes").value >= 1
+        # spike was finite: the device guard saw nothing anomalous
+        assert history[-1].get("resilience/anomaly_total", 0.0) == 0.0
+    finally:
+        set_telemetry(Telemetry())

@@ -1,0 +1,192 @@
+"""Checkpoint integrity & fallback: manifests written after finalize,
+truncation detected, restore walks back to the newest intact step
+bitwise-identically, and a kill mid-async-save never strands resume.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from d9d_tpu.loop.components.checkpointer import StateCheckpointer
+from d9d_tpu.resilience.chaos import (
+    checkpoint_steps,
+    truncate_latest_checkpoint,
+)
+from d9d_tpu.resilience.manifest import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    validate_checkpoint_dir,
+)
+from d9d_tpu.telemetry import Telemetry, set_telemetry
+
+
+def _arrays(step: int):
+    # deterministic per-step content so fallbacks can be checked bitwise
+    return {
+        "w": jnp.arange(4096, dtype=jnp.float32) * step,
+        "b": jnp.ones((8,), jnp.float32) * step,
+    }
+
+
+def _checkpointer(tmp_path, **kw):
+    kw.setdefault("save_every_steps", 1)
+    kw.setdefault("num_to_keep", 3)
+    return StateCheckpointer(tmp_path, **kw)
+
+
+def test_manifest_written_and_validated(tmp_path):
+    ck = _checkpointer(tmp_path, async_save=True)
+    for s in (1, 2):
+        ck.save(s, _arrays(s), {"step": s})
+    ck.wait_until_finished()
+    for s in (1, 2):
+        step_dir = pathlib.Path(tmp_path) / f"save_{s}"
+        assert (step_dir / MANIFEST_NAME).exists()
+        assert validate_checkpoint_dir(step_dir) is True
+    ck.close()
+
+
+def test_truncated_latest_falls_back_bitwise(tmp_path):
+    hub = set_telemetry(Telemetry())
+    try:
+        ck = _checkpointer(tmp_path, async_save=True)
+        for s in (1, 2, 3):
+            ck.save(s, _arrays(s), {"step": s})
+        ck.wait_until_finished()
+        step, victim = truncate_latest_checkpoint(tmp_path)
+        assert step == 3
+        assert victim.stat().st_size > 0
+        with pytest.raises(CheckpointIntegrityError):
+            validate_checkpoint_dir(pathlib.Path(tmp_path) / f"save_{step}")
+        restored = ck.restore(_arrays(0))
+        assert restored is not None
+        got_step, got_arrays, meta = restored
+        assert got_step == 2 and meta["step"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(got_arrays["w"]), np.asarray(_arrays(2)["w"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_arrays["b"]), np.asarray(_arrays(2)["b"])
+        )
+        assert (
+            hub.registry.counter("resilience/checkpoint_fallback").value
+            == 1
+        )
+        # an explicit step request keeps strict semantics
+        with pytest.raises(CheckpointIntegrityError):
+            ck.restore(_arrays(0), step=3)
+        ck.close()
+    finally:
+        set_telemetry(Telemetry())
+
+
+def test_fallback_prunes_corrupt_steps_and_allows_resave(tmp_path):
+    """After walking back past a corrupt step, that step is pruned from
+    the rotation so replayed training can re-save at the same step
+    number and the corrupt entry can never shadow the intact one."""
+    ck = _checkpointer(tmp_path, async_save=False)
+    for s in (1, 2):
+        ck.save(s, _arrays(s), {"step": s})
+    truncate_latest_checkpoint(tmp_path)
+    restored = ck.restore(_arrays(0))
+    assert restored is not None and restored[0] == 1
+    assert checkpoint_steps(tmp_path) == [1]
+    # the restore reset the same-step save guard: replaying to step 2
+    # re-saves cleanly over the pruned slot
+    ck.save(2, _arrays(2), {"step": 2})
+    again = ck.restore(_arrays(0))
+    assert again is not None and again[0] == 2
+    np.testing.assert_array_equal(
+        np.asarray(again[1]["w"]), np.asarray(_arrays(2)["w"])
+    )
+    ck.close()
+
+
+def test_all_checkpoints_corrupt_raises_not_fresh_start(tmp_path):
+    """Checkpoints exist but none restores: silently training from
+    scratch (and rotating the old data away) would be quiet data loss —
+    the operator gets an error instead."""
+    ck = _checkpointer(tmp_path, async_save=False, num_to_keep=2)
+    for s in (1, 2):
+        ck.save(s, _arrays(s), {"step": s})
+    for s in (1, 2):
+        truncate_latest_checkpoint(tmp_path, step=s)
+    with pytest.raises(RuntimeError, match="refusing to silently"):
+        ck.restore(_arrays(0))
+    # nothing was pruned: no intact step was found to walk back TO
+    assert checkpoint_steps(tmp_path) == [1, 2]
+    ck.close()
+
+
+def test_empty_directory_restores_none(tmp_path):
+    ck = _checkpointer(tmp_path, async_save=False)
+    assert ck.restore(_arrays(0)) is None  # genuinely no checkpoints
+    ck.close()
+
+
+def test_pre_manifest_checkpoints_still_restore(tmp_path):
+    """Back-compat: steps saved before the manifest era (no manifest
+    file) restore through the unverified path."""
+    ck = _checkpointer(tmp_path, async_save=False)
+    ck.save(1, _arrays(1), {"step": 1})
+    (pathlib.Path(tmp_path) / "save_1" / MANIFEST_NAME).unlink()
+    restored = ck.restore(_arrays(0))
+    assert restored is not None and restored[0] == 1
+    ck.close()
+
+
+_KILL_SCRIPT = r"""
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+sys.path.insert(0, {repo!r})
+from d9d_tpu.loop.components.checkpointer import StateCheckpointer
+
+tmp = sys.argv[1]
+def arrays(step):
+    return {{
+        "w": jnp.arange(4096, dtype=jnp.float32) * step,
+        "b": jnp.ones((8,), jnp.float32) * step,
+    }}
+ck = StateCheckpointer(tmp, save_every_steps=1, num_to_keep=3, async_save=True)
+ck.save(1, arrays(1), {{"step": 1}})
+ck.wait_until_finished()  # step 1 durable + manifest written
+ck.save(2, arrays(2), {{"step": 2}})
+# simulated preemption kill mid-async-save: NO wait_until_finished —
+# the background write (and the step-2 manifest) may or may not land
+os._exit(9)
+"""
+
+
+def test_kill_mid_async_save_restores_an_intact_step(tmp_path):
+    """Crash consistency: a process killed mid-async-save leaves a
+    directory tree from which restore ALWAYS returns an intact step
+    bitwise-identically — step 1 when step 2 didn't survive, step 2 if
+    its write happened to complete — and never crashes or returns
+    half-written arrays."""
+    repo = str(pathlib.Path(__file__).resolve().parents[2])
+    script = _KILL_SCRIPT.format(repo=repo)
+    proc = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 9, proc.stderr
+    assert 1 in checkpoint_steps(tmp_path)
+
+    ck = _checkpointer(tmp_path, async_save=True)
+    restored = ck.restore(_arrays(0))
+    assert restored is not None, "kill mid-save stranded resume entirely"
+    got_step, got_arrays, meta = restored
+    assert got_step in (1, 2) and meta["step"] == got_step
+    np.testing.assert_array_equal(
+        np.asarray(got_arrays["w"]), np.asarray(_arrays(got_step)["w"])
+    )
+    ck.close()

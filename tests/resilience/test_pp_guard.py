@@ -1,0 +1,86 @@
+"""PP-path anomaly guard: PipelinedOptimizer.step_guarded freezes every
+stage's update on non-finite grad-norm/loss, carries the streak on
+device, and adds no host syncs (pinned with the transfer guard)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+pytestmark = pytest.mark.chaos
+
+from d9d_tpu.pipelining.training import PipelinedOptimizer
+
+
+def _setup(freeze=True):
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("x",))
+    sh = NamedSharding(mesh, P())
+    opt = PipelinedOptimizer(
+        optimizer=optax.adam(1e-2),
+        scalar_shardings={0: sh, 1: sh},
+        anomaly_freeze=freeze,
+    )
+    params = {
+        0: {"w": jnp.ones((4, 4))},
+        1: {"w": jnp.full((4, 4), 2.0)},
+    }
+    states = opt.init(params)
+    return opt, params, states
+
+
+def test_guarded_step_freezes_all_stages_on_nan():
+    opt, params, states = _setup()
+    guard = opt.init_guard_state()
+    good = {s: {"w": jnp.full((4, 4), 0.1)} for s in (0, 1)}
+    w = jnp.float32(1.0)
+
+    p1, s1, _, gm, guard = opt.step_guarded(
+        params, states, good, w, jnp.float32(1.0), guard
+    )
+    assert float(gm["resilience/anomaly"]) == 0.0
+    p1_host = jax.tree.map(np.asarray, p1)
+
+    # NaN in ONE stage's grads poisons the global norm → both freeze
+    bad = {
+        0: {"w": jnp.full((4, 4), jnp.nan)},
+        1: {"w": jnp.full((4, 4), 0.1)},
+    }
+    p2, s2, _, gm, guard = opt.step_guarded(
+        p1, s1, bad, w, jnp.float32(1.0), guard
+    )
+    assert float(gm["resilience/anomaly"]) == 1.0
+    assert float(gm["resilience/anomaly_streak"]) == 1.0
+    for s in (0, 1):
+        np.testing.assert_array_equal(
+            p1_host[s]["w"], np.asarray(p2[s]["w"])
+        )
+
+    # a NaN loss with finite grads also trips, and the streak grows
+    good2 = {s: {"w": jnp.full((4, 4), 0.1)} for s in (0, 1)}
+    _, _, _, gm, guard = opt.step_guarded(
+        p2, s2, good2, w, jnp.float32(np.nan), guard
+    )
+    assert float(gm["resilience/anomaly_streak"]) == 2.0
+    assert float(gm["resilience/anomaly_total"]) == 2.0
+
+
+def test_guarded_step_no_device_to_host_sync():
+    opt, params, states = _setup()
+    guard = opt.init_guard_state()
+    good = {s: {"w": jnp.full((4, 4), 0.1)} for s in (0, 1)}
+    w = jnp.float32(1.0)
+    # warmup compiles every jitted piece
+    params, states, _, gm, guard = opt.step_guarded(
+        params, states, good, w, jnp.float32(1.0), guard
+    )
+    jax.block_until_ready(gm["resilience/anomaly"])
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(2):
+            # fresh grads per step: the update donates its grad buffers
+            grads = {s: {"w": jnp.full((4, 4), 0.1)} for s in (0, 1)}
+            params, states, _, gm, guard = opt.step_guarded(
+                params, states, grads, w, jnp.float32(1.0), guard
+            )
+    jax.block_until_ready(gm["resilience/anomaly"])

@@ -1,0 +1,62 @@
+"""Watchdog exit contract: a missed heartbeat flushes telemetry (with a
+final resilience/watchdog_timeout event in the JSONL log) and hard-exits
+with the configured code — pinned in a subprocess, since os._exit is
+not mockable from inside."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+_SCRIPT = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from d9d_tpu.loop.components.timeout_manager import TimeoutManager
+from d9d_tpu.telemetry import JsonlSink, get_telemetry
+
+tele = get_telemetry()
+tele.add_sink(JsonlSink({out!r}, run_name="watchdog", process_index=0))
+tele.set_step(7)
+with TimeoutManager(init_timeout_s=0.2, step_timeout_s=0.2, exit_code=77):
+    time.sleep(30)  # no heartbeat: the watchdog must kill us first
+print("UNREACHABLE")
+sys.exit(0)
+"""
+
+
+def test_watchdog_exits_with_configured_code_and_flushes(tmp_path):
+    repo = str(pathlib.Path(__file__).resolve().parents[2])
+    script = _SCRIPT.format(repo=repo, out=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 77, (proc.stdout, proc.stderr)
+    assert "UNREACHABLE" not in proc.stdout
+    assert "watchdog timeout" in proc.stderr
+
+    log = tmp_path / "watchdog_proc0.jsonl"
+    assert log.exists(), "watchdog exit must leave a flushed JSONL log"
+    events = [json.loads(line) for line in log.read_text().splitlines()]
+    spans = [e for e in events if e.get("kind") == "span"]
+    assert any(
+        e["name"] == "resilience/watchdog_timeout"
+        and e.get("meta", {}).get("exit_code") == 77
+        and e.get("step") == 7
+        for e in spans
+    )
+    flushes = [e for e in events if e.get("kind") == "flush"]
+    assert flushes and flushes[-1]["counters"].get(
+        "resilience/watchdog_timeout"
+    ) == 1.0
+
+
+def test_exit_code_knob_defaults():
+    from d9d_tpu.loop.components.timeout_manager import TimeoutManager
+    from d9d_tpu.resilience import EXIT_WATCHDOG
+
+    assert TimeoutManager().exit_code == EXIT_WATCHDOG == 42

@@ -42,6 +42,7 @@ def test_bench_tiny_runs(devices, tmp_path, monkeypatch):
     )
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_pp_tiny_runs(devices):
     """tools/bench_pp.py (schedule × residual-policy microbench) must keep
     working against the PipelineTrainEngine API."""
@@ -102,6 +103,7 @@ def test_bench_moe_tiny_runs(devices):
     assert 0 <= result["detail"]["mfu"] <= result["detail"]["hfu"] + 1e-9
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_kernels_tiny_runs(devices):
     import subprocess
 
@@ -142,6 +144,7 @@ def test_bench_generate_tiny_runs(devices):
     assert result["detail"]["new_tokens"] == 8
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_hybrid_tiny_runs(devices):
     """run_bench_moe(hybrid=True): the Qwen3-Next/GDN family's bench row
     (BASELINE config 5) stays runnable on the CPU rig."""
@@ -168,6 +171,7 @@ def test_bench_serving_tiny_runs(devices):
     )
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_serve_tool_tiny_runs(devices, tmp_path):
     """tools/bench_serve.py: the CPU serving microbench end-to-end —
     every mode must emit identical tokens, the summary must report the
@@ -203,6 +207,7 @@ def test_bench_serve_tool_tiny_runs(devices, tmp_path):
         assert e["histograms"]["serve/queue_wait_s"]["count"] > 0
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_pp_overhead_tiny_runs(devices):
     """tools/bench_pp_overhead.py: the executor dispatch-overhead A/B
     (VERDICT r5 Weak #3) stays runnable; the naive re-dispatch loop must
