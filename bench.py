@@ -771,6 +771,10 @@ def run_bench_serving(*, tiny: bool = False) -> dict:
             "per_token_dispatches_per_1k_tokens": round(
                 per_tok["dispatches_per_1k_tokens"], 2
             ),
+            # introspection columns (telemetry/introspect.py): a warmed
+            # steady-state serving loop must not compile at all
+            "steady_state_compiles": fused["steady_state_compiles"],
+            "recompiles": fused["recompiles"],
             "speedup_vs_per_token": round(
                 fused["tok_per_s"] / max(per_tok["tok_per_s"], 1e-9), 3
             ),

@@ -18,6 +18,9 @@ import jax
 
 __all__ = [
     "HAS_MODERN_JAX",
+    "compiled_cost_analysis",
+    "compiled_memory_analysis",
+    "device_hbm_capacity",
     "get_abstract_mesh",
     "mesh_axis_types_kwargs",
     "set_mesh",
@@ -76,6 +79,76 @@ def get_abstract_mesh():
     if hasattr(jax.sharding, "get_abstract_mesh"):
         return jax.sharding.get_abstract_mesh()
     return _AMBIENT[0]
+
+
+# -- compiled-executable introspection (telemetry/introspect.py) --------
+#
+# The AOT surface is stable (`Lowered.compile()` → `Compiled`), but what
+# the *backend* returns from cost/memory analysis varies: lists vs dicts
+# across jax versions, None on backends without the C++ implementation,
+# and attribute-less stubs on some plugins. Normalize here so the
+# introspection layer never has to version-switch.
+
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "generated_code_size_in_bytes",
+    "alias_size_in_bytes",
+)
+
+
+def compiled_cost_analysis(compiled) -> dict | None:
+    """``Compiled.cost_analysis()`` normalized to one flat dict (or None
+    when the backend declines). Older runtimes return a one-element list
+    of dicts; newer ones return the dict directly."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend without the analysis
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not ca:
+        return None
+    try:
+        return {str(k): float(v) for k, v in dict(ca).items()}
+    except Exception:  # noqa: BLE001 — unexpected shape: treat as absent
+        return None
+
+
+def compiled_memory_analysis(compiled) -> dict | None:
+    """``Compiled.memory_analysis()`` as ``{field: int bytes}`` over the
+    standard CompiledMemoryStats size fields, or None when the backend
+    returns nothing useful (all-absent attrs count as nothing)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend without the analysis
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for field in _MEMORY_FIELDS:
+        v = getattr(ma, field, None)
+        if v is not None:
+            try:
+                out[field] = int(v)
+            except (TypeError, ValueError):
+                continue
+    return out or None
+
+
+def device_hbm_capacity() -> int | None:
+    """Per-chip accelerator memory capacity in bytes (``bytes_limit``
+    from the device's memory stats), or None where the backend exposes
+    none (CPU rigs) — callers skip the budget gauge then."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — backend not initialized/available
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,

@@ -90,6 +90,17 @@ class TrainerConfig(pydantic.BaseModel):
     telemetry_console: bool = True
     telemetry_console_interval_s: float = 30.0
 
+    # device-side introspection (telemetry/introspect.py): the recompile
+    # guard arms after this many steps of the CURRENT train() session —
+    # by then every legitimate signature (ragged last microbatch, both
+    # fused-serve variants) has compiled, so any later compile is a
+    # silent steady-state recompile worth a counter + warning
+    introspect_warmup_steps: int = pydantic.Field(default=2, ge=1)
+    # |model-FLOPs − XLA cost_analysis FLOPs| / model above this logs a
+    # warning; the flops/model_vs_xla_divergence gauge is always set
+    # when both sides are known
+    flops_divergence_tolerance: float = pydantic.Field(default=0.25, gt=0)
+
 
 class InferenceConfig(pydantic.BaseModel):
     model_config = pydantic.ConfigDict(extra="forbid")

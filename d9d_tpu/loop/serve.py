@@ -81,7 +81,7 @@ import numpy as np
 
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import Array
-from d9d_tpu.telemetry import get_telemetry
+from d9d_tpu.telemetry import get_telemetry, tracked_jit
 
 # slot-occupancy fraction per chunk/step: 20 linear bins over [0, 1]
 _UTIL_EDGES = tuple(i / 20 for i in range(21))
@@ -360,7 +360,9 @@ class ContinuousBatcher:
         # and each distinct fused K compiles its own scan
         self._step = None
         self._fused: dict[tuple[int, bool], object] = {}  # (k, with_admit)
-        self._reset = jax.jit(_zero_row, donate_argnums=0)
+        self._reset = tracked_jit(
+            _zero_row, name="serve/reset_row", donate_argnums=0
+        )
         self._cache = self._init_cache()
 
         # fused-mode device carries (one buffer each, donated through)
@@ -424,7 +426,7 @@ class ContinuousBatcher:
         # donate the cache: XLA aliases input buffers to outputs, so the
         # per-step update is in place — no second cache residency or
         # full-cache memcpy per token
-        return jax.jit(step_fn, donate_argnums=0)
+        return tracked_jit(step_fn, name="serve/step", donate_argnums=0)
 
     def _build_fused(self, k: int, with_admit: bool):
         """Compile one fused K-step executable. ``with_admit`` variants
@@ -481,7 +483,11 @@ class ContinuousBatcher:
             # host fetches in ONE readback per chunk
             return cache, tok, pos, live, rem, jnp.moveaxis(toks, 0, 1)
 
-        return jax.jit(fused_fn, donate_argnums=(0, 1, 2, 3, 4))
+        return tracked_jit(
+            fused_fn,
+            name=f"serve/fused_k{k}" + ("_admit" if with_admit else ""),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
 
     # ------------------------------------------------------------------
     def submit(

@@ -41,6 +41,7 @@ import numpy as np
 
 from d9d_tpu.core.types import Array
 from d9d_tpu.nn.decode_flags import continuation_chunk
+from d9d_tpu.telemetry import tracked_jit
 
 
 def _assert_rewindable(cache) -> None:
@@ -180,7 +181,9 @@ def speculative_generate(
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, 1+k]
         return state["cache"], d_cache, proposals, greedy
 
-    spec_round = jax.jit(round_fn, donate_argnums=(0, 1))
+    spec_round = tracked_jit(
+        round_fn, name="serve/spec_round", donate_argnums=(0, 1)
+    )
 
     # first committed token: target's own greedy continuation of the
     # prompt (not yet fed to either cache)

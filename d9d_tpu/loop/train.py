@@ -56,7 +56,9 @@ from d9d_tpu.telemetry import (
     JsonlSink,
     TrackerBridge,
     get_telemetry,
+    recompile_guard,
 )
+from d9d_tpu.telemetry.introspect import executable_flops
 from d9d_tpu.telemetry.flops import (
     active_param_count,
     device_peak_flops,
@@ -233,6 +235,9 @@ class Trainer:
         # mesh's peak (per-chip peak x mesh size), matching bench.py's
         # single-chip convention at mesh size 1
         self._peak_flops = device_peak_flops() * int(ctx.mesh.devices.size)
+        # once-per-process flag for the model-vs-XLA FLOPs cross-check
+        # (telemetry/introspect.py inventory vs the roofline convention)
+        self._flops_divergence_checked = False
         self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
 
     # -- live-MFU inputs (telemetry/flops.py roofline convention) ------
@@ -254,6 +259,44 @@ class Trainer:
             rt = self.pp_engine.stages.get(0)
             return getattr(rt.module, "config", None) if rt else None
         return getattr(self.module, "config", None)
+
+    def _note_flops_divergence(self) -> None:
+        """Cross-check the roofline FLOPs inventory (telemetry/flops.py,
+        the live-MFU convention) against XLA's own cost analysis of the
+        compiled train step. A large gap means the MFU gauge is lying —
+        the model inventory drifted from what actually runs (missed
+        attention term, uncounted recompute) — so it gets a gauge
+        (``flops/model_vs_xla_divergence``, signed, relative) and a
+        warning past the configured tolerance. Non-PP only: under PP
+        the step is many per-action executables, not one program."""
+        if self._flops_divergence_checked or self.pp_engine is not None:
+            return
+        xla = executable_flops("train_step")
+        if xla is None or xla <= 0:
+            return  # backend declined cost analysis, or tracked_jit degraded
+        # Two normalizations to compare like with like: cost_analysis
+        # describes the PER-DEVICE SPMD program (the model inventory
+        # counts the whole mesh), and XLA's static analysis counts the
+        # microbatch lax.scan body ONCE, not x trip-count — so the
+        # comparable model term is per-device, per-microbatch.
+        model = (
+            self._flops_per_token * self._tokens_per_step
+            / max(int(self.ctx.mesh.devices.size), 1)
+            / max(self.batch_maths.num_microbatches, 1)
+        )
+        if model <= 0:
+            return
+        divergence = (xla - model) / model
+        self.telemetry.gauge("flops/model_vs_xla_divergence").set(divergence)
+        self._flops_divergence_checked = True
+        if abs(divergence) > self.config.flops_divergence_tolerance:
+            logger.warning(
+                "model-FLOPs inventory diverges from XLA cost analysis by "
+                "%+.1f%% (model %.3e vs XLA %.3e FLOPs/step): the MFU "
+                "gauge inherits this error — check telemetry/flops.py's "
+                "inventory against the model geometry",
+                100 * divergence, model, xla,
+            )
 
     # ------------------------------------------------------------------
 
@@ -408,6 +451,11 @@ class Trainer:
             else self.config.log_every
         )
         last_tele_flush = None  # step of the loop's most recent flush
+        # silent-recompile guard: re-arm for this session — every
+        # legitimate signature compiles within the warmup steps, after
+        # which any compile is a flagged steady-state recompile
+        guard = recompile_guard()
+        guard.configure(self.config.introspect_warmup_steps)
         try:
             self.data_loader = self.dataset_provider.build()
             self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
@@ -496,6 +544,7 @@ class Trainer:
                     step = self.stepper.advance()
                     session_steps += 1
                     steps_since_sync += 1
+                    guard.note_step(session_steps)
                     self.profiler.step_end(step - 1)
                     self.gc.step(step)
                     clock.mark("host_dispatch")
@@ -585,6 +634,7 @@ class Trainer:
                             )
                         tele_sync_t0 = now
                         steps_since_sync = 0
+                        self._note_flops_divergence()
                     clock.mark("metric_flush")
                     if guard_action == "ok":
                         # never persist state the guard flagged: under a

@@ -33,6 +33,7 @@ from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
 from d9d_tpu.resilience.anomaly import ANOMALY_POLICIES
+from d9d_tpu.telemetry import tracked_jit
 
 
 @dataclasses.dataclass
@@ -220,10 +221,20 @@ def build_train_step(
             [streak, total]
         )
 
+    # tracked_jit (telemetry/introspect.py): same single dispatch per
+    # call, plus compile/train_step spans, the steady-state recompile
+    # guard, and the per-executable FLOPs/HBM inventory the MFU
+    # cross-check reads
     if anomaly_policy is None:
-        jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        jitted = tracked_jit(
+            step, name="train_step",
+            donate_argnums=(0, 1) if donate else (),
+        )
         return TrainStepFn(fn=jitted)
-    jitted = jax.jit(step, donate_argnums=(0, 1, 4) if donate else ())
+    jitted = tracked_jit(
+        step, name="train_step",
+        donate_argnums=(0, 1, 4) if donate else (),
+    )
     return TrainStepFn(fn=jitted, guarded=True)
 
 
@@ -250,4 +261,4 @@ def build_eval_step(
         )
         return loss_sum / jnp.maximum(weight_sum, 1e-8)
 
-    return jax.jit(step)
+    return tracked_jit(step, name="eval_step")

@@ -39,7 +39,7 @@ from typing import Any
 import jax
 
 from d9d_tpu.core.tracing import annotate
-from d9d_tpu.telemetry import get_telemetry
+from d9d_tpu.telemetry import get_telemetry, tracked_jit
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.program.actions import (
     Action,
@@ -260,7 +260,7 @@ class PipelineScheduleExecutor:
                 structures = {jax.tree.structure(a) for a in st.aux}
                 if len(structures) == 1:
                     if self._sum_aux is None:
-                        self._sum_aux = jax.jit(
+                        self._sum_aux = tracked_jit(
                             lambda auxes: jax.tree.reduce(
                                 lambda a, b: jax.tree.map(
                                     lambda x, y: x + y, a, b
@@ -268,7 +268,8 @@ class PipelineScheduleExecutor:
                                 auxes,
                                 is_leaf=lambda t: isinstance(t, tuple)
                                 and len(t) == 3,
-                            )
+                            ),
+                            name="pp/loss_sum",
                         )
                     loss_sum, weight_sum, metrics_sum = self._sum_aux(st.aux)
                 else:

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from d9d_tpu.core import compat
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.stage_info import PipelineStageInfo
+from d9d_tpu.telemetry import tracked_jit
 
 __all__ = ["PipelineStageRuntime", "StageTask"]
 
@@ -133,17 +134,27 @@ class PipelineStageRuntime:
 
             return wrapped
 
-        self._fwd = jax.jit(scoped("fwd", self._fwd_impl))
-        self._fwd_loss = jax.jit(scoped("fwd_loss", self._fwd_loss_impl))
-        self._fwd_out = jax.jit(scoped("fwd_out", self._fwd_out_impl))
-        self._bwd_full = jax.jit(scoped("bwd", self._bwd_full_impl))
-        self._bwd_input = jax.jit(scoped("bwd_dI", self._bwd_input_impl))
-        self._bwd_weight = jax.jit(scoped("bwd_dW", self._bwd_weight_impl))
-        self._acc = jax.jit(
-            scoped("grad_acc", _tree_add), donate_argnums=(0,)
+        # tracked_jit: each per-action executable gets compile-span /
+        # recompile-guard / HBM-inventory accounting under its stage-
+        # scoped name (telemetry/introspect.py); dispatch count per
+        # action is unchanged
+        sid = self.info.stage_index
+
+        def tjit(label, fn, **kw):
+            return tracked_jit(fn, name=f"pp_s{sid}/{label}", **kw)
+
+        self._fwd = tjit("fwd", scoped("fwd", self._fwd_impl))
+        self._fwd_loss = tjit("fwd_loss", scoped("fwd_loss", self._fwd_loss_impl))
+        self._fwd_out = tjit("fwd_out", scoped("fwd_out", self._fwd_out_impl))
+        self._bwd_full = tjit("bwd", scoped("bwd", self._bwd_full_impl))
+        self._bwd_input = tjit("bwd_dI", scoped("bwd_dI", self._bwd_input_impl))
+        self._bwd_weight = tjit("bwd_dW", scoped("bwd_dW", self._bwd_weight_impl))
+        self._acc = tjit(
+            "grad_acc", scoped("grad_acc", _tree_add), donate_argnums=(0,)
         )
-        self._cast = jax.jit(
-            lambda g: jax.tree.map(lambda x: x.astype(self.grad_dtype), g)
+        self._cast = tjit(
+            "cast_grads",
+            lambda g: jax.tree.map(lambda x: x.astype(self.grad_dtype), g),
         )
         if self.residual_policy not in ("remat", "cache_full", "cache_acts"):
             raise ValueError(
@@ -154,11 +165,11 @@ class PipelineStageRuntime:
         # executor always runs I before W for a (stage, mb), so the first
         # W trace for any signature finds its record)
         self._acts_records = {}
-        self._bwd_input_acts = jax.jit(
-            scoped("bwd_dI_acts", self._bwd_input_acts_impl)
+        self._bwd_input_acts = tjit(
+            "bwd_dI_acts", scoped("bwd_dI_acts", self._bwd_input_acts_impl)
         )
-        self._bwd_weight_acts = jax.jit(
-            scoped("bwd_dW_acts", self._bwd_weight_acts_impl)
+        self._bwd_weight_acts = tjit(
+            "bwd_dW_acts", scoped("bwd_dW_acts", self._bwd_weight_acts_impl)
         )
 
     # ---- forward ---------------------------------------------------------

@@ -68,6 +68,8 @@ __all__ = [
     "model_flops_per_token",
     "active_param_count",
     "device_peak_flops",
+    "tracked_jit",
+    "recompile_guard",
 ]
 
 
@@ -140,6 +142,13 @@ class Telemetry:
         for sink in self.sinks:
             sink.on_span(span)
 
+    def record_executable(self, record: dict[str, Any]) -> None:
+        """Stream one per-executable introspection record (compile cost,
+        FLOPs, HBM breakdown — telemetry/introspect.py) to every sink as
+        a schema-v2 ``executable`` event."""
+        for sink in self.sinks:
+            sink.on_executable(record)
+
     def flush(self, step: int | None = None) -> dict[str, Any]:
         """Snapshot every instrument and hand it to each sink; returns
         the snapshot (callers fold headline values into their own logs)."""
@@ -173,6 +182,15 @@ def set_telemetry(hub: Telemetry) -> Telemetry:
     with _default_lock:
         _default = hub
     return hub
+
+
+# imported AFTER get_telemetry exists: introspect records through the hub
+# (deferred inside its methods), and re-exporting here keeps the public
+# surface one import wide
+from d9d_tpu.telemetry.introspect import (  # noqa: E402
+    recompile_guard,
+    tracked_jit,
+)
 
 
 @contextlib.contextmanager

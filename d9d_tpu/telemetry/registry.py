@@ -43,7 +43,9 @@ __all__ = [
 
 # JSONL event-log schema version (docs/design/observability.md) — bump on
 # any breaking change to event shapes emitted by sinks.JsonlSink.
-SCHEMA_VERSION = 1
+# v2: adds the ``executable`` event kind (per-executable compile/HBM/FLOPs
+# records from telemetry/introspect.py); v1 files remain readable.
+SCHEMA_VERSION = 2
 
 
 def exp_edges(lo: float, hi: float, bins: int) -> tuple[float, ...]:

@@ -48,6 +48,8 @@ class TelemetrySink:
 
     def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None: ...
 
+    def on_executable(self, record: dict[str, Any]) -> None: ...
+
     def close(self) -> None: ...
 
 
@@ -111,6 +113,12 @@ class JsonlSink(TelemetrySink):
         if span.meta:
             ev["meta"] = span.meta
         self._write(ev)
+
+    def on_executable(self, record: dict[str, Any]) -> None:
+        # compiles are rare and expensive — flush immediately so a crash
+        # right after a multi-minute compile still leaves its record
+        self._write({"kind": "executable", **record})
+        self._fh.flush()
 
     def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
         self._file()  # ensure the meta header exists even for span-free runs
@@ -232,21 +240,28 @@ _REQUIRED = {
     "meta": ("schema", "process_index"),
     "span": ("name", "t0", "dur_s"),
     "flush": ("step", "counters", "gauges", "histograms"),
+    "executable": ("name", "signature", "lower_s", "compile_s"),
 }
 
 
 def validate_event(event: dict[str, Any]) -> None:
-    """Raise ``ValueError`` if ``event`` is not a well-formed schema-v1
-    telemetry event (the contract bench harness tests pin)."""
+    """Raise ``ValueError`` if ``event`` is not a well-formed telemetry
+    event (the contract bench harness tests pin). Files written by any
+    schema version up to the current one stay readable — v2 only added
+    the ``executable`` kind, which a v1 file simply never contains."""
     kind = event.get("kind")
     if kind not in _REQUIRED:
         raise ValueError(f"unknown event kind {kind!r}")
     missing = [k for k in _REQUIRED[kind] if k not in event]
     if missing:
         raise ValueError(f"{kind} event missing fields {missing}")
-    if kind == "meta" and event["schema"] != SCHEMA_VERSION:
+    if kind == "meta" and not (
+        isinstance(event["schema"], int)
+        and 1 <= event["schema"] <= SCHEMA_VERSION
+    ):
         raise ValueError(
-            f"schema {event['schema']} != supported {SCHEMA_VERSION}"
+            f"schema {event['schema']} not in supported range "
+            f"[1, {SCHEMA_VERSION}]"
         )
     if kind == "span" and not (
         isinstance(event["dur_s"], (int, float)) and event["dur_s"] >= 0

@@ -1,0 +1,242 @@
+"""Cross-process Perfetto export: merge telemetry JSONL event logs into
+one Chrome-trace file.
+
+Each process writes its own JSONL event log (``JsonlSink``, one file per
+process) with span timestamps on its *private* monotonic clock
+(``perf_counter`` origin). The file's ``meta`` header records the
+``(unix_time, perf_counter)`` pair sampled at open, which is exactly the
+rebasing constant needed to place every span on a shared wall clock:
+
+    wall(t) = meta.unix_time + (t - meta.perf_counter)
+
+This module reads any number of such files, rebases them onto the
+earliest meta wall time across the set, and emits one Chrome-trace /
+Perfetto JSON (``{"traceEvents": [...]}``) in which:
+
+- **spans** become ``"X"`` duration events, one *track* (tid) per span
+  namespace (``name.rsplit('/', 1)[0]`` — so ``train/phase/*`` phases,
+  ``train/step``, ``pp/*``, ``io/*`` and ``compile/*`` each get their
+  own lane) under one *process* (pid) per input file;
+- **flush** counters and gauges become ``"C"`` counter events at the
+  flush's own ``unix_time`` (counter names carry the metric namespace);
+- **executable** records (telemetry/introspect.py) become ``"i"``
+  instant events on the ``compile`` track with the FLOPs/HBM payload in
+  ``args``, so a recompile shows up as a visible pin on the timeline;
+- process/thread ``"M"`` metadata events name every lane.
+
+The output ordering is deterministic (sorted by timestamp, then pid,
+tid, name) so two exports of the same logs are byte-identical — tests
+and diff-based tooling rely on that.
+
+Load the result at https://ui.perfetto.dev or chrome://tracing; with
+per-stage PP tracks and the serve admission/dispatch spans side by side,
+stage bubbles and admission stalls become one visually inspectable
+timeline — the observable the MPMD-pipeline work (PAPERS.md,
+arxiv 2412.14374) tunes against.
+
+Pure host Python: no jax anywhere (importable by offline tooling).
+"""
+
+import json
+import logging
+import re
+from pathlib import Path
+from typing import Any, Iterable
+
+from d9d_tpu.telemetry.sinks import validate_event
+
+__all__ = [
+    "discover_jsonl",
+    "export_perfetto",
+    "merge_to_chrome_trace",
+]
+
+logger = logging.getLogger("d9d_tpu.telemetry.trace_export")
+
+_PROC_RE = re.compile(r"_proc(\d+)\.jsonl$")
+
+
+def _read_events_lenient(path: Path) -> list[dict[str, Any]]:
+    """Validated events from one log, tolerating the tail a crashed
+    process leaves: JsonlSink buffers span writes between flushes, so a
+    killed rank's file typically ends mid-line — a post-mortem merge
+    must read everything BEFORE the damage, not die on it. Malformed
+    trailing lines are dropped with a warning; damage to the first
+    (meta) line is still fatal, since nothing can be aligned without
+    the clock pair."""
+    events: list[dict[str, Any]] = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+                validate_event(event)
+            except ValueError as e:
+                if i == 0:
+                    raise ValueError(
+                        f"{path}: unreadable meta header: {e}"
+                    ) from e
+                logger.warning(
+                    "%s: dropping malformed line %d (truncated by a "
+                    "crash?): %s", path, i + 1, e,
+                )
+                break
+            events.append(event)
+    if not events or events[0].get("kind") != "meta":
+        raise ValueError(f"{path}: no meta header — not a telemetry log")
+    return events
+
+
+def discover_jsonl(path: str | Path) -> list[Path]:
+    """Telemetry JSONL files at ``path``: the file itself, or every
+    ``*.jsonl`` directly under a directory, sorted for determinism."""
+    p = Path(path)
+    if p.is_file():
+        return [p]
+    return sorted(p.glob("*.jsonl"))
+
+
+def _track_of(span_name: str) -> str:
+    """Track (thread lane) for a span: its namespace — everything before
+    the last path component. ``train/phase/data_wait`` → ``train/phase``
+    (so the enclosing ``train/step`` span sits on its own ``train`` lane
+    instead of fighting the phases for nesting), ``pp/s3/bwd`` →
+    ``pp/s3``, ``compile/train_step`` → ``compile``."""
+    if "/" in span_name:
+        return span_name.rsplit("/", 1)[0]
+    return span_name
+
+
+def merge_to_chrome_trace(paths: Iterable[str | Path]) -> dict[str, Any]:
+    """Merge telemetry JSONL files into one Chrome-trace dict.
+
+    Each file becomes one trace process; its pid is the file's recorded
+    ``process_index`` where unique across the set, else its position in
+    the sorted input (two single-process runs merged side by side must
+    not collide)."""
+    paths = [Path(p) for p in paths]
+    if not paths:
+        raise ValueError("no telemetry JSONL files to merge")
+
+    loaded = []  # (path, meta, events)
+    for path in paths:
+        events = _read_events_lenient(path)
+        meta = events[0]
+        if "perf_counter" not in meta or "unix_time" not in meta:
+            raise ValueError(
+                f"{path}: meta header lacks the unix_time/perf_counter "
+                "clock pair needed for cross-process alignment"
+            )
+        loaded.append((path, meta, events[1:]))
+
+    indices = [m.get("process_index", 0) for _, m, _ in loaded]
+    unique = len(set(indices)) == len(indices)
+    origin = min(m["unix_time"] - m["perf_counter"] for _, m, _ in loaded)
+    t0_wall = min(m["unix_time"] for _, m, _ in loaded)
+
+    trace_events: list[dict[str, Any]] = []
+    meta_events: list[dict[str, Any]] = []
+    for slot, (path, meta, events) in enumerate(loaded):
+        pid = meta.get("process_index", 0) if unique else slot
+        # this process's perf_counter → shared-wall-µs rebase
+        epoch = meta["unix_time"] - meta["perf_counter"]
+
+        def wall_us(perf_t: float) -> float:
+            return (epoch + perf_t - t0_wall) * 1e6
+
+        meta_events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": f"proc{pid} ({path.stem})"},
+        })
+        tids: dict[str, int] = {}
+        tracks: list[str] = []
+
+        def tid_of(track: str) -> int:
+            tid = tids.get(track)
+            if tid is None:
+                tid = tids[track] = len(tids) + 1
+                tracks.append(track)
+            return tid
+
+        for ev in events:
+            kind = ev["kind"]
+            if kind == "span":
+                args: dict[str, Any] = {}
+                if "step" in ev:
+                    args["step"] = ev["step"]
+                if ev.get("meta"):
+                    args.update(ev["meta"])
+                trace_events.append({
+                    "ph": "X", "pid": pid,
+                    "tid": tid_of(_track_of(ev["name"])),
+                    "ts": wall_us(ev["t0"]),
+                    "dur": ev["dur_s"] * 1e6,
+                    "name": ev["name"], "cat": "span",
+                    **({"args": args} if args else {}),
+                })
+            elif kind == "flush":
+                # flush carries its own wall clock — no rebase needed
+                ts = (ev.get("unix_time", t0_wall) - t0_wall) * 1e6
+                series = dict(ev.get("counters", {}))
+                series.update(ev.get("gauges", {}))
+                for name, value in series.items():
+                    if value is None:
+                        continue
+                    trace_events.append({
+                        "ph": "C", "pid": pid, "tid": 0, "ts": ts,
+                        "name": name, "cat": "counter",
+                        "args": {"value": value},
+                    })
+            elif kind == "executable":
+                # no per-event timestamp: pin to the compile span's lane
+                # at the file's own meta time + accumulated order is not
+                # recoverable — use the meta wall time so the pins sit at
+                # the run's start unless a matching compile span exists
+                trace_events.append({
+                    "ph": "i", "pid": pid, "tid": tid_of("compile"),
+                    "ts": (meta["unix_time"] - t0_wall) * 1e6,
+                    "name": f"executable:{ev['name']}",
+                    "cat": "executable", "s": "t",
+                    "args": {
+                        k: v for k, v in ev.items() if k != "kind"
+                    },
+                })
+        for track in sorted(tracks):
+            meta_events.append({
+                "ph": "M", "pid": pid, "tid": tids[track],
+                "name": "thread_name", "args": {"name": track},
+            })
+
+    # deterministic, stable ordering: two exports of the same logs are
+    # byte-identical (metadata first, then events by time/identity)
+    trace_events.sort(
+        key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"])
+    )
+    meta_events.sort(
+        key=lambda e: (e["pid"], e["name"], e.get("tid", 0))
+    )
+    return {
+        "traceEvents": meta_events + trace_events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "source": "d9d_tpu.telemetry.trace_export",
+            "origin_unix_time": t0_wall,
+            "clock_origin": origin,
+            "processes": len(loaded),
+        },
+    }
+
+
+def export_perfetto(
+    paths: Iterable[str | Path], out_path: str | Path
+) -> dict[str, Any]:
+    """Merge ``paths`` and write the Chrome-trace JSON to ``out_path``;
+    returns the trace dict (callers report event counts)."""
+    trace = merge_to_chrome_trace(paths)
+    out = Path(out_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    with open(out, "w") as fh:
+        json.dump(trace, fh, sort_keys=True)
+    return trace

@@ -56,8 +56,19 @@ def test_chunked_matches_recurrent(chunk_size, l2norm):
     o_c, s_c = gated_delta_rule_chunked(
         q, k, v, g, beta, use_qk_l2norm=l2norm, chunk_size=chunk_size
     )
-    np.testing.assert_allclose(np.asarray(o_c), np.asarray(o_r), atol=2e-5)
-    np.testing.assert_allclose(np.asarray(s_c), np.asarray(s_r), atol=2e-5)
+    # rtol covers large-magnitude elements: at chunk_size=64 > t=37 the
+    # whole sequence is one chunk, and the WY-form matmul accumulation
+    # order diverges maximally from the scan recurrence — measured worst
+    # case (l2norm=False): |Δ|=2.31e-5 on O(1) outputs at rel 5.96e-6,
+    # i.e. pure fp32 summation-order noise, not an algorithmic error
+    # (pre-PR-6 this was tier-1's single standing failure: atol-only
+    # 2e-5 sat below the observed 2.31e-5)
+    np.testing.assert_allclose(
+        np.asarray(o_c), np.asarray(o_r), rtol=1e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_c), np.asarray(s_r), rtol=1e-5, atol=2e-5
+    )
 
 
 def test_chunked_grads_match_recurrent():

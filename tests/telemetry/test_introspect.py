@@ -1,0 +1,212 @@
+"""Device-side introspection (telemetry/introspect.py): tracked_jit
+compile accounting, the steady-state recompile guard, the executable
+inventory, and the trainer-level FLOPs cross-check + e2e pins.
+
+Quick tier except the tiny-train e2e at the bottom (still CPU-cheap —
+same micro config as test_train_telemetry.py)."""
+
+import logging
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from d9d_tpu.telemetry import (
+    Telemetry,
+    iter_events,
+    recompile_guard,
+    set_telemetry,
+    tracked_jit,
+)
+from d9d_tpu.telemetry import introspect
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    """Isolated hub + disarmed guard + clean inventory per test (the
+    guard and inventory are process-global by design)."""
+    hub = set_telemetry(Telemetry())
+    guard = recompile_guard()
+    guard.reset()
+    saved_warmup = guard.warmup_steps
+    introspect.reset_inventory()
+    yield hub
+    guard.reset()
+    guard.warmup_steps = saved_warmup
+    introspect.reset_inventory()
+
+
+def test_tracked_jit_records_compile_span_and_inventory(_fresh_hub):
+    hub = _fresh_hub
+    f = tracked_jit(lambda x, y: x @ y, name="unit/mm")
+    x = jnp.ones((8, 16))
+    y = jnp.ones((16, 4))
+    out1 = f(x, y)
+    out2 = f(x, y)  # same signature: no second compile
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+    records = [r for r in introspect.inventory() if r.name == "unit/mm"]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.calls == 2
+    assert not rec.recompile
+    assert rec.lower_s >= 0 and rec.compile_s >= 0
+    # XLA cost analysis on CPU reports the matmul FLOPs (2*M*N*K)
+    assert rec.flops == pytest.approx(2 * 8 * 16 * 4)
+    # memory analysis present on this backend: peak covers args+outputs
+    assert rec.hbm_peak_bytes is not None and rec.hbm_peak_bytes > 0
+
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["compile/count"] == 1
+    assert "compile/recompile" not in snap["counters"]
+    assert snap["gauges"]["hbm/unit/mm/peak_bytes"] == rec.hbm_peak_bytes
+    spans = [s for s in hub.registry.spans if s.name == "compile/unit/mm"]
+    assert len(spans) == 1
+    assert spans[0].meta["recompile"] is False
+
+
+def test_tracked_jit_matches_plain_jit_output(_fresh_hub):
+    def fn(x, y):
+        return jnp.sin(x) @ y + jnp.cos(y).sum()
+
+    tracked = tracked_jit(fn, name="unit/parity")
+    plain = jax.jit(fn)
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 6))
+    y = jax.random.normal(jax.random.PRNGKey(1), (6, 6))
+    np.testing.assert_allclose(
+        np.asarray(tracked(x, y)), np.asarray(plain(x, y)), rtol=1e-6
+    )
+
+
+def test_python_scalars_share_one_trace(_fresh_hub):
+    """Weak-typed host scalars must NOT fingerprint by value — jit
+    shares one executable across them, so tracked_jit must too."""
+    f = tracked_jit(lambda x, s: x * s, name="unit/scalar")
+    x = jnp.ones((4,))
+    f(x, 2.0)
+    f(x, 3.5)  # different value, same weak f32 signature
+    assert len(introspect.inventory()) == 1
+    assert introspect.inventory()[0].calls == 2
+
+
+def test_recompile_during_warmup_counts_but_does_not_warn(
+    _fresh_hub, caplog
+):
+    hub = _fresh_hub
+    f = tracked_jit(lambda x: x + 1, name="unit/warm")
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        f(jnp.ones((2,)))
+        f(jnp.ones((3,)))  # new shape, guard not steady
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["compile/recompiles_total"] == 1
+    assert "compile/recompile" not in snap["counters"]
+    assert not [r for r in caplog.records if "recompile" in r.message]
+
+
+def test_steady_state_recompile_fires_exactly_one_counter_and_warning(
+    _fresh_hub, caplog
+):
+    """The acceptance pin: a deliberate shape change after warmup fires
+    exactly one compile/recompile counter increment + one warning."""
+    hub = _fresh_hub
+    guard = recompile_guard()
+    guard.configure(warmup_steps=2)
+    f = tracked_jit(lambda x: (x * 2).sum(), name="unit/steady")
+    f(jnp.ones((4, 4)))
+    guard.note_step(1)
+    f(jnp.ones((4, 4)))
+    guard.note_step(2)  # warmup over → steady
+    assert guard.steady
+
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        f(jnp.ones((8, 4)))  # deliberate shape change in steady state
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["compile/recompile"] == 1
+    assert snap["counters"]["compile/recompiles_total"] == 1
+    warnings = [
+        r for r in caplog.records
+        if "steady-state recompile" in r.message
+    ]
+    assert len(warnings) == 1
+    assert "unit/steady" in warnings[0].getMessage()
+    # repeat calls at the new signature: no further compiles or warnings
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        f(jnp.ones((8, 4)))
+    assert hub.registry.snapshot()["counters"]["compile/recompile"] == 1
+    assert not caplog.records
+
+
+def test_recompile_warning_rate_limited(_fresh_hub, caplog):
+    guard = recompile_guard()
+    guard.warn_every_s = 3600.0
+    guard.mark_steady()
+    f = tracked_jit(lambda x: x + 1, name="unit/rate")
+    f(jnp.ones((2,)))
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        f(jnp.ones((3,)))
+        f(jnp.ones((4,)))  # second recompile inside the warn window
+    snap = _fresh_hub.registry.snapshot()
+    assert snap["counters"]["compile/recompile"] == 2  # both counted
+    warnings = [
+        r for r in caplog.records
+        if "steady-state recompile" in r.message
+    ]
+    assert len(warnings) == 1  # only the first warns inside the window
+
+
+def test_fallback_on_aot_failure_keeps_function_working(
+    _fresh_hub, caplog, monkeypatch
+):
+    """A lower/compile failure must degrade to plain jit, not break the
+    call — introspection can never take down training."""
+    f = tracked_jit(lambda x: x * 3, name="unit/fallback")
+    monkeypatch.setattr(
+        f._jit, "lower",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+        raising=False,
+    )
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        out = f(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    assert f._fallback
+    assert introspect.inventory() == ()
+    assert any("falling back" in r.message for r in caplog.records)
+    # further calls stay on the jit path without retrying AOT
+    np.testing.assert_allclose(np.asarray(f(jnp.ones((5,)))), 3.0)
+
+
+def test_executable_event_streams_to_jsonl(tmp_path, _fresh_hub):
+    from d9d_tpu.telemetry import JsonlSink
+
+    hub = _fresh_hub
+    sink = hub.add_sink(
+        JsonlSink(tmp_path, run_name="intro", process_index=0)
+    )
+    f = tracked_jit(lambda x: x @ x, name="unit/jsonl")
+    f(jnp.ones((4, 4)))
+    hub.flush(step=0)
+    hub.remove_sink(sink)
+    (path,) = pathlib.Path(tmp_path).glob("*.jsonl")
+    events = list(iter_events(path))  # schema-validates every line (v2)
+    execs = [e for e in events if e["kind"] == "executable"]
+    assert len(execs) == 1
+    ev = execs[0]
+    assert ev["name"] == "unit/jsonl"
+    assert ev["lower_s"] >= 0 and ev["compile_s"] >= 0
+    assert ev["recompile"] is False
+    assert ev["flops"] == pytest.approx(2 * 4 * 4 * 4)
+    assert ev["hbm"]["peak"] > 0
+
+
+def test_inventory_reset_keeps_wrappers_compiled(_fresh_hub):
+    f = tracked_jit(lambda x: x + 1, name="unit/reset")
+    f(jnp.ones((2,)))
+    introspect.reset_inventory()
+    assert introspect.inventory() == ()
+    f(jnp.ones((2,)))  # cached executable: no new record
+    assert introspect.inventory() == ()
+    snap = _fresh_hub.registry.snapshot()
+    assert snap["counters"]["compile/count"] == 1

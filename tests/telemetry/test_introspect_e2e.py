@@ -1,0 +1,166 @@
+"""Introspection e2e on the CPU micro trainer (the acceptance pins):
+
+- a tiny train run emits ``compile/train_step`` spans + an
+  ``executable`` JSONL event, with ZERO recompile counters/warnings in
+  steady state;
+- a deliberate batch-shape change after warmup fires exactly one
+  ``compile/recompile`` counter + one warning;
+- the model-vs-XLA FLOPs cross-check gauge is set and small on the
+  dense micro config (both sides count the same 6N+attention program).
+"""
+
+import logging
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.e2e  # full (micro) training flow
+
+from d9d_tpu.core import MeshParameters
+from d9d_tpu.loop import (
+    AdamWProvider,
+    CausalLMTask,
+    DatasetProvider,
+    ModelProvider,
+    Trainer,
+    TrainerConfig,
+)
+from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+from d9d_tpu.ops.attention.eager import eager_sdpa
+from d9d_tpu.parallel import replicate_plan
+from d9d_tpu.telemetry import (
+    Telemetry,
+    iter_events,
+    recompile_guard,
+    set_telemetry,
+)
+from d9d_tpu.telemetry import introspect
+
+VOCAB = 64
+BATCH, SEQ, STEPS = 4, 16, 4
+
+
+class _Provider(ModelProvider):
+    cfg = Qwen3DenseConfig.tiny(vocab_size=VOCAB)
+
+    def build_module(self, stage):
+        return Qwen3DenseCausalLM(
+            config=self.cfg, sdpa=eager_sdpa, stage=stage, dtype=jnp.float32
+        )
+
+    def build_plan(self, ctx):
+        return replicate_plan(ctx)
+
+    def sample_inputs(self, batch_size, seq_len):
+        z = jnp.zeros((batch_size, seq_len), jnp.int32)
+        return (z, z, z)
+
+
+class _Data(DatasetProvider):
+    def build(self):
+        rng = np.random.RandomState(0)
+        for _ in range(STEPS):
+            yield {"input_ids": rng.randint(0, VOCAB, size=(BATCH, SEQ + 1))}
+
+
+def test_train_introspection_steady_state_and_recompile_pin(
+    tmp_path, caplog
+):
+    set_telemetry(Telemetry())
+    guard = recompile_guard()
+    guard.reset()
+    introspect.reset_inventory()
+    ctx = MeshParameters().build(jax.devices()[:1])
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=BATCH,
+            microbatch_size=BATCH,
+            seq_len=SEQ,
+            total_steps=STEPS,
+            log_every=2,
+            prefetch_batches=0,
+            introspect_warmup_steps=1,
+            telemetry_dir=str(tmp_path),
+            telemetry_every_steps=2,
+            telemetry_console=False,
+        ),
+        model_provider=_Provider(),
+        dataset_provider=_Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(weight_decay=0.0),
+    )
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        history = trainer.train()
+    assert len(history) >= 1
+
+    # steady state reached, zero recompiles, zero warnings
+    assert guard.steady
+    hub = trainer.telemetry
+    snap = hub.registry.snapshot()
+    assert "compile/recompile" not in snap["counters"]
+    assert not [
+        r for r in caplog.records if "recompile" in r.message
+    ]
+
+    # compile spans + inventory for the tracked train step
+    assert snap["counters"]["compile/count"] >= 1
+    step_records = [
+        r for r in introspect.inventory() if r.name == "train_step"
+    ]
+    assert len(step_records) == 1
+    assert step_records[0].calls == STEPS
+    assert step_records[0].flops is not None and step_records[0].flops > 0
+
+    # JSONL: compile span + schema-v2 executable event round-trip
+    (path,) = pathlib.Path(tmp_path).glob("*.jsonl")
+    events = list(iter_events(path))
+    span_names = {e["name"] for e in events if e["kind"] == "span"}
+    assert "compile/train_step" in span_names
+    execs = [
+        e for e in events
+        if e["kind"] == "executable" and e["name"] == "train_step"
+    ]
+    assert len(execs) == 1
+    assert execs[0]["hbm"]["peak"] > 0
+
+    # FLOPs cross-check: gauge set, and on this dense micro config the
+    # two conventions (6N+attention vs XLA cost analysis of the same
+    # program + AdamW) agree within the configured tolerance
+    div = snap["gauges"].get("flops/model_vs_xla_divergence")
+    assert div is not None
+    assert abs(div) < trainer.config.flops_divergence_tolerance, div
+
+    # --- the acceptance pin: deliberate shape change after warmup ----
+    caplog.clear()
+    rng = np.random.RandomState(1)
+    half = {"input_ids": rng.randint(0, VOCAB, size=(BATCH, SEQ // 2 + 1))}
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry.introspect"):
+        # shorter sequence → new abstract signature for the step
+        trainer.config.__dict__["seq_len"] = SEQ // 2
+        trainer._stage = None
+        metrics = trainer.step_fn(
+            trainer.params, trainer.opt_state,
+            _reshape_batch(trainer, half), jax.random.PRNGKey(0),
+        )
+    jax.block_until_ready(metrics[2]["loss"])
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["compile/recompile"] == 1
+    warnings = [
+        r for r in caplog.records
+        if "steady-state recompile" in r.message
+    ]
+    assert len(warnings) == 1
+    assert "train_step" in warnings[0].getMessage()
+    guard.reset()
+
+
+def _reshape_batch(trainer, raw):
+    """Microbatch-shaped CausalLM batch for a direct step_fn call."""
+    prepared = trainer.task.prepare_batch(raw)
+    return jax.tree.map(
+        lambda x: jnp.asarray(x)[None], prepared
+    )

@@ -1,5 +1,8 @@
 """Quick-tier unit coverage for the trace_summary attribution helpers
-(no jax, no subprocess — pure parsing)."""
+and the telemetry-JSONL → Perfetto merge (no jax, no subprocess — pure
+parsing)."""
+
+import json
 
 
 def test_trace_summary_attribution_helpers():
@@ -39,3 +42,197 @@ def test_trace_summary_attribution_helpers():
     ) == "ep/dispatch_a2a"
     assert ts.scope_of({"name": "jit(step)/train/optimizer/add"}) == "train/optimizer"
     assert ts.scope_of({"name": "copy.1"}) is None
+
+
+# -- telemetry-JSONL multi-process Perfetto merge -----------------------
+
+
+def _write_proc_log(path, *, process_index, unix_time, perf_counter,
+                    spans, counters=None):
+    """Synthetic JsonlSink file: meta header (the clock pair the merge
+    rebases on) + spans on that process's PRIVATE monotonic clock."""
+    events = [{
+        "kind": "meta", "schema": 2, "process_index": process_index,
+        "pid": 1000 + process_index, "unix_time": unix_time,
+        "perf_counter": perf_counter,
+    }]
+    for name, t0, dur_s, step in spans:
+        events.append({
+            "kind": "span", "name": name, "t0": t0, "dur_s": dur_s,
+            "step": step,
+        })
+    if counters:
+        events.append({
+            "kind": "flush", "step": 0, "unix_time": unix_time + 1.0,
+            "counters": counters, "gauges": {}, "histograms": {},
+        })
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return path
+
+
+def test_perfetto_merge_clock_aligns_offset_epochs(tmp_path):
+    """Two process logs whose monotonic epochs are wildly offset must
+    land on ONE wall-clock timeline: a span that happened 0.5 s after
+    proc0's meta and a span that happened 0.5 s after proc1's meta (at
+    the same wall time) must come out at the same trace timestamp."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    p0 = _write_proc_log(
+        tmp_path / "run_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=10.0,  # epoch: wall - 10
+        spans=[
+            ("pp/s0/fwd", 10.5, 0.2, 3),   # wall + 0.5
+            ("train/step", 11.0, 0.4, 3),  # wall + 1.0
+        ],
+        counters={"pp/s0/busy_total_s": 1.5},
+    )
+    p1 = _write_proc_log(
+        tmp_path / "run_proc1.jsonl", process_index=1,
+        unix_time=wall, perf_counter=987_654.0,  # offset private clock
+        spans=[("pp/s1/fwd", 987_654.5, 0.2, 3)],  # SAME wall + 0.5
+    )
+    trace = merge_to_chrome_trace([p0, p1])
+    evs = trace["traceEvents"]
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+
+    # clock alignment: both 0.5s-after-meta spans at the same trace ts
+    assert xs["pp/s0/fwd"]["ts"] == 500_000.0
+    assert xs["pp/s1/fwd"]["ts"] == 500_000.0
+    assert xs["train/step"]["ts"] == 1_000_000.0
+    assert xs["pp/s0/fwd"]["dur"] == 200_000.0
+    # process identity preserved, per-namespace tracks assigned
+    assert xs["pp/s0/fwd"]["pid"] == 0
+    assert xs["pp/s1/fwd"]["pid"] == 1
+    assert xs["pp/s0/fwd"]["args"]["step"] == 3
+    thread_names = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in evs if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert thread_names[(0, xs["pp/s0/fwd"]["tid"])] == "pp/s0"
+    assert thread_names[(1, xs["pp/s1/fwd"]["tid"])] == "pp/s1"
+    # counters ride along as counter events at the flush wall time
+    cs = [e for e in evs if e["ph"] == "C"]
+    assert cs and cs[0]["name"] == "pp/s0/busy_total_s"
+    assert cs[0]["ts"] == 1_000_000.0
+    assert cs[0]["args"]["value"] == 1.5
+
+
+def test_perfetto_merge_is_deterministic_and_stably_ordered(tmp_path):
+    """Same inputs → byte-identical output, with events sorted by
+    (ts, pid, tid, name) after the metadata block — diff-based tooling
+    and golden tests rely on stable ordering."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    # deliberately interleaved + identical timestamps across processes
+    p0 = _write_proc_log(
+        tmp_path / "a_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0,
+        spans=[("serve/b", 2.0, 0.1, None), ("serve/a", 2.0, 0.1, None),
+               ("io/save", 1.0, 0.5, None)],
+    )
+    p1 = _write_proc_log(
+        tmp_path / "a_proc1.jsonl", process_index=1,
+        unix_time=wall, perf_counter=50.0,
+        spans=[("serve/a", 52.0, 0.1, None)],
+    )
+    t1 = merge_to_chrome_trace([p0, p1])
+    t2 = merge_to_chrome_trace([p0, p1])
+    assert json.dumps(t1, sort_keys=True) == json.dumps(t2, sort_keys=True)
+
+    body = [e for e in t1["traceEvents"] if e["ph"] == "X"]
+    keys = [(e["ts"], e["pid"], e["tid"], e["name"]) for e in body]
+    assert keys == sorted(keys)
+    # equal-ts events across processes tie-break on pid then name
+    same_ts = [e for e in body if e["ts"] == 2_000_000.0]
+    assert [(e["pid"], e["name"]) for e in same_ts] == [
+        (0, "serve/a"), (0, "serve/b"), (1, "serve/a"),
+    ]
+
+
+def test_trace_summary_cli_perfetto_from_two_process_logs(tmp_path):
+    """The tool end-to-end: telemetry mode detected from JSONL inputs,
+    inventory table printed, valid Chrome-trace JSON written (no jax in
+    this path, so the subprocess is cheap)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    wall = 1_700_000_000.0
+    _write_proc_log(
+        tmp_path / "run_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=5.0,
+        spans=[("train/step", 5.5, 0.3, 1)],
+        counters={"train/tokens": 64.0},
+    )
+    p0 = tmp_path / "run_proc0.jsonl"
+    with open(p0, "a") as fh:
+        fh.write(json.dumps({
+            "kind": "executable", "name": "train_step",
+            "signature": "abc123", "lower_s": 0.1, "compile_s": 0.9,
+            "recompile": False, "flops": 1.5e9,
+            "hbm": {"args": 1024, "temps": 2048, "peak": 3072},
+        }) + "\n")
+    _write_proc_log(
+        tmp_path / "run_proc1.jsonl", process_index=1,
+        unix_time=wall, perf_counter=99.0,
+        spans=[("pp/s1/bwd", 99.5, 0.2, 1)],
+    )
+    out_json = tmp_path / "merged.json"
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path), "--perfetto", str(out_json)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "per-executable inventory" in out.stdout
+    assert "train_step" in out.stdout
+    assert "2 process log(s)" in out.stdout
+    trace = json.loads(out_json.read_text())
+    xs = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"train/step", "pp/s1/bwd"} <= xs
+    # both spans 0.5s after their own meta: clock-aligned to one ts
+    ts = {
+        e["name"]: e["ts"] for e in trace["traceEvents"]
+        if e["ph"] == "X"
+    }
+    assert ts["train/step"] == ts["pp/s1/bwd"] == 500_000.0
+
+
+def test_perfetto_merge_tolerates_crash_truncated_tail(tmp_path):
+    """JsonlSink buffers spans between flushes, so a killed rank's log
+    ends mid-line — the post-mortem merge must keep everything before
+    the damage instead of dying on it."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "crash_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0,
+        spans=[("train/step", 1.0, 0.2, 5)],
+    )
+    with open(path, "a") as fh:
+        fh.write('{"kind": "span", "name": "train/ph')  # truncated write
+    trace = merge_to_chrome_trace([path])
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["train/step"]
+
+
+def test_perfetto_merge_rejects_headerless_files(tmp_path):
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    bad = tmp_path / "bad_proc0.jsonl"
+    with open(bad, "w") as fh:
+        fh.write(json.dumps({
+            "kind": "meta", "schema": 2, "process_index": 0,
+        }) + "\n")
+    try:
+        merge_to_chrome_trace([bad])
+    except ValueError as e:
+        assert "clock pair" in str(e)
+    else:  # pragma: no cover
+        raise AssertionError("headerless file must be rejected")

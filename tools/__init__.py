@@ -1,0 +1,9 @@
+"""Dev/bench tooling package.
+
+Exists (as a package) so console entry points can target the tools —
+``d9d-bench-compare = tools.bench_compare:main`` — while every script
+stays directly runnable (``python tools/<name>.py``); each script pins
+the repo root onto ``sys.path`` itself. Deliberately NOT shipped in the
+wheel (pyproject packages.find): a top-level ``tools`` in site-packages
+would shadow any other distribution's module of that name.
+"""

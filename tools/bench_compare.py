@@ -1,0 +1,326 @@
+"""Perf-regression gate: diff a bench/telemetry summary against the
+committed baseline snapshot, exit nonzero on regression.
+
+Every PR runs tier-1; none of them, until now, ran anything that would
+notice a 10x perf collapse. This tool closes that gap with a cheap
+tripwire that works even while the TPU tunnel is flaky:
+
+- ``--run-micro`` drives a tiny ``ContinuousBatcher`` workload on CPU
+  (seconds, deterministic seed) and collects the metrics that are
+  *structurally* meaningful on any backend: host dispatches per 1k
+  tokens, readbacks, emitted tokens, compile counts and recompiles
+  (from the ``telemetry/introspect.py`` inventory), peak executable HBM
+  claim — plus wall-clock tokens/s as a loose catastrophic-collapse
+  floor.
+- ``--current FILE`` compares an existing summary instead of running.
+- ``--from-bench-jsonl FILE`` extracts the comparable metrics from a
+  ``bench_results/bench.jsonl`` row (the on-chip ``bench.py`` output)
+  so ``run_tpu_benches.sh`` can emit a compare summary for the queued
+  TPU legs; without a ``tpu`` section in the baseline it reports
+  without gating.
+
+Baseline format (``BENCH_BASELINE.json`` at the repo root, committed):
+
+    {"metrics": {"serve_micro.dispatches_per_1k_tokens":
+        {"value": 31.25, "direction": "lower", "rel_tol": 0.0}, ...}}
+
+``direction: higher`` fails when ``current < value * (1 - rel_tol)``;
+``direction: lower`` fails when ``current > value * (1 + rel_tol)``.
+Structural counts carry ``rel_tol 0`` (they are deterministic — any
+increase is a real regression); wall-clock metrics carry wide
+tolerances (CI boxes are noisy; the gate is for collapses, not 3%
+jitter). A metric present in the baseline but missing from the current
+summary fails (a deleted metric is how a regression hides).
+
+Exit codes: 0 ok, 1 regression, 2 usage/baseline error.
+
+Refresh the baseline after an intentional perf change with:
+    python tools/bench_compare.py --run-micro --write-baseline
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = REPO_ROOT / "BENCH_BASELINE.json"
+
+# micro-workload shape: small enough to compile + run in seconds on the
+# 2-core CI rig, big enough that the fused path's dispatch contract
+# (1 dispatch per K tokens + boundary resets) is exercised across
+# multiple chunks and an admission wave
+MICRO = dict(batch_size=2, requests=6, chunk_k=4, gen_lo=4, gen_hi=10)
+
+
+def run_micro() -> dict:
+    """The CPU serving microbench: returns ``{"metrics": {name: value}}``.
+
+    Deterministic given the seed: the arrival schedule is released
+    against the batcher's own device-step clock, sampling is greedy,
+    and compile counts come from the introspection inventory — only
+    ``tok_per_s`` carries wall-clock noise.
+    """
+    import time
+
+    from tools.bench_serve import build_model, make_workload
+
+    from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.telemetry import introspect
+
+    model, params, cfg = build_model(tiny=True)
+    workload = make_workload(
+        vocab=cfg.vocab_size, requests=MICRO["requests"], seed=0,
+        prompt_lo=2, prompt_hi=6, gen_lo=MICRO["gen_lo"],
+        gen_hi=MICRO["gen_hi"],
+        mean_interarrival=MICRO["gen_hi"] / MICRO["batch_size"],
+    )
+    k = MICRO["chunk_k"]
+    # scope every inventory-derived metric to THIS bench's records: the
+    # in-process tier-1 gate runs after other tests whose executables
+    # (and deliberate recompiles) share the process-wide inventory
+    mark_bench = len(introspect.inventory())
+    batcher = ContinuousBatcher(
+        model, params, batch_size=MICRO["batch_size"],
+        chunk_size=k, overlap=True,
+    )
+    # warmup compiles both fused variants (admit + steady-state) before
+    # the measurement window, like the real serving benches
+    batcher.submit(workload[0][1], max_new_tokens=2 * k + 2)
+    batcher.drain()
+    batcher.reset_measurement()
+    mark_window = len(introspect.inventory())
+
+    pending = list(workload)
+    clock = 0
+    t0 = time.perf_counter()
+    while pending:
+        while pending and pending[0][0] <= clock:
+            _, prompt, gen = pending.pop(0)
+            batcher.submit(prompt, max_new_tokens=gen)
+        if batcher.active:
+            before = batcher.stats.device_steps
+            batcher.step_chunk()
+            clock += batcher.stats.device_steps - before
+        elif pending:
+            clock = pending[0][0]
+    batcher.drain()
+    dt = time.perf_counter() - t0
+
+    st = batcher.stats
+    bench_records = introspect.inventory()[mark_bench:]
+    window_records = introspect.inventory()[mark_window:]
+    peaks = [
+        r.hbm_peak_bytes for r in bench_records if r.hbm_peak_bytes
+    ]
+    return {
+        "schema": 1,
+        "workload": dict(MICRO),
+        "metrics": {
+            # structural (deterministic) — tight thresholds
+            "serve_micro.emitted_tokens": st.emitted_tokens,
+            "serve_micro.host_dispatches": st.host_dispatches,
+            "serve_micro.readbacks": st.readbacks,
+            "serve_micro.dispatches_per_1k_tokens": round(
+                st.dispatches_per_1k_tokens, 4
+            ),
+            # compiles in the MEASUREMENT window (a warmed steady-state
+            # serve loop must not compile at all) + this bench's recompiles
+            "serve_micro.steady_state_compiles": len(window_records),
+            "serve_micro.recompiles": sum(
+                1 for r in bench_records if r.recompile
+            ),
+            # per-executable HBM claim of the biggest serving executable
+            # (None on backends without memory analysis → omitted)
+            **(
+                {"serve_micro.peak_hbm_bytes": max(peaks)}
+                if peaks else {}
+            ),
+            # wall clock — wide-tolerance collapse floor only
+            "serve_micro.tok_per_s": round(st.emitted_tokens / dt, 2),
+        },
+    }
+
+
+def extract_bench_jsonl(path: str) -> dict:
+    """Comparable metrics from the newest parseable ``bench.py`` row in
+    a bench_results jsonl capture (rows may be error lines — skip)."""
+    metrics = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric") and "value" in row:
+                metrics[f"tpu.{row['metric']}"] = row["value"]
+                detail = row.get("detail", {})
+                for block in ("moe", "hybrid", "serving"):
+                    sub = detail.get(block)
+                    if isinstance(sub, dict) and "value" in sub:
+                        metrics[f"tpu.{sub.get('metric', block)}"] = (
+                            sub["value"]
+                        )
+                if isinstance(detail.get("serving"), dict):
+                    d = detail["serving"].get("dispatches_per_1k_tokens")
+                    if d is not None:
+                        metrics["tpu.serving_dispatches_per_1k_tokens"] = d
+    return {"schema": 1, "metrics": metrics}
+
+
+def compare(current: dict, baseline: dict) -> tuple[bool, list[str]]:
+    """→ (ok, report lines). Gates every baseline metric against the
+    current summary with its direction + relative tolerance."""
+    lines = []
+    ok = True
+    cur = current.get("metrics", {})
+    base = baseline.get("metrics", {})
+    if not base:
+        return True, ["baseline has no metrics: nothing to gate"]
+    for name in sorted(base):
+        spec = base[name]
+        value, direction = spec["value"], spec.get("direction", "lower")
+        rel_tol = spec.get("rel_tol", 0.0)
+        have = cur.get(name)
+        if have is None:
+            ok = False
+            lines.append(f"FAIL {name}: missing from current summary "
+                         f"(baseline {value})")
+            continue
+        if direction == "higher":
+            bound = value * (1.0 - rel_tol)
+            bad = have < bound
+            rel = "<" if bad else ">="
+        else:
+            bound = value * (1.0 + rel_tol)
+            bad = have > bound
+            rel = ">" if bad else "<="
+        status = "FAIL" if bad else "ok  "
+        lines.append(
+            f"{status} {name}: {have:g} {rel} bound {bound:g} "
+            f"(baseline {value:g}, {direction} is better, "
+            f"rel_tol {rel_tol:g})"
+        )
+        ok = ok and not bad
+    extra = sorted(set(cur) - set(base))
+    for name in extra:
+        lines.append(f"note {name}: {cur[name]:g} (no baseline)")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Perf-regression gate vs the committed baseline"
+    )
+    ap.add_argument(
+        "--baseline", default=str(DEFAULT_BASELINE),
+        help=f"baseline snapshot (default {DEFAULT_BASELINE.name})",
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--run-micro", action="store_true",
+        help="run the CPU serving microbench and gate its summary",
+    )
+    src.add_argument(
+        "--current", help="compare an existing summary JSON file"
+    )
+    src.add_argument(
+        "--from-bench-jsonl",
+        help="extract metrics from a bench_results bench.jsonl capture "
+        "(TPU legs); reports without gating when the baseline has no "
+        "matching tpu.* metrics",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="with --run-micro: (re)write the baseline from this run "
+        "instead of gating (default thresholds)",
+    )
+    ap.add_argument(
+        "--write-current", metavar="OUT.json",
+        help="also write the current summary to OUT.json",
+    )
+    args = ap.parse_args(argv)
+
+    if args.run_micro:
+        current = run_micro()
+    elif args.current:
+        with open(args.current) as fh:
+            current = json.load(fh)
+    else:
+        current = extract_bench_jsonl(args.from_bench_jsonl)
+
+    if args.write_current:
+        with open(args.write_current, "w") as fh:
+            json.dump(current, fh, indent=2, sort_keys=True)
+
+    if args.write_baseline:
+        if not args.run_micro:
+            print("--write-baseline requires --run-micro", file=sys.stderr)
+            return 2
+        baseline = {
+            "comment": "perf-regression gate baseline "
+                       "(tools/bench_compare.py); refresh with "
+                       "--run-micro --write-baseline after intentional "
+                       "perf changes",
+            "metrics": default_thresholds(current["metrics"]),
+        }
+        with open(args.baseline, "w") as fh:
+            json.dump(baseline, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote baseline {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline) as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+        return 2
+
+    ok, lines = compare(current, baseline)
+    for line in lines:
+        print(line)
+    print(json.dumps({
+        "bench_compare": {
+            "ok": ok,
+            "baseline": str(args.baseline),
+            "gated_metrics": len(baseline.get("metrics", {})),
+        }
+    }))
+    return 0 if ok else 1
+
+
+def default_thresholds(metrics: dict) -> dict:
+    """Per-metric gate specs for a fresh baseline: structural counts are
+    exact (any extra dispatch/compile/byte is a real regression),
+    wall-clock rates get a wide collapse-only floor."""
+    specs = {}
+    for name, value in metrics.items():
+        if name.endswith(".tok_per_s"):
+            # CI wall clock is noisy: gate only a catastrophic collapse
+            specs[name] = {
+                "value": value, "direction": "higher", "rel_tol": 0.9,
+            }
+        elif name.endswith(".emitted_tokens"):
+            specs[name] = {
+                "value": value, "direction": "higher", "rel_tol": 0.0,
+            }
+        elif name.endswith(".peak_hbm_bytes"):
+            # layout/codegen details may drift a little across jaxlib
+            specs[name] = {
+                "value": value, "direction": "lower", "rel_tol": 0.25,
+            }
+        else:
+            specs[name] = {
+                "value": value, "direction": "lower", "rel_tol": 0.0,
+            }
+    return specs
+
+
+if __name__ == "__main__":
+    sys.exit(main())

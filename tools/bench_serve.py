@@ -102,8 +102,12 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap,
     False when embedding run_mode next to other instrumented components
     whose counters must survive."""
     from d9d_tpu.loop.serve import ContinuousBatcher
-    from d9d_tpu.telemetry import get_telemetry
+    from d9d_tpu.telemetry import get_telemetry, introspect
 
+    # scope inventory-derived columns to THIS mode's records: the
+    # process-wide inventory may carry other components' compiles (and
+    # deliberate recompiles) when run_mode is embedded
+    mode_mark = len(introspect.inventory())
     batcher = ContinuousBatcher(
         model, params, batch_size=batch_size,
         chunk_size=chunk_size, overlap=overlap,
@@ -121,6 +125,11 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap,
     batcher.reset_measurement()
     if reset_telemetry:
         get_telemetry().reset_instruments()
+    # introspection inventory marker: executables compiled AFTER this
+    # point compiled inside the measurement window — a warmed steady
+    # state must report 0 (the compile-count column the perf-regression
+    # gate pins via tools/bench_compare.py)
+    inventory_mark = len(introspect.inventory())
 
     pending = list(workload)
     rids = {}
@@ -157,6 +166,11 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap,
         "readbacks": st.readbacks,
         "dispatches_per_1k_tokens": st.dispatches_per_1k_tokens,
         "slot_utilization": st.slot_utilization,
+        "steady_state_compiles": len(introspect.inventory())
+        - inventory_mark,
+        "recompiles": sum(
+            1 for r in introspect.inventory()[mode_mark:] if r.recompile
+        ),
     }, outputs
 
 

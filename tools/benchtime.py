@@ -80,6 +80,7 @@ def require_backend(caller: str, timeout_s: int = 600) -> None:
     shared by bench.py and __graft_entry__ (ADVICE r3: the two copies were
     already on the divergence trajectory this module exists to stop).
     """
+    import json
     import os
     import sys
     import threading
@@ -98,9 +99,22 @@ def require_backend(caller: str, timeout_s: int = 600) -> None:
     t.start()
     t.join(timeout_s)
     if "devices" not in result:
+        error = result.get("error", f"jax.devices() hung >{timeout_s}s")
+        # structured stdout row FIRST: the bench trajectory records
+        # stdout JSON (BENCH_r05 landed as rc=3 with parsed:null because
+        # only stderr carried the outage) — a tunnel flake must stay
+        # machine-readable, not an unparsed tail
         print(
-            f"{caller}: accelerator backend unavailable "
-            f"({result.get('error', f'jax.devices() hung >{timeout_s}s')})",
+            json.dumps({
+                "rc": 3,
+                "skipped": "backend_unavailable",
+                "caller": caller,
+                "error": error,
+            }),
+            flush=True,
+        )
+        print(
+            f"{caller}: accelerator backend unavailable ({error})",
             file=sys.stderr,
             flush=True,
         )

@@ -65,10 +65,23 @@ if [[ "${D9D_BENCH_RESUME:-0}" != "1" ]]; then
   : > bench_results/pp.jsonl
 fi
 
+# structured outage rows: a dead tunnel must leave a machine-readable
+# {"rc": ..., "skipped": "backend_unavailable"} row in the capture files
+# (BENCH_r05 landed as rc=3 with an unparsed stderr tail — the
+# trajectory lost the outage), mirroring benchtime.require_backend's
+# stdout row inside the python harnesses
+skip_row() {  # skip_row <rc> <leg>
+  local row="{\"rc\": $1, \"skipped\": \"backend_unavailable\", \"leg\": \"$2\"}"
+  echo "$row" | tee -a bench_results/bench.jsonl \
+    | tee -a bench_results/failures.jsonl
+}
+
 echo "== liveness ladder: probe"
 if ! timeout $((PROBE_TIMEOUT + 20)) python tools/tpu_probe.py \
     --timeout "$PROBE_TIMEOUT"; then
-  echo "tunnel dead at probe; aborting (exit 3)"; exit 3
+  echo "tunnel dead at probe; aborting (exit 3)"
+  skip_row 3 "probe"
+  exit 3
 fi
 echo "== liveness ladder: tiny bench (2-layer, 3 steps)"
 # tiny gets its own, shorter watchdog so it still fires inside the 900s
@@ -77,9 +90,19 @@ if ! timeout -k 30 900 env D9D_BENCH_WATCHDOG_S=600 \
     python bench.py --tiny > bench_results/tiny.json; then
   echo "tiny bench failed/wedged; aborting before the big legs (exit 4)"
   cat bench_results/tiny.json 2>/dev/null
+  # bench.py's own watchdog/require_backend rows (stdout JSON) are in
+  # tiny.json; add the structured abort marker to the capture files too
+  skip_row 4 "tiny_bench"
   exit 4
 fi
 cat bench_results/tiny.json
+
+# device introspection for every leg (telemetry/introspect.py): one
+# JSONL event log per leg with compile/* spans and the per-executable
+# FLOPs/HBM inventory — tools/trace_summary.py renders it, and
+# --perfetto merges the logs into one timeline
+export D9D_TELEMETRY_DIR="${D9D_TELEMETRY_DIR:-bench_results/telemetry}"
+mkdir -p "$D9D_TELEMETRY_DIR"
 
 # leg order = value-per-tunnel-minute: the default leg carries the whole
 # BENCH_r04 headline (dense+MoE+hybrid in one process), then the MoE
@@ -295,6 +318,21 @@ run_leg "pipeline schedule microbench" bench_results/pp.jsonl \
 : > bench_results/pp_overhead.jsonl
 run_leg "executor dispatch-overhead A/B (precompiled vs naive)" \
   bench_results/pp_overhead.jsonl python tools/bench_pp_overhead.py
+
+echo "== perf-regression compare vs BENCH_BASELINE.json (report-only)"
+# the committed baseline gates the CPU microbench in tier-1; for the
+# chip legs this emits the comparison so BASELINE.md updates start from
+# a diff, not a guess — report-only (|| true): a regressed chip row
+# must still finish the capture
+python tools/bench_compare.py --from-bench-jsonl bench_results/bench.jsonl \
+  | tee bench_results/bench_compare.txt || true
+
+echo "== telemetry introspection summary (compile/HBM inventory)"
+if compgen -G "$D9D_TELEMETRY_DIR/*.jsonl" > /dev/null; then
+  python tools/trace_summary.py "$D9D_TELEMETRY_DIR" \
+    --perfetto bench_results/perfetto_trace.json \
+    | tee bench_results/introspection_summary.txt || true
+fi
 
 echo "== schedule-economics makespan sim (device-free, for the record)"
 : > bench_results/makespan.jsonl
