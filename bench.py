@@ -367,6 +367,25 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
     # run that variant; the recorded row is the validated microbatch-1 one.
     microbatch = batch if tiny else int(os.environ.get("D9D_BENCH_MOE_UB", "1"))
 
+    # D9D_BENCH_MOE_ZERO=1: ZeRO-style optimizer-state sharding over
+    # dp_replicate (parallel/zero.py) — the mesh spans every visible
+    # chip as dp_r and each chip streams 1/N of the fp32 masters/Adam
+    # moments per step (docs/design/zero_sharding.md). Single-chip
+    # tunnels degrade to dp_r=1 (the code path still runs; the 1/N
+    # claim needs a multi-chip window). The per-chip global batch is
+    # held constant: tokens/s/chip stays the recorded metric.
+    zero = os.environ.get("D9D_BENCH_MOE_ZERO", "0") == "1"
+    n_dev = len(jax.devices())
+    dp_replicate = (min(n_dev, 4) if tiny else n_dev) if zero else 1
+    if zero and not tiny:
+        # constant per-chip load: global batch AND the (DP-global)
+        # microbatch scale by the replica count, so per-chip µBS and
+        # num_microbatches match the single-chip leg exactly
+        batch = batch * dp_replicate
+        microbatch = microbatch * dp_replicate
+    # per-chip µBS drives the fp32-vs-bf16-master recipe choice below
+    ub_chip = microbatch // dp_replicate
+
     class Provider(ModelProvider):
         def build_module(self, stage):
             return Qwen3MoeCausalLM(
@@ -376,7 +395,7 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
                 # recipe — bf16 master weights + stochastic-rounding AdamW
                 # — which also removes the per-traversal fp32->bf16 cast
                 # of every weight (2.7G of fp32 reads per pass)
-                param_dtype=jnp.float32 if microbatch <= 1 or tiny
+                param_dtype=jnp.float32 if ub_chip <= 1 or tiny
                 else jnp.bfloat16,
                 # "auto" (the r4 default) encodes the r3 sweep: one
                 # chunk at n<=2048 (the µBS=1 win: 25.3k vs 24.5k tok/s),
@@ -401,7 +420,9 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
                     )
                 }
 
-    ctx = MeshParameters().build(jax.devices()[:1])
+    ctx = MeshParameters(dp_replicate=dp_replicate).build(
+        jax.devices()[:dp_replicate]
+    )
     trainer = Trainer(
         ctx=ctx,
         config=TrainerConfig(
@@ -410,6 +431,7 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
             seq_len=seq_len,
             total_steps=steps_warmup + steps_measure,
             log_every=10_000,
+            zero_sharding=zero,
         ),
         model_provider=Provider(),
         dataset_provider=Data(),
@@ -417,15 +439,19 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
         # microbatch 1 (the recorded row) fits fp32-moment AdamW; larger
         # microbatches only fit with bf16 moments (see note above)
         optimizer_provider=AdamWProvider(weight_decay=0.0)
-        if microbatch <= 1 or tiny
+        if ub_chip <= 1 or tiny
         else StochasticAdamWProvider(),
     )
+    opt_state_bytes_per_chip = trainer.opt_state_bytes_per_chip()
 
     tok_per_s = _measure(
         trainer, iter(Data().build()), warmup=steps_warmup,
         steps=steps_measure, batch=batch, seq_len=seq_len,
         profile_tag=None if tiny else ("hybrid" if hybrid else "moe"),
     )
+    # the recorded metric is tokens/sec/CHIP: the multi-replica ZeRO leg
+    # measures whole-mesh throughput over dp_replicate chips
+    tok_per_s /= dp_replicate
 
     # active params: experts scaled by top_k/num_experts, everything else
     # 1x — the same shared accounting the trainer's live-MFU gauge uses
@@ -467,6 +493,12 @@ def run_bench_moe(*, tiny: bool = False, hybrid: bool = False) -> dict:
             "batch": batch,
             "steps": steps_measure,
             "device": jax.devices()[0].device_kind,
+            # ZeRO observability (docs/design/zero_sharding.md): the 1/N
+            # optimizer-state claim as an executable number — mirrors
+            # the opt/state_bytes_per_chip telemetry gauge
+            "zero_sharding": zero,
+            "dp_replicate": dp_replicate,
+            "opt_state_bytes_per_chip": opt_state_bytes_per_chip,
         },
     }
 

@@ -90,6 +90,22 @@ class TrainerConfig(pydantic.BaseModel):
     telemetry_console: bool = True
     telemetry_console_interval_s: float = 30.0
 
+    # ZeRO-style optimizer-state sharding (parallel/zero.py,
+    # docs/design/zero_sharding.md): partition fp32 masters + Adam
+    # moments across the dp_replicate mesh axis — grads reduce-scattered
+    # into the local 1/N shard, the update computed on the shard, new
+    # params all-gathered back. A placement/annotation change only: the
+    # update math is identical (CPU-exactness-tested), and checkpoints
+    # keep global shapes so saves round-trip across different settings
+    # of this knob (gather-on-load). No-op at dp_replicate == 1.
+    zero_sharding: bool = False
+    # observability split (tracked_jit): compile the optimizer phase as
+    # its own `train_opt_update` executable so the introspection
+    # inventory attributes the update's FLOPs/HBM separately from
+    # hbm/train_step. Costs one extra dispatch per step and an HBM
+    # round-trip of the clipped grads — leave off for recorded rows
+    split_optimizer_update: bool = False
+
     # device-side introspection (telemetry/introspect.py): the recompile
     # guard arms after this many steps of the CURRENT train() session —
     # by then every legitimate signature (ragged last microbatch, both

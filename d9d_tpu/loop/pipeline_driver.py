@@ -192,6 +192,7 @@ class PipelineTrainEngine:
         grad_dtype=jnp.float32,
         peft_method=None,
         anomaly_policy: str | None = None,
+        zero_sharding: bool = False,
     ):
         if not isinstance(task, PipelineTrainTask):
             raise TypeError(
@@ -267,6 +268,8 @@ class PipelineTrainEngine:
         )
         self._eval_executor = None
         self.anomaly_policy = anomaly_policy
+        from d9d_tpu.core.mesh import AXIS_DP_REPLICATE
+
         self.optimizer = PipelinedOptimizer(
             optimizer=optimizer,
             scalar_shardings={
@@ -275,6 +278,9 @@ class PipelineTrainEngine:
             },
             max_grad_norm=max_grad_norm,
             anomaly_freeze=anomaly_policy in ("skip_step", "rollback"),
+            # ZeRO over dp_replicate: every stage submesh carries the
+            # dp_r axis (stage meshes keep the full non-pp vocabulary)
+            zero_axis=AXIS_DP_REPLICATE if zero_sharding else None,
         )
         self.opt_states = self.optimizer.init(
             {s: rt.params for s, rt in self.stages.items()}

@@ -113,6 +113,7 @@ class Trainer:
         if ctx.pp_size > 1:
             from d9d_tpu.loop.pipeline_driver import PipelineTrainEngine
 
+            self.zero = None  # PP: the per-stage optimizer owns the tables
             self.pp_engine = PipelineTrainEngine(
                 ctx=ctx,
                 schedule=config.pipeline,
@@ -125,6 +126,7 @@ class Trainer:
                 max_grad_norm=config.max_grad_norm,
                 peft_method=peft_method,
                 anomaly_policy=config.anomaly_policy,
+                zero_sharding=config.zero_sharding,
             )
             self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
@@ -158,6 +160,29 @@ class Trainer:
             self.opt_state = replicate_uncommitted(
                 jax.jit(self.optimizer.init)(self.params), ctx.mesh
             )
+            self.zero = None
+            if config.zero_sharding:
+                # ZeRO optimizer-state sharding (parallel/zero.py): move
+                # the live state onto its 1/N-per-chip layout and wrap
+                # the optimizer with the reduce-scatter/all-gather
+                # annotations around the update seam
+                from d9d_tpu.parallel.zero import (
+                    ZeroShardedOptimizer,
+                    build_zero_sharding,
+                    place_tree,
+                )
+
+                self.zero = build_zero_sharding(
+                    params=self.params,
+                    opt_state=self.opt_state,
+                    mesh=ctx.mesh,
+                )
+                self.opt_state = place_tree(
+                    self.opt_state, self.zero.state_shardings
+                )
+                self.optimizer = ZeroShardedOptimizer(
+                    self.optimizer, self.zero
+                )
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
 
             self.step_fn = build_train_step(
@@ -167,6 +192,8 @@ class Trainer:
                 num_microbatches=self.batch_maths.num_microbatches,
                 max_grad_norm=config.max_grad_norm,
                 anomaly_policy=config.anomaly_policy,
+                zero=self.zero,
+                split_update=config.split_optimizer_update,
             )
 
         self.dataset_provider = dataset_provider
@@ -235,6 +262,12 @@ class Trainer:
         # mesh's peak (per-chip peak x mesh size), matching bench.py's
         # single-chip convention at mesh size 1
         self._peak_flops = device_peak_flops() * int(ctx.mesh.devices.size)
+        # per-chip optimizer-state footprint (docs/design/zero_sharding.md):
+        # under ZeRO this reads ~1/dp_replicate of the replicated value —
+        # the executable claim the bench column mirrors
+        self.telemetry.gauge("opt/state_bytes_per_chip").set(
+            self.opt_state_bytes_per_chip()
+        )
         # once-per-process flag for the model-vs-XLA FLOPs cross-check
         # (telemetry/introspect.py inventory vs the roofline convention)
         self._flops_divergence_checked = False
@@ -253,6 +286,24 @@ class Trainer:
         if self.base_params is not None:  # PEFT: frozen base still computes
             trees.append(self.base_params)
         return active_param_count(trees, self._model_config())
+
+    def opt_state_bytes_per_chip(self) -> int:
+        """Per-chip bytes of the live optimizer state (shard-aware).
+
+        Under PP each chip belongs to exactly one stage, so the honest
+        per-chip number is the worst stage's footprint, not the sum.
+        """
+        from d9d_tpu.parallel.zero import tree_bytes_per_device
+
+        if self.pp_engine is not None:
+            per_rank: dict[int, int] = {}
+            for s, state in self.pp_engine.opt_states.items():
+                rank = self.pp_engine.stage_owner[s]
+                per_rank[rank] = per_rank.get(rank, 0) + tree_bytes_per_device(
+                    state
+                )
+            return max(per_rank.values(), default=0)
+        return tree_bytes_per_device(self.opt_state)
 
     def _model_config(self):
         if self.pp_engine is not None:

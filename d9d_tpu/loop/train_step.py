@@ -32,6 +32,7 @@ from jax import lax
 from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.parallel.zero import ZeroSharding, constrain_tree
 from d9d_tpu.resilience.anomaly import ANOMALY_POLICIES
 from d9d_tpu.telemetry import tracked_jit
 
@@ -82,6 +83,8 @@ def build_train_step(
     grad_dtype: jnp.dtype | None = jnp.float32,
     donate: bool = True,
     anomaly_policy: str | None = None,
+    zero: ZeroSharding | None = None,
+    split_update: bool = False,
 ) -> TrainStepFn:
     """Build the jitted step.
 
@@ -97,6 +100,23 @@ def build_train_step(
     optimizer-moment update is frozen for that step via an in-device
     select (``warn`` applies the update and only flags). The metric dict
     gains ``resilience/anomaly`` / ``anomaly_streak`` / ``anomaly_total``.
+
+    ``zero`` (parallel/zero.py, docs/design/zero_sharding.md) annotates
+    the grad-accumulation scan carry with the dp_replicate-sharded
+    layout, so XLA reduce-scatters each microbatch's gradient into the
+    local 1/N shard (the fp32 accumulator itself shrinks to 1/N per
+    chip) and the optimizer — which the trainer wraps in
+    ``ZeroShardedOptimizer`` — updates only the local shard before the
+    all-gather back. The caller passes the *wrapped* optimizer here;
+    ``zero`` only drives the accumulator annotation.
+
+    ``split_update`` compiles the optimizer phase as its OWN
+    ``tracked_jit`` executable (``train_opt_update``) instead of fusing
+    it into the step program: two dispatches per step and the clipped
+    grads materialize in HBM between them, but the introspection
+    inventory then splits the update's FLOPs/HBM claim out of
+    ``hbm/train_step`` — the observability mode for attributing the
+    optimizer stream (and watching ZeRO's 1/N argument-bytes drop).
     """
     if anomaly_policy is not None and anomaly_policy not in ANOMALY_POLICIES:
         raise ValueError(
@@ -104,6 +124,9 @@ def build_train_step(
             f"got {anomaly_policy!r}"
         )
     freeze_on_anomaly = anomaly_policy in ("skip_step", "rollback")
+    grad_shardings = (
+        zero.grad_shardings if zero is not None and zero.active else None
+    )
 
     def microbatch_grads(params, mb, rng):
         def scalar_loss(p):
@@ -116,19 +139,35 @@ def build_train_step(
             )(params)
         return loss_sum, weight, metrics, grads
 
-    def step(params, opt_state, batch, rng, guard_state=None):
+    def accumulate_grads(params, batch, rng):
+        """Microbatch scan + sum-then-scale + clip → (grads, metrics)."""
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, grad_dtype or p.dtype), params
         )
+        if grad_shardings is not None:
+            # ZeRO: the carry is pinned to the dp_r-sharded layout — the
+            # fp32 accumulator holds 1/N per chip across the whole scan,
+            # and XLA's reduce-scatter rewrite can fold the backward's
+            # dp_r reduction straight into the shard
+            zero_grads = constrain_tree(zero_grads, grad_shardings)
 
         def scan_body(carry, mb_and_idx):
             grads_acc, loss_acc, weight_acc, metrics_acc = carry
             mb, idx = mb_and_idx
             mb_rng = jax.random.fold_in(rng, idx)
             loss_sum, weight, metrics, grads = microbatch_grads(params, mb, mb_rng)
+            if grad_shardings is not None:
+                # pin the per-microbatch grads to the baseline (replicated)
+                # layout FIRST: the backward partitions exactly as the
+                # unsharded path, and the accumulate below is then a
+                # shard-local elementwise add — bitwise-identical values,
+                # 1/N accumulator (see ZeroSharding.grad_pin_shardings)
+                grads = constrain_tree(grads, zero.grad_pin_shardings)
             grads_acc = jax.tree.map(
                 lambda a, g: a + g.astype(a.dtype), grads_acc, grads
             )
+            if grad_shardings is not None:
+                grads_acc = constrain_tree(grads_acc, grad_shardings)
             metrics_acc = jax.tree.map(lambda a, m: a + m, metrics_acc, metrics)
             return (
                 grads_acc,
@@ -167,8 +206,19 @@ def build_train_step(
                 )
                 grads = jax.tree.map(lambda g: g * clip, grads)
 
+        out_metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "loss_weight": weight_sum,
+            **{f"task/{k}": v for k, v in metrics.items()},
+        }
+        return grads, out_metrics
+
+    def apply_update(params, opt_state, grads, out_metrics, guard_state):
         # OptimizerOwnsApply capabilities (core/protocol.py): fp32 grads
-        # pass-through + optimizer-owned parameter write
+        # pass-through + optimizer-owned parameter write. Under ZeRO the
+        # optimizer is the ZeroShardedOptimizer wrapper: update runs on
+        # the 1/N shard, apply_updates all-gathers the new params.
         with jax.named_scope("train/optimizer"):
             if not getattr(optimizer, "accepts_fp32_grads", False):
                 grads = jax.tree.map(
@@ -180,12 +230,6 @@ def build_train_step(
             apply = getattr(optimizer, "apply_updates", optax.apply_updates)
             new_params = apply(params, updates)
 
-        out_metrics = {
-            "loss": loss,
-            "grad_norm": grad_norm,
-            "loss_weight": weight_sum,
-            **{f"task/{k}": v for k, v in metrics.items()},
-        }
         if anomaly_policy is None:
             return new_params, new_opt_state, out_metrics
 
@@ -194,11 +238,14 @@ def build_train_step(
         # A NaN/inf anywhere in the grads reaches grad_norm by
         # construction (the global norm sums every leaf).
         with jax.named_scope("train/anomaly_guard"):
-            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            ok = jnp.isfinite(out_metrics["loss"]) & jnp.isfinite(
+                out_metrics["grad_norm"]
+            )
             if freeze_on_anomaly:
                 # freeze params AND optimizer moments for the step: a
                 # NaN that reached Adam's second moment would poison
-                # every later step despite finite grads
+                # every later step despite finite grads. Elementwise
+                # select — sharded ZeRO moments freeze shard-local.
                 new_params = jax.tree.map(
                     lambda new, old: jnp.where(ok, new, old),
                     new_params, params,
@@ -210,6 +257,7 @@ def build_train_step(
             anomaly = jnp.logical_not(ok).astype(jnp.int32)
             streak = jnp.where(ok, 0, guard_state[0] + 1)
             total = guard_state[1] + anomaly
+            out_metrics = dict(out_metrics)
             out_metrics["resilience/anomaly"] = anomaly.astype(jnp.float32)
             out_metrics["resilience/anomaly_streak"] = streak.astype(
                 jnp.float32
@@ -221,21 +269,42 @@ def build_train_step(
             [streak, total]
         )
 
+    def step(params, opt_state, batch, rng, guard_state=None):
+        grads, out_metrics = accumulate_grads(params, batch, rng)
+        return apply_update(params, opt_state, grads, out_metrics, guard_state)
+
+    guard_ix = (4,) if anomaly_policy is not None else ()
+
+    if split_update:
+        # two tracked executables: grads (reuses the train_step name so
+        # the MFU cross-check and dashboards keep working) + the
+        # optimizer update under its own inventory row. grads/opt_state
+        # (and the guard carry) are donated to the update program;
+        # params are donated there too — the grads program has already
+        # consumed them by the time the update dispatches.
+        grads_jit = tracked_jit(accumulate_grads, name="train_step")
+        update_jit = tracked_jit(
+            apply_update, name="train_opt_update",
+            donate_argnums=(0, 1, 2) + guard_ix if donate else (),
+        )
+
+        def split_fn(params, opt_state, batch, rng, guard_state=None):
+            grads, out_metrics = grads_jit(params, batch, rng)
+            return update_jit(params, opt_state, grads, out_metrics, guard_state)
+
+        return TrainStepFn(
+            fn=split_fn, guarded=anomaly_policy is not None
+        )
+
     # tracked_jit (telemetry/introspect.py): same single dispatch per
     # call, plus compile/train_step spans, the steady-state recompile
     # guard, and the per-executable FLOPs/HBM inventory the MFU
     # cross-check reads
-    if anomaly_policy is None:
-        jitted = tracked_jit(
-            step, name="train_step",
-            donate_argnums=(0, 1) if donate else (),
-        )
-        return TrainStepFn(fn=jitted)
     jitted = tracked_jit(
         step, name="train_step",
-        donate_argnums=(0, 1, 4) if donate else (),
+        donate_argnums=(0, 1) + guard_ix if donate else (),
     )
-    return TrainStepFn(fn=jitted, guarded=True)
+    return TrainStepFn(fn=jitted, guarded=anomaly_policy is not None)
 
 
 def build_eval_step(

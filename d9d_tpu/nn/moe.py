@@ -392,7 +392,11 @@ class MoELayer(nn.Module):
             # concat never touch HBM (ops/moe_pallas.py; backward runs
             # the XLA chain below via custom_vjp — identical math).
             # pallas_gather additionally keeps x resident in VMEM and
-            # gathers rows in-kernel (no HBM aligned activation buffer)
+            # gathers rows in-kernel (no HBM aligned activation buffer),
+            # and by default folds the combine in too: the kernel
+            # scatter-accumulates token-major [N, D] output in VMEM, so
+            # the expert-sorted y rows never hit HBM either
+            # (D9D_TPU_MOE_COMBINE=unfused for the A/B)
             return fused_moe_ffn_apply(
                 x, topk_probs, sort,
                 self.grouped_experts.gate_weight,

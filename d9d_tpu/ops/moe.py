@@ -126,6 +126,19 @@ def permute_tokens(
     return permuted_x, permuted_probs
 
 
+def fused_combine_enabled() -> bool:
+    """``D9D_TPU_MOE_COMBINE`` A/B switch (default ON) for the
+    gather-fused combine: under the ``pallas_gather`` FFN backend the
+    down-projection's combine (ragged gather → grouped matmul → K-sum)
+    runs INSIDE the fused kernel, accumulating token-major [N, D]
+    outputs in VMEM — the expert-sorted y rows and the pair-gathered
+    copy never exist in HBM (tools/roofline.py's 79 ms/step
+    permute+combine residual is half combine-side). Read at call time
+    like the file's other env knobs; ops/moe_pallas.py consults it and
+    its VMEM-fit gate can still veto per shape."""
+    return os.environ.get("D9D_TPU_MOE_COMBINE", "fused") != "unfused"
+
+
 def combine_pairs(y: Array, dest: Array, num_tokens: int) -> Array:
     """Fold expert-sorted pair rows back to their owning tokens.
 

@@ -44,6 +44,16 @@ residual HBM term after the µBS/bf16 levers. The gather variant
 auto-falls back to plain ``pallas`` when the residency or SMEM index
 maps don't fit (:func:`_gather_fits`).
 
+Under the gather backend the COMBINE side fuses too (default on,
+``D9D_TPU_MOE_COMBINE=unfused`` for the A/B): the kernel holds the
+token-major combined output ``[N, h]`` resident in VMEM (constant
+output index map — flushed to HBM once) and scatter-accumulates each
+tile's prob-weighted down-projection rows into their owning tokens, so
+the expert-sorted ``y`` and its pair-gathered copy — the combine half
+of the roofline's 79 ms/step permute+combine gather traffic — never
+touch HBM. One ragged gather → grouped matmul → K-sum, all in-kernel
+(:func:`_ffn_gather_combine_kernel`; fit gate :func:`_combine_fits`).
+
 Scope: the LOCAL MoE path only. The EP flow's per-shard ``expert_fn``
 receives rows the dispatch all-to-all already delivered in expert-sorted
 (but unaligned) order; re-aligning them for this kernel would cost a
@@ -172,6 +182,64 @@ def _ffn_gather_kernel(
     out_ref[...] = (y * p_scr[...]).astype(out_ref.dtype)
 
 
+def _ffn_gather_combine_kernel(
+    gid_ref, ps_ref, x_ref, probs_ref, wg_ref, wu_ref, wd_ref, out_ref,
+    a_scr, p_scr, y_scr, *, block_m: int, top_k: int,
+):
+    """Gather-fused FFN **with the combine folded in**: the kernel's
+    output is the token-major combined [N, h] — one ragged gather →
+    grouped matmul → K-sum, no expert-sorted y in HBM at all.
+
+    Same VMEM-resident x/probs and in-kernel row gather as
+    :func:`_ffn_gather_kernel`; the difference is on the way out. The
+    output block is the whole [N, h] array with a constant index map, so
+    it stays resident in VMEM across the (sequential) grid and is
+    flushed to HBM once: each tile scatters its rows into
+    ``out[pair_src[row] // top_k]`` with an in-VMEM read-modify-write —
+    the K expert contributions of each token accumulate here instead of
+    in an XLA reshape+sum over a pair-gathered copy. Pad rows
+    (pair_src < 0) are skipped. The K-sum therefore runs in
+    expert-sorted order rather than the XLA path's slot order — same
+    numbers up to fp summation order (parity-tested at ulp tolerance).
+    """
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+    def gather(i, _):
+        src = ps_ref[t * block_m + i]
+        valid = src >= 0
+        src0 = jnp.maximum(src, 0)
+        row = x_ref[pl.ds(src0 // top_k, 1), :]
+        a_scr[pl.ds(i, 1), :] = jnp.where(valid, row, 0)
+        pr = probs_ref[pl.ds(src0, 1), :]
+        p_scr[pl.ds(i, 1), :] = jnp.where(valid, pr, 0)
+        return 0
+
+    jax.lax.fori_loop(0, block_m, gather, 0, unroll=8)
+    a = a_scr[...]
+    g = jnp.dot(a, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(a, wu_ref[0], preferred_element_type=jnp.float32)
+    hidden = (jax.nn.silu(g) * u).astype(a.dtype)
+    y = jnp.dot(hidden, wd_ref[0], preferred_element_type=jnp.float32)
+    y_scr[...] = (y * p_scr[...]).astype(out_ref.dtype)
+
+    def combine(i, _):
+        src = ps_ref[t * block_m + i]
+        tok = jnp.maximum(src, 0) // top_k
+        row = y_scr[pl.ds(i, 1), :]
+        cur = out_ref[pl.ds(tok, 1), :]
+        # pad rows write token 0's row back unchanged (+0): branchless
+        out_ref[pl.ds(tok, 1), :] = cur + jnp.where(src >= 0, row, 0)
+        return 0
+
+    # NOT unrolled: consecutive rows may target the same token, so each
+    # read-modify-write must retire before the next row's read
+    jax.lax.fori_loop(0, block_m, combine, 0)
+
+
 def _vmem_bytes_estimate(
     h: int, inter: int, block_m: int, itemsize: int
 ) -> int:
@@ -206,16 +274,30 @@ def _vmem_budget() -> int:
 _SMEM_PREFETCH_BUDGET = 256 * 1024
 
 
+def _gather_footprint(
+    n: int, m: int, h: int, inter: int, block_m: int, itemsize: int
+) -> int:
+    """VMEM bytes of the gather variant: base kernel footprint + the
+    resident x [n, h] / probs [m, 1] blocks (counted double-buffered
+    like every other pipelined input — their index map is constant, but
+    Pallas still allocates pipeline buffers) + the a/p gather scratch.
+    Single source of truth for BOTH eligibility gates."""
+    resident = (n * h * itemsize + m * 4) * 2  # double-buffered
+    scratch = block_m * h * itemsize + block_m * 4
+    return (
+        _vmem_bytes_estimate(h, inter, block_m, itemsize)
+        + resident + scratch
+    )
+
+
 def _gather_fits(
     n: int, m: int, h: int, inter: int, block_m: int, itemsize: int,
     num_experts: int,
 ) -> bool:
     """Can the gather variant hold x [n, h] + probs [m, 1] resident in
     VMEM on top of the base kernel footprint (plus its gather scratch),
-    and its index maps in scalar memory? Resident blocks are counted
-    double-buffered like every other pipelined input (their index map is
-    constant, but Pallas still allocates pipeline buffers). Also
-    requires n and m sublane-aligned (full-array blocks)."""
+    and its index maps in scalar memory? Also requires n and m
+    sublane-aligned (full-array blocks)."""
     if n % 8 != 0 or m % 8 != 0:
         return False
     # SMEM riders: pair_src [m_pad] + gid [m_pad / block_m], int32
@@ -223,11 +305,24 @@ def _gather_fits(
     m_pad = (-(-m // block_m) + num_experts) * block_m
     if 4 * (m_pad + m_pad // block_m) > _SMEM_PREFETCH_BUDGET:
         return False
-    resident = (n * h * itemsize + m * 4) * 2  # double-buffered
-    scratch = block_m * h * itemsize + block_m * 4
+    return _gather_footprint(n, m, h, inter, block_m, itemsize) <= _vmem_budget()
+
+
+def _combine_fits(
+    n: int, m: int, h: int, inter: int, block_m: int, itemsize: int,
+    num_experts: int,
+) -> bool:
+    """Gather-variant residency plus the combine's extra VMEM: the
+    whole token-major output [n, h] resident across the grid (counted
+    double-buffered like the other full-array blocks) and the
+    [block_m, h] y scratch the scatter loop reads back from."""
+    if not _gather_fits(n, m, h, inter, block_m, itemsize, num_experts):
+        return False
+    out_resident = n * h * itemsize * 2
+    y_scratch = block_m * h * itemsize
     return (
-        _vmem_bytes_estimate(h, inter, block_m, itemsize)
-        + resident + scratch
+        _gather_footprint(n, m, h, inter, block_m, itemsize)
+        + out_resident + y_scratch
     ) <= _vmem_budget()
 
 
@@ -280,6 +375,39 @@ def _fused_ffn_call(
     )(gid, aligned_x, aligned_probs, gate_w, up_w, down_w)
 
 
+def _gather_grid_spec(
+    x: Array, probs_flat: Array, pair_src: Array, gate_w: Array,
+    block_m: int, out_spec: "pl.BlockSpec", extra_scratch: tuple = (),
+) -> "pltpu.PrefetchScalarGridSpec":
+    """Shared grid/in_specs/scratch of the two gather-variant kernels
+    (resident x + probs, per-tile expert weight blocks via the gid SMEM
+    rider); only the output spec and any extra scratch differ."""
+    n, h = x.shape
+    m = probs_flat.shape[0]
+    inter = gate_w.shape[-1]
+    m_pad = pair_src.shape[0]
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # gid + pair_src ride SMEM
+        grid=(m_pad // block_m,),
+        in_specs=[
+            pl.BlockSpec((n, h), lambda t, gid_ref, ps_ref: (0, 0)),
+            pl.BlockSpec((m, 1), lambda t, gid_ref, ps_ref: (0, 0)),
+            pl.BlockSpec((1, h, inter),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, h, inter),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+            pl.BlockSpec((1, inter, h),
+                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
+        ],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((block_m, h), x.dtype),
+            pltpu.VMEM((block_m, 1), jnp.float32),
+            *extra_scratch,
+        ],
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("block_m", "top_k", "interpret")
 )
@@ -298,30 +426,12 @@ def _fused_gather_call(
 ) -> Array:
     """``x [N, h]`` resident + in-kernel row gather → aligned ``[m_pad, h]``
     outputs (same aligned layout as :func:`_fused_ffn_call`)."""
-    n, h = x.shape
-    m = probs_flat.shape[0]
-    inter = gate_w.shape[-1]
+    h = x.shape[1]
     m_pad = pair_src.shape[0]
-    n_tiles = m_pad // block_m
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # gid + pair_src ride SMEM
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((n, h), lambda t, gid_ref, ps_ref: (0, 0)),
-            pl.BlockSpec((m, 1), lambda t, gid_ref, ps_ref: (0, 0)),
-            pl.BlockSpec((1, h, inter),
-                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
-            pl.BlockSpec((1, h, inter),
-                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
-            pl.BlockSpec((1, inter, h),
-                         lambda t, gid_ref, ps_ref: (gid_ref[t], 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_m, h),
-                               lambda t, gid_ref, ps_ref: (t, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((block_m, h), x.dtype),
-            pltpu.VMEM((block_m, 1), jnp.float32),
-        ],
+    grid_spec = _gather_grid_spec(
+        x, probs_flat, pair_src, gate_w, block_m,
+        out_spec=pl.BlockSpec((block_m, h),
+                              lambda t, gid_ref, ps_ref: (t, 0)),
     )
     return pl.pallas_call(
         functools.partial(
@@ -329,6 +439,42 @@ def _fused_gather_call(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((m_pad, h), x.dtype),
+        interpret=interpret,
+    )(gid, pair_src, x, probs_flat, gate_w, up_w, down_w)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "top_k", "interpret")
+)
+def _fused_gather_combine_call(
+    x: Array,
+    probs_flat: Array,
+    gid: Array,
+    pair_src: Array,
+    gate_w: Array,
+    up_w: Array,
+    down_w: Array,
+    *,
+    block_m: int,
+    top_k: int,
+    interpret: bool,
+) -> Array:
+    """Gather + FFN + in-kernel combine → token-major ``[N, h]``
+    directly (no aligned y buffer, no XLA pair gather / K-sum)."""
+    n, h = x.shape
+    grid_spec = _gather_grid_spec(
+        x, probs_flat, pair_src, gate_w, block_m,
+        # constant index map: the [N, h] accumulator stays resident in
+        # VMEM across the sequential grid and flushes to HBM once
+        out_spec=pl.BlockSpec((n, h), lambda t, gid_ref, ps_ref: (0, 0)),
+        extra_scratch=(pltpu.VMEM((block_m, h), x.dtype),),
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _ffn_gather_combine_kernel, block_m=block_m, top_k=top_k
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, h), x.dtype),
         interpret=interpret,
     )(gid, pair_src, x, probs_flat, gate_w, up_w, down_w)
 
@@ -362,7 +508,7 @@ def _zero_cotangent(x):
     return np.zeros(x.shape, dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
 def fused_moe_ffn(
     x: Array,
     probs: Array,
@@ -377,6 +523,7 @@ def fused_moe_ffn(
     block_m: int,
     interpret: bool,
     gather: bool,
+    combine: bool,
 ) -> Array:
     """[N, D] tokens + routing -> combined [N, D] expert outputs.
 
@@ -384,24 +531,40 @@ def fused_moe_ffn(
     NamedTuple across the nondiff boundary); int arrays get float0
     cotangents like pallas_flash's segment ids. ``gather`` selects the
     in-kernel row-gather variant (x resident in VMEM; no HBM aligned
-    activation buffer).
+    activation buffer); ``combine`` additionally folds the down-side
+    combine into the kernel (token-major [N, D] accumulated in VMEM —
+    no expert-sorted y in HBM and no XLA pair gather / K-sum).
     """
     out, _ = _fused_fwd(
         x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
-        group_sizes, num_experts, block_m, interpret, gather,
+        group_sizes, num_experts, block_m, interpret, gather, combine,
     )
     return out
 
 
 def _fused_fwd(
     x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
-    group_sizes, num_experts, block_m, interpret, gather,
+    group_sizes, num_experts, block_m, interpret, gather, combine,
 ):
     sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
     meta = aligned_metadata(sort, num_experts, block_m)
     n, h = x.shape
     k = dest.shape[0] // n
     dtype = gate_w.dtype  # caller pre-casts weights to the compute dtype
+    residuals = (x, probs, gate_w, up_w, down_w, sort_idx, dest,
+                 token_idx, group_sizes)
+    if gather and combine:
+        # one kernel end to end: in-kernel row gather AND in-kernel
+        # combine — the only HBM traffic for the whole expert FFN is
+        # x/probs in (resident loads) and the combined [N, h] out
+        out = _fused_gather_combine_call(
+            x.astype(dtype),
+            probs.reshape(-1, 1).astype(jnp.float32),
+            meta.gid, meta.pair_src,
+            gate_w, up_w, down_w,
+            block_m=block_m, top_k=k, interpret=interpret,
+        )
+        return out.astype(x.dtype), residuals
     if gather:
         # the kernel gathers rows itself from a VMEM-resident x — no
         # [m_pad, h] aligned buffer in HBM at all (the buffer costs a
@@ -441,12 +604,11 @@ def _fused_fwd(
     # combine_pairs formulation, over the aligned layout)
     pair_y = jnp.take(y_aligned, meta.dest_aligned, axis=0)
     out = pair_y.reshape(n, k, h).sum(axis=1).astype(x.dtype)
-    residuals = (x, probs, gate_w, up_w, down_w, sort_idx, dest,
-                 token_idx, group_sizes)
     return out, residuals
 
 
-def _fused_bwd(num_experts, block_m, interpret, gather, residuals, d_out):
+def _fused_bwd(num_experts, block_m, interpret, gather, combine, residuals,
+               d_out):
     (x, probs, gate_w, up_w, down_w, sort_idx, dest, token_idx,
      group_sizes) = residuals
     sort = TokenSort(sort_idx, dest, token_idx, group_sizes)
@@ -488,12 +650,17 @@ def fused_moe_ffn_apply(
     block_m: int | None = None,
     interpret: bool | None = None,
     gather: bool | None = None,
+    combine: bool | None = None,
 ) -> Array:
     """Entry point for nn/moe.py: fused kernel when eligible, else the
     reference XLA chain (identical math either way). ``gather`` forces
     the in-kernel row-gather variant on/off (None = env-selected via
-    ``D9D_TPU_MOE_FFN=pallas_gather``); either way the VMEM-fit gate
-    can veto it."""
+    ``D9D_TPU_MOE_FFN=pallas_gather``); ``combine`` forces the
+    in-kernel combine on/off (None = ``D9D_TPU_MOE_COMBINE``, default
+    fused, gather variant only). Either way the VMEM-fit gates can
+    veto per shape."""
+    from d9d_tpu.ops.moe import fused_combine_enabled
+
     h = x.shape[-1]
     inter = gate_w.shape[-1]
     if interpret is None:
@@ -509,13 +676,19 @@ def fused_moe_ffn_apply(
         x.shape[0], probs.size, h, inter, block_m, itemsize,
         num_experts=num_experts,
     )
+    if combine is None:
+        combine = fused_combine_enabled()
+    combine = gather and combine and _combine_fits(
+        x.shape[0], probs.size, h, inter, block_m, itemsize,
+        num_experts=num_experts,
+    )
     from jax.ad_checkpoint import checkpoint_name
 
     out = fused_moe_ffn(
         x, probs,
         gate_w.astype(dtype), up_w.astype(dtype), down_w.astype(dtype),
         sort.sort_idx, sort.dest, sort.token_idx, sort.group_sizes,
-        num_experts, block_m, interpret, gather,
+        num_experts, block_m, interpret, gather, combine,
     )
     # same checkpoint name the XLA chain's grouped dots carry, so the
     # save_expensive remat policy keeps its meaning under this backend

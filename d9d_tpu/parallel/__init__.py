@@ -8,14 +8,24 @@ from d9d_tpu.parallel.plan import (
     replicate_plan,
     tp_plan,
 )
+from d9d_tpu.parallel.zero import (
+    ZeroSharding,
+    ZeroShardedOptimizer,
+    build_zero_sharding,
+    tree_bytes_per_device,
+)
 
 __all__ = [
     "LogicalRules",
     "ParallelPlan",
+    "ZeroSharding",
+    "ZeroShardedOptimizer",
+    "build_zero_sharding",
     "fsdp_ep_plan",
     "fsdp_plan",
     "hsdp_plan",
     "logical_to_mesh_sharding",
     "replicate_plan",
     "tp_plan",
+    "tree_bytes_per_device",
 ]

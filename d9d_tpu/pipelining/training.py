@@ -49,12 +49,15 @@ class PipelinedOptimizer:
     # the ok flag rides the same scalar hops as the clip factor, so the
     # guard adds no dispatches and no readbacks to the step
     anomaly_freeze: bool = False
+    # ZeRO optimizer-state sharding (parallel/zero.py): shard each
+    # stage's fp32 masters/moments over this axis of its submesh —
+    # grads reduce-scattered at the update entry, the update computed
+    # on the 1/N shard, new params all-gathered back. The per-stage
+    # sharding tables are computed in init() from the concrete states,
+    # so the jitted updates are built lazily per stage. None = off.
+    zero_axis: str | None = None
 
     def __post_init__(self) -> None:
-        opt = self.optimizer
-        accepts_fp32 = getattr(opt, "accepts_fp32_grads", False)
-        apply_updates = getattr(opt, "apply_updates", optax.apply_updates)
-
         def sq_norm(grads):
             with jax.named_scope("pp_opt/sq_norm"):
                 return optax.global_norm(grads) ** 2
@@ -71,16 +74,6 @@ class PipelinedOptimizer:
                     else 1.0
                 )
                 return norm, inv_w * clip
-
-        def update(params, opt_state, grads, factor):
-            with jax.named_scope("pp_opt/update"):
-                grads = jax.tree.map(lambda g: g * factor, grads)
-                if not accepts_fp32:
-                    grads = jax.tree.map(
-                        lambda g, p: g.astype(p.dtype), grads, params
-                    )
-                updates, opt_state = opt.update(grads, opt_state, params)
-                return apply_updates(params, updates), opt_state
 
         def combine_guarded(sq_norms, weight_sum, loss_sum, guard, max_norm):
             # the unguarded combine, plus finiteness of the two scalars
@@ -102,7 +95,36 @@ class PipelinedOptimizer:
                 }
                 return norm, factor, ok, new_guard, metrics
 
+        self._sq_norm = jax.jit(sq_norm)
+        self._combine = jax.jit(
+            functools.partial(combine, max_norm=self.max_grad_norm)
+        )
+        self._combine_guarded = jax.jit(
+            functools.partial(combine_guarded, max_norm=self.max_grad_norm)
+        )
+        # default jitted updates over the plain optimizer; zero-enabled
+        # stages get their own pair in init() (per-stage sharding tables)
+        self._default_fns = self._build_update_fns(self.optimizer)
+        self._stage_fns: dict[int, tuple] = {}
+        self.zero_shardings: dict[int, Any] = {}
+
+    def _build_update_fns(self, opt) -> tuple:
+        """(update, update_guarded) jits closed over ``opt`` — one pair
+        per distinct optimizer instance (the ZeRO wrapper bakes its
+        sharding tables into the traced program)."""
+        accepts_fp32 = getattr(opt, "accepts_fp32_grads", False)
+        apply_updates = getattr(opt, "apply_updates", optax.apply_updates)
         freeze = self.anomaly_freeze
+
+        def update(params, opt_state, grads, factor):
+            with jax.named_scope("pp_opt/update"):
+                grads = jax.tree.map(lambda g: g * factor, grads)
+                if not accepts_fp32:
+                    grads = jax.tree.map(
+                        lambda g, p: g.astype(p.dtype), grads, params
+                    )
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return apply_updates(params, updates), opt_state
 
         def update_guarded(params, opt_state, grads, factor, ok):
             with jax.named_scope("pp_opt/update_guarded"):
@@ -120,17 +142,13 @@ class PipelinedOptimizer:
                     )
                 return new_params, new_state
 
-        self._sq_norm = jax.jit(sq_norm)
-        self._combine = jax.jit(
-            functools.partial(combine, max_norm=self.max_grad_norm)
+        return (
+            jax.jit(update, donate_argnums=(0, 1, 2)),
+            jax.jit(update_guarded, donate_argnums=(0, 1, 2)),
         )
-        self._update = jax.jit(update, donate_argnums=(0, 1, 2))
-        self._combine_guarded = jax.jit(
-            functools.partial(combine_guarded, max_norm=self.max_grad_norm)
-        )
-        self._update_guarded = jax.jit(
-            update_guarded, donate_argnums=(0, 1, 2)
-        )
+
+    def _stage_update_fns(self, stage: int) -> tuple:
+        return self._stage_fns.get(stage, self._default_fns)
 
     def _scoped(self, stage: int):
         return compat.set_mesh(self.scalar_shardings[stage].mesh)
@@ -148,7 +166,35 @@ class PipelinedOptimizer:
                     jax.jit(self.optimizer.init)(p),
                     self.scalar_shardings[s].mesh,
                 )
+            if self.zero_axis is not None:
+                out[s] = self._enable_zero(s, p, out[s])
         return out
+
+    def _enable_zero(self, stage: int, params: PyTree, state: PyTree):
+        """Shard ``stage``'s optimizer state over ``zero_axis`` and swap
+        in a ZeRO-wrapped update pair for that stage. The anomaly-guard
+        freeze select stays elementwise, so frozen moments freeze
+        shard-local — PR 5 semantics preserved on sharded state."""
+        from d9d_tpu.parallel.zero import (
+            ZeroShardedOptimizer,
+            build_zero_sharding,
+            place_tree,
+        )
+
+        mesh = self.scalar_shardings[stage].mesh
+        if self.zero_axis not in mesh.shape:
+            raise ValueError(
+                f"zero_axis {self.zero_axis!r} not in stage {stage}'s "
+                f"submesh axes {tuple(mesh.shape)}"
+            )
+        zero = build_zero_sharding(
+            params=params, opt_state=state, mesh=mesh, axis=self.zero_axis
+        )
+        self.zero_shardings[stage] = zero
+        self._stage_fns[stage] = self._build_update_fns(
+            ZeroShardedOptimizer(self.optimizer, zero)
+        )
+        return place_tree(state, zero.state_shardings)
 
     def step(
         self,
@@ -176,8 +222,9 @@ class PipelinedOptimizer:
         with annotate("pp_opt.update"):
             for s in sorted(stage_params):
                 f = put_compat(factor, self.scalar_shardings[s])
+                update, _ = self._stage_update_fns(s)
                 with self._scoped(s):
-                    new_params[s], new_states[s] = self._update(
+                    new_params[s], new_states[s] = update(
                         stage_params[s], opt_states[s], stage_grads[s], f
                     )
         return new_params, new_states, norm
@@ -232,8 +279,9 @@ class PipelinedOptimizer:
                 # the ok flag rides the same hop as the clip factor: one
                 # put per stage either way, no extra dispatches
                 f, ok_s = put_compat((factor, ok), self.scalar_shardings[s])
+                _, update_guarded = self._stage_update_fns(s)
                 with self._scoped(s):
-                    new_params[s], new_states[s] = self._update_guarded(
+                    new_params[s], new_states[s] = update_guarded(
                         stage_params[s], opt_states[s], stage_grads[s],
                         f, ok_s,
                     )

@@ -254,6 +254,130 @@ def test_fused_parity_random_geometry(seed):
     )
 
 
+class TestFusedCombine:
+    """r7 gather-fused combine (ops/moe_pallas.py): the kernel emits the
+    token-major combined [N, h] directly — parity fwd + grads vs the
+    existing combine, the default-on env knob, and the fit gate."""
+
+    @pytest.mark.parametrize("block_m", [8, 16, 64])
+    def test_forward_matches_reference(self, block_m):
+        x, ids, probs, wg, wu, wd = _problem(seed=21)
+        e = wg.shape[0]
+        sort = sort_tokens_by_expert(ids, e)
+        ref = _reference(x, probs, sort, wg, wu, wd, jnp.float32)
+        got = fused_moe_ffn_apply(
+            x, probs, sort, wg, wu, wd, jnp.float32,
+            num_experts=e, block_m=block_m, interpret=True,
+            gather=True, combine=True,
+        )
+        # the in-kernel K-sum accumulates in expert-sorted order vs the
+        # XLA path's slot order: ulp tolerance, same as the other paths
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_matches_uncombined_gather_variant(self):
+        """combine on vs off over the SAME gather kernel inputs."""
+        x, ids, probs, wg, wu, wd = _problem(seed=23)
+        e = wg.shape[0]
+        sort = sort_tokens_by_expert(ids, e)
+        off = fused_moe_ffn_apply(
+            x, probs, sort, wg, wu, wd, jnp.float32,
+            num_experts=e, block_m=16, interpret=True,
+            gather=True, combine=False,
+        )
+        on = fused_moe_ffn_apply(
+            x, probs, sort, wg, wu, wd, jnp.float32,
+            num_experts=e, block_m=16, interpret=True,
+            gather=True, combine=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(on), np.asarray(off), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gradients_match_reference(self):
+        """The combine variant rides the same custom_vjp backward (the
+        XLA reference chain) — grads must match end to end."""
+        x, ids, probs, wg, wu, wd = _problem(seed=25)
+        e = wg.shape[0]
+        sort = sort_tokens_by_expert(ids, e)
+        cot = jnp.asarray(
+            np.random.RandomState(9).randn(*x.shape), jnp.float32
+        )
+
+        def loss(fn):
+            def run(x_, probs_, wg_, wu_, wd_):
+                return (fn(x_, probs_, wg_, wu_, wd_) * cot).sum()
+            return run
+
+        ref = loss(lambda x_, p_, g_, u_, d_: _reference(
+            x_, p_, sort, g_, u_, d_, jnp.float32
+        ))
+        fused = loss(lambda x_, p_, g_, u_, d_: fused_moe_ffn_apply(
+            x_, p_, sort, g_, u_, d_, jnp.float32,
+            num_experts=e, block_m=16, interpret=True,
+            gather=True, combine=True,
+        ))
+        g_ref = jax.grad(ref, argnums=(0, 1, 2, 3, 4))(x, probs, wg, wu, wd)
+        g_fused = jax.grad(fused, argnums=(0, 1, 2, 3, 4))(
+            x, probs, wg, wu, wd
+        )
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+            )
+
+    def test_env_knob_defaults_on(self, monkeypatch):
+        from d9d_tpu.ops.moe import fused_combine_enabled
+
+        monkeypatch.delenv("D9D_TPU_MOE_COMBINE", raising=False)
+        assert fused_combine_enabled()
+        monkeypatch.setenv("D9D_TPU_MOE_COMBINE", "unfused")
+        assert not fused_combine_enabled()
+
+    def test_combine_fit_gate(self, monkeypatch):
+        from d9d_tpu.ops.moe_pallas import _combine_fits, _gather_fits
+
+        assert _combine_fits(96, 192, 64, 32, 16, 4, num_experts=8)
+        # anything the gather gate rejects, the combine gate rejects
+        assert not _combine_fits(97, 194, 64, 32, 16, 4, num_experts=8)
+        # a budget that fits the gather residency but not the extra
+        # [N, h] output residency routes to the uncombined variant
+        gather_only = None
+        for budget in range(20_000, 400_000, 10_000):
+            monkeypatch.setenv("D9D_TPU_MOE_FFN_VMEM_BUDGET", str(budget))
+            if _gather_fits(96, 192, 64, 32, 16, 4, num_experts=8):
+                gather_only = budget
+                break
+        assert gather_only is not None
+        assert not _combine_fits(96, 192, 64, 32, 16, 4, num_experts=8)
+
+    def test_skewed_and_empty_experts(self):
+        """Every token on one expert: pad tiles and the scatter loop's
+        branchless pad handling must stay exact."""
+        n, e, k = 32, 6, 2
+        rng = np.random.RandomState(31)
+        x = jnp.asarray(rng.randn(n, 64), jnp.float32)
+        wg = jnp.asarray(rng.randn(e, 64, 32) * 0.1, jnp.float32)
+        wu = jnp.asarray(rng.randn(e, 64, 32) * 0.1, jnp.float32)
+        wd = jnp.asarray(rng.randn(e, 32, 64) * 0.1, jnp.float32)
+        ids = jnp.stack(
+            [jnp.full((n,), 3, jnp.int32), jnp.full((n,), 5, jnp.int32)],
+            axis=1,
+        )
+        probs = jnp.asarray(rng.rand(n, k), jnp.float32)
+        sort = sort_tokens_by_expert(ids, e)
+        ref = _reference(x, probs, sort, wg, wu, wd, jnp.float32)
+        got = fused_moe_ffn_apply(
+            x, probs, sort, wg, wu, wd, jnp.float32,
+            num_experts=e, block_m=8, interpret=True,
+            gather=True, combine=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
 def test_unfused_gate_up_env_knob_exact(monkeypatch):
     """D9D_TPU_MOE_FUSED_GATE_UP=0 (two grouped matmuls, no runtime
     weight concat — the ub1/fp32 A/B tools/roofline.py motivates) must be

@@ -1,0 +1,370 @@
+"""ZeRO optimizer-state sharding (parallel/zero.py,
+docs/design/zero_sharding.md): sharding-table construction, CPU
+exactness of the sharded update vs the replicated path across
+dp_replicate ∈ {1, 2, 4} for optax AdamW and StochasticAdamW, the PP
+path with the anomaly guard firing on sharded moments, the
+opt/state_bytes_per_chip gauge, and the split-update introspection mode.
+
+Exactness contract (see the design page): dp_replicate=1 is BITWISE
+(every constraint an identity); dp_replicate>1 agrees at ulp tolerance —
+per-element arithmetic is order-preserved by construction, but XLA
+re-partitions local reductions (grad-norm partials, CPU-backend fusion
+tiling) when the program carries sharded operands.
+"""
+
+import flax.linen as nn
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.mesh import AXIS_DP_REPLICATE, MeshParameters
+from d9d_tpu.core.tree_sharding import replicate_uncommitted
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.train_step import build_train_step
+from d9d_tpu.optim import StochasticAdamW
+from d9d_tpu.parallel.zero import (
+    ZeroShardedOptimizer,
+    _extend_spec,
+    build_zero_sharding,
+    place_tree,
+    tree_bytes_per_device,
+)
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+class ToyTask(TrainTask):
+    def prepare_batch(self, batch):
+        return batch
+
+    def loss_fn(self, module, params, mb, rng):
+        y = module.apply(params, mb["x"])
+        return jnp.sum((y - mb["y"]) ** 2), jnp.float32(mb["x"].shape[0]), {}
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(16)(x)
+        return nn.Dense(4)(jax.nn.relu(h))
+
+
+def _make_opt(name):
+    if name == "adamw":
+        return optax.adamw(1e-2)
+    # fp32 moments: the strict-parity recipe (bf16 moments round the
+    # ulp-level re-partitioning noise across a whole bf16 ulp)
+    return StochasticAdamW(1e-2, moment_dtype=jnp.float32, seed=3)
+
+
+def _run(dp, zero_on, opt_name, *, steps=3, anomaly_policy=None,
+         nan_at=None, split_update=False, max_grad_norm=1.0):
+    """Train `steps` steps of the toy net; returns host param/state trees
+    and the final metrics."""
+    ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+    module = Net()
+    x = jnp.ones((2, 4, 8)) * jnp.arange(8)
+    y = jnp.linspace(0, 1, 2 * 4 * 4).reshape(2, 4, 4)
+    params = jax.device_put(
+        module.init(jax.random.PRNGKey(0), x[0]),
+        NamedSharding(ctx.mesh, P()),
+    )
+    opt = _make_opt(opt_name)
+    opt_state = replicate_uncommitted(jax.jit(opt.init)(params), ctx.mesh)
+    zero = None
+    if zero_on:
+        zero = build_zero_sharding(
+            params=params, opt_state=opt_state, mesh=ctx.mesh
+        )
+        opt_state = place_tree(opt_state, zero.state_shardings)
+        opt = ZeroShardedOptimizer(opt, zero)
+    step = build_train_step(
+        module=module, task=ToyTask(), optimizer=opt, num_microbatches=2,
+        anomaly_policy=anomaly_policy, zero=zero, split_update=split_update,
+        max_grad_norm=max_grad_norm,
+    )
+    rng = jax.random.PRNGKey(1)
+    metrics = None
+    for i in range(steps):
+        mb = {"x": x * jnp.nan, "y": y} if i == nan_at else {"x": x, "y": y}
+        params, opt_state, metrics = step(params, opt_state, mb, rng)
+    return (
+        jax.tree.map(np.asarray, params),
+        jax.tree.map(np.asarray, opt_state),
+        {k: np.asarray(v) for k, v in metrics.items()},
+    )
+
+
+def _assert_trees(a, b, *, bitwise):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        if bitwise:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(x, np.float64), np.asarray(y, np.float64),
+                rtol=RTOL, atol=ATOL,
+            )
+
+
+# -- sharding tables ------------------------------------------------------
+
+class TestShardingTables:
+    def test_extend_spec_picks_largest_divisible_dim(self):
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp_r", "tp"))
+        # dim 1 is larger and divisible -> gets the axis
+        assert _extend_spec(P(), (4, 16), mesh, "dp_r") == P(None, "dp_r")
+        # existing sharding composes: dim 0 taken by tp -> extend there
+        # only if divisibility after tp holds, else pick the free dim
+        assert _extend_spec(P("tp"), (4, 16), mesh, "dp_r") == P(
+            "tp", "dp_r"
+        )
+        # indivisible everywhere -> None
+        assert _extend_spec(P(), (3, 5), mesh, "dp_r") is None
+        # already sharded over the axis -> None (never double-shard)
+        assert _extend_spec(P("dp_r"), (4, 16), mesh, "dp_r") is None
+
+    def test_tables_skip_integer_riders(self):
+        dp = 2
+        ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+        module = Net()
+        params = jax.device_put(
+            module.init(jax.random.PRNGKey(0), jnp.ones((4, 8))),
+            NamedSharding(ctx.mesh, P()),
+        )
+        opt = StochasticAdamW(1e-2)
+        state = replicate_uncommitted(jax.jit(opt.init)(params), ctx.mesh)
+        zero = build_zero_sharding(
+            params=params, opt_state=state, mesh=ctx.mesh
+        )
+        assert zero.active and zero.axis == AXIS_DP_REPLICATE
+        # count (int scalar) and the PRNG key must opt out; mu/nu shard
+        flat = jax.tree.leaves(
+            zero.state_shardings, is_leaf=lambda x: x is None
+        )
+        assert any(s is None for s in flat)
+        shards = [s for s in flat if s is not None]
+        assert shards, "no state leaf took the zero sharding"
+
+        def has_axis(spec):
+            return any(
+                AXIS_DP_REPLICATE in (e if isinstance(e, tuple) else (e,))
+                for e in spec
+                if e is not None
+            )
+
+        assert all(has_axis(s.spec) for s in shards)
+
+    def test_state_bytes_scale_with_dp(self):
+        sizes = {}
+        for dp in (1, 2, 4):
+            ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+            module = Net()
+            params = jax.device_put(
+                module.init(jax.random.PRNGKey(0), jnp.ones((4, 8))),
+                NamedSharding(ctx.mesh, P()),
+            )
+            opt = optax.adamw(1e-2)
+            state = replicate_uncommitted(
+                jax.jit(opt.init)(params), ctx.mesh
+            )
+            zero = build_zero_sharding(
+                params=params, opt_state=state, mesh=ctx.mesh
+            )
+            state = place_tree(state, zero.state_shardings)
+            sizes[dp] = tree_bytes_per_device(state)
+        # moments dominate the toy state: per-chip bytes must drop by
+        # roughly 1/N (scalars/odd leaves stay replicated)
+        assert sizes[2] < 0.7 * sizes[1]
+        assert sizes[4] < 0.7 * sizes[2]
+
+
+# -- exactness vs the replicated path ------------------------------------
+
+class TestExactness:
+    @pytest.mark.parametrize("opt_name", ["adamw", "sadamw"])
+    def test_dp1_bitwise(self, opt_name):
+        base = _run(1, False, opt_name)
+        zeroed = _run(1, True, opt_name)
+        _assert_trees(base[0], zeroed[0], bitwise=True)
+        _assert_trees(base[1], zeroed[1], bitwise=True)
+
+    @pytest.mark.parametrize("opt_name", ["adamw", "sadamw"])
+    def test_dp2_matches_replicated(self, opt_name):
+        base = _run(2, False, opt_name)
+        zeroed = _run(2, True, opt_name)
+        _assert_trees(base[0], zeroed[0], bitwise=False)
+        _assert_trees(base[1], zeroed[1], bitwise=False)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("opt_name", ["adamw", "sadamw"])
+    def test_dp4_matches_replicated(self, opt_name):
+        base = _run(4, False, opt_name)
+        zeroed = _run(4, True, opt_name)
+        _assert_trees(base[0], zeroed[0], bitwise=False)
+        _assert_trees(base[1], zeroed[1], bitwise=False)
+
+    def test_guard_freezes_sharded_moments(self):
+        """skip_step under ZeRO: a NaN step leaves params AND the
+        sharded moments bitwise frozen, and the replicated comparison
+        still holds across the anomaly."""
+        base = _run(2, False, "adamw", steps=3, anomaly_policy="skip_step",
+                    nan_at=1)
+        zeroed = _run(2, True, "adamw", steps=3, anomaly_policy="skip_step",
+                      nan_at=1)
+        assert float(zeroed[2]["resilience/anomaly_total"]) == 1.0
+        _assert_trees(base[0], zeroed[0], bitwise=False)
+        _assert_trees(base[1], zeroed[1], bitwise=False)
+
+    def test_guard_freeze_is_bitwise_under_zero(self):
+        """The frozen step itself: state before == state after the NaN
+        step, on the SHARDED trees (PR 5 freeze semantics)."""
+        ctx = MeshParameters(dp_replicate=2).build(jax.devices()[:2])
+        module = Net()
+        x = jnp.ones((2, 4, 8))
+        y = jnp.zeros((2, 4, 4))
+        params = jax.device_put(
+            module.init(jax.random.PRNGKey(0), x[0]),
+            NamedSharding(ctx.mesh, P()),
+        )
+        opt = optax.adamw(1e-2)
+        opt_state = replicate_uncommitted(
+            jax.jit(opt.init)(params), ctx.mesh
+        )
+        zero = build_zero_sharding(
+            params=params, opt_state=opt_state, mesh=ctx.mesh
+        )
+        opt_state = place_tree(opt_state, zero.state_shardings)
+        opt = ZeroShardedOptimizer(opt, zero)
+        step = build_train_step(
+            module=module, task=ToyTask(), optimizer=opt,
+            num_microbatches=2, anomaly_policy="skip_step", zero=zero,
+        )
+        rng = jax.random.PRNGKey(1)
+        params, opt_state, _ = step(params, opt_state, {"x": x, "y": y}, rng)
+        p_before = jax.tree.map(np.asarray, params)
+        s_before = jax.tree.map(np.asarray, opt_state)
+        params, opt_state, m = step(
+            params, opt_state, {"x": x * jnp.nan, "y": y}, rng
+        )
+        assert float(m["resilience/anomaly"]) == 1.0
+        _assert_trees(p_before, jax.tree.map(np.asarray, params), bitwise=True)
+        _assert_trees(s_before, jax.tree.map(np.asarray, opt_state), bitwise=True)
+
+
+# -- PP path (PipelinedOptimizer) ----------------------------------------
+
+class TestPipelinedZero:
+    def _run_pp(self, zero_axis, opt, nan_at=None, steps=2):
+        from d9d_tpu.pipelining.training import PipelinedOptimizer
+
+        mesh = Mesh(np.array(jax.devices()[:2]), (AXIS_DP_REPLICATE,))
+        sh = NamedSharding(mesh, P())
+        popt = PipelinedOptimizer(
+            optimizer=opt,
+            scalar_shardings={0: sh, 1: sh},
+            anomaly_freeze=True,
+            zero_axis=zero_axis,
+        )
+        params = {
+            0: {"w": jax.device_put(jnp.linspace(0, 1, 16).reshape(4, 4), sh)},
+            1: {"w": jax.device_put(jnp.linspace(1, 2, 16).reshape(4, 4), sh)},
+        }
+        states = popt.init(params)
+        guard = popt.init_guard_state()
+        w = jnp.float32(1.0)
+        gm = None
+        for i in range(steps):
+            if i == nan_at:
+                grads = {s: {"w": jnp.full((4, 4), jnp.nan)} for s in (0, 1)}
+            else:
+                grads = {
+                    s: {"w": jnp.full((4, 4), 0.1 * (i + 1))} for s in (0, 1)
+                }
+            params, states, _, gm, guard = popt.step_guarded(
+                params, states, grads, w, jnp.float32(1.0), guard
+            )
+        return (
+            jax.tree.map(np.asarray, params),
+            jax.tree.map(np.asarray, states),
+            {k: float(v) for k, v in gm.items()},
+            popt,
+        )
+
+    @pytest.mark.parametrize("opt_name", ["adamw", "sadamw"])
+    def test_matches_replicated_with_guard_firing(self, opt_name):
+        base = self._run_pp(None, _make_opt(opt_name), nan_at=1, steps=3)
+        zeroed = self._run_pp(
+            AXIS_DP_REPLICATE, _make_opt(opt_name), nan_at=1, steps=3
+        )
+        assert zeroed[2]["resilience/anomaly_total"] == 1.0
+        _assert_trees(base[0], zeroed[0], bitwise=False)
+        _assert_trees(base[1], zeroed[1], bitwise=False)
+
+    def test_state_actually_sharded(self):
+        _, states, _, popt = self._run_pp(
+            AXIS_DP_REPLICATE, optax.adamw(1e-2)
+        )
+        assert set(popt.zero_shardings) == {0, 1}
+        for z in popt.zero_shardings.values():
+            assert z.active
+
+
+# -- trainer gauge + split-update introspection --------------------------
+
+def _micro_trainer(dp, zero, tmp_path, **overrides):
+    from tests.resilience.conftest import MicroProvider, MicroLoaderProvider
+    from d9d_tpu.loop import CausalLMTask, Trainer, TrainerConfig
+
+    ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+    defaults = dict(
+        global_batch_size=8,
+        microbatch_size=8,
+        seq_len=8,
+        total_steps=3,
+        log_every=1,
+        prefetch_batches=0,
+        telemetry_console=False,
+        gc_every_steps=None,
+        zero_sharding=zero,
+    )
+    defaults.update(overrides)
+    return Trainer(
+        ctx=ctx,
+        config=TrainerConfig(**defaults),
+        model_provider=MicroProvider(),
+        dataset_provider=MicroLoaderProvider(),
+        task=CausalLMTask(),
+        optimizer_provider=__import__(
+            "d9d_tpu.loop", fromlist=["AdamWProvider"]
+        ).AdamWProvider(),
+    )
+
+
+def test_opt_state_bytes_gauge_scales(tmp_path):
+    from d9d_tpu.telemetry import get_telemetry
+
+    replicated = _micro_trainer(4, False, tmp_path)
+    b_rep = replicated.opt_state_bytes_per_chip()
+    assert get_telemetry().gauge("opt/state_bytes_per_chip").value == b_rep
+    sharded = _micro_trainer(4, True, tmp_path)
+    b_zero = sharded.opt_state_bytes_per_chip()
+    assert get_telemetry().gauge("opt/state_bytes_per_chip").value == b_zero
+    # MicroLM moments dominate -> ~1/4 per chip, scalars stay replicated
+    assert b_zero < 0.5 * b_rep
+
+
+def test_split_update_parity_and_inventory():
+    from d9d_tpu.telemetry.introspect import inventory, reset_inventory
+
+    base = _run(2, True, "adamw")
+    reset_inventory()
+    split = _run(2, True, "adamw", split_update=True)
+    _assert_trees(base[0], split[0], bitwise=False)
+    _assert_trees(base[1], split[1], bitwise=False)
+    names = {rec.name for rec in inventory()}
+    assert "train_opt_update" in names
+    assert "train_step" in names

@@ -39,3 +39,11 @@ def test_roofline_scenarios():
     assert (moe2["predicted_mfu"] > moe1["predicted_mfu"])
     assert (moe4["predicted_mfu"] > moe2["predicted_mfu"])
     assert moe4["predicted_mfu"] >= 0.25
+
+    # ZeRO pre-registrations (docs/design/zero_sharding.md): sharding
+    # the optimizer stream + grad accumulator over 4 replicas must beat
+    # every same-µBS replicated row, and ub2+zero already clears 0.25
+    for ub, base in (("1_fp32", moe1), ("2_bf16", moe2), ("4_bf16", moe4)):
+        z = rows[f"qwen3_moe_ub{ub}_zero4"]
+        assert z["predicted_mfu"] > base["predicted_mfu"]
+    assert rows["qwen3_moe_ub2_bf16_zero4"]["predicted_mfu"] >= 0.25

@@ -358,11 +358,23 @@ def bench_moe_ffn(tiny):
             )
         )
         # r5: in-kernel row gather (x resident in VMEM) — the aligned
-        # activation buffer never round-trips HBM
+        # activation buffer never round-trips HBM. combine pinned OFF so
+        # this row keeps measuring the r5 kernel (cross-round
+        # comparability); the r7 combine fusion gets its own variant
         variants[f"pallas_gather_bm{bm}"] = jax.jit(
             lambda x, probs, ids, wg, wu, wd, bm=bm: fused_moe_ffn_apply(
                 x, probs, sort_tokens_by_expert(ids, e), wg, wu, wd,
                 jnp.bfloat16, num_experts=e, block_m=bm, gather=True,
+                combine=False,
+            )
+        )
+        # r7: gather + in-kernel combine — token-major [N, h] output
+        # accumulated in VMEM, expert-sorted y never touches HBM
+        variants[f"pallas_gather_combine_bm{bm}"] = jax.jit(
+            lambda x, probs, ids, wg, wu, wd, bm=bm: fused_moe_ffn_apply(
+                x, probs, sort_tokens_by_expert(ids, e), wg, wu, wd,
+                jnp.bfloat16, num_experts=e, block_m=bm, gather=True,
+                combine=True,
             )
         )
     cfg = f"n{n}_h{h}_i{inter}_e{e}_k{k}"

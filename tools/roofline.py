@@ -178,18 +178,27 @@ def _embed_head_ce(inv, n_step, h, vocab, dtype_b, passes, ce_chunk,
     inv.add("embed", bytes_=passes * dtype_b * n_step * h * 2)
 
 
-def _optimizer(inv, params, moment_dtype_b, param_dtype_b):
-    # AdamW: read p, m, v, g; write p, m, v (fp32 grads accumulated)
+def _optimizer(inv, params, moment_dtype_b, param_dtype_b, zero_n=1):
+    # AdamW: read p, m, v, g; write p, m, v (fp32 grads accumulated).
+    # Under ZeRO-sharded state (zero_n > 1, parallel/zero.py) the chip
+    # reads its 1/N param shard + 1/N of both moments (read+write) + the
+    # 1/N grad shard, and writes the FULL all-gathered new params (the
+    # gather itself is ICI traffic, not HBM)
     b = params * (
-        param_dtype_b * 2 + moment_dtype_b * 4 + 4  # grad read fp32
+        param_dtype_b            # new params written full (post-gather)
+        + param_dtype_b / zero_n  # param shard read
+        + moment_dtype_b * 4 / zero_n  # m, v read+write on the shard
+        + 4 / zero_n             # grad shard read (fp32)
     )
     inv.add("optimizer", bytes_=b)
 
 
-def _grad_accum(inv, params, microbatches):
+def _grad_accum(inv, params, microbatches, zero_n=1):
     if microbatches > 1:
-        # fp32 accumulator read+write per microbatch
-        inv.add("grad_accum", bytes_=params * 4 * 2 * microbatches)
+        # fp32 accumulator read+write per microbatch; ZeRO pins the
+        # scan carry to the dp_r-sharded layout so the accumulator —
+        # BASELINE.md's 66 ms/step row — shrinks to 1/N per chip
+        inv.add("grad_accum", bytes_=params * 4 * 2 * microbatches / zero_n)
 
 
 def dense_scenario():
@@ -224,10 +233,14 @@ def dense_scenario():
 
 
 def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True,
-                 hybrid=False):
+                 hybrid=False, zero_n=1):
     """Qwen3-MoE north-star geometry; ``hybrid=True`` swaps 12 of the 16
     attention layers for GatedDeltaNet (bench.py run_bench_moe(hybrid=
-    True) — BASELINE config 5)."""
+    True) — BASELINE config 5). ``zero_n`` predicts the
+    ``D9D_BENCH_MOE_ZERO=1`` leg on an N-chip dp_replicate mesh at
+    constant per-chip load: compute terms are per-chip and unchanged,
+    only the optimizer stream and the fp32 grad accumulator divide by N
+    (parallel/zero.py; pre-registered BEFORE the chip window)."""
     h, layers, heads, kvh, hd = 768, 16, 12, 4, 64
     inter, n_experts, topk, vocab = 256, 64, 8, 32768
     seq, batch = 2048, 8
@@ -270,8 +283,8 @@ def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True,
         _embed_head_ce(inv, n, h, vocab, dtype_b, 3,
                        2048 if n <= 2048 else 512, param_dtype_b)
     moment_b = 4 if param_dtype_b == 4 else 2  # bf16 params -> SR moments
-    _optimizer(inv, params, moment_b, param_dtype_b)
-    _grad_accum(inv, params, microbatches)
+    _optimizer(inv, params, moment_b, param_dtype_b, zero_n=zero_n)
+    _grad_accum(inv, params, microbatches, zero_n=zero_n)
     tokens = batch * seq
     active = dense_params + expert_params * topk / n_experts
     attn_f = 6 * n_attn * heads * hd * seq
@@ -286,6 +299,8 @@ def moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=True, sortfree=True,
         name += "_unfused_gate_up"
     if not sortfree:
         name += "_argsort"
+    if zero_n > 1:
+        name += f"_zero{zero_n}"
     return name, inv.report(tokens, model_fpt)
 
 
@@ -367,6 +382,12 @@ def main():
         moe_scenario(ub=1, param_dtype_b=4, fused_gate_up=False),
         moe_scenario(ub=1, param_dtype_b=4, hybrid=True),
         moe_scenario(ub=2, param_dtype_b=2, hybrid=True),
+        # ZeRO pre-registrations (D9D_BENCH_MOE_ZERO=1 on a 4-chip
+        # dp_replicate slice, constant per-chip load): the optimizer
+        # stream + fp32 grad accumulator divide by N
+        moe_scenario(ub=1, param_dtype_b=4, zero_n=4),
+        moe_scenario(ub=2, param_dtype_b=2, zero_n=4),
+        moe_scenario(ub=4, param_dtype_b=2, zero_n=4),
         decode_scenario(),
     ]
     for name, rep in scenarios:

@@ -157,22 +157,56 @@ r["detail"]["variant"] = "ub1_unfused_gate_up"
 print(json.dumps(r))
 EOF
 
-# µBS sweep with bf16 master weights + stochastic AdamW (any ub>1).
+# r7 A/B: gather-fused FFN with the in-kernel combine DISABLED (the
+# default is fused; this leg isolates the combine half of the
+# permute+combine gather traffic — ops/moe_pallas.py)
+D9D_TPU_MOE_FFN=pallas_gather D9D_TPU_MOE_COMBINE=unfused \
+  run_leg "MoE ub1 + gather FFN, combine unfused A/B" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub1_pallas_gather_combine_unfused"
+print(json.dumps(r))
+EOF
+
+# µBS sweep with bf16 master weights + stochastic AdamW (any ub>1),
+# crossed with ZeRO optimizer-state sharding (D9D_BENCH_MOE_ZERO=1:
+# dp_replicate across every visible chip, constant per-chip load —
+# single-chip tunnels degrade to dp_r=1 and record the degenerate row).
 # tools/roofline.py predicts ub2 -> MFU 0.235 and ub4 -> 0.272 (clears
-# the 0.25 target) IF ub4 fits HBM — a leg that OOMs records the failure
-# without eating the window
+# the 0.25 target) IF ub4 fits HBM; the zero rows are pre-registered at
+# ub2_zero4 -> 0.260 and ub4_zero4 -> 0.293 (the optimizer stream and
+# fp32 grad accumulator divide by N). A leg that OOMs records the
+# failure without eating the window.
 for ub in 2 4; do
-  D9D_BENCH_MOE_UB=$ub run_leg "MoE ub$ub bf16-params stochastic adamw" \
-    bench_results/bench_sweep.jsonl python - <<'EOF'
+  for zero in 0 1; do
+    D9D_BENCH_MOE_UB=$ub D9D_BENCH_MOE_ZERO=$zero \
+      run_leg "MoE ub$ub bf16-params stochastic adamw zero$zero" \
+      bench_results/bench_sweep.jsonl python - <<'EOF'
 import json, os
 import bench
 r = bench.run_bench_moe()
 r["detail"]["variant"] = (
     f"ub{os.environ['D9D_BENCH_MOE_UB']}_bf16_params_stochastic_adamw"
+    f"_zero{os.environ['D9D_BENCH_MOE_ZERO']}"
 )
 print(json.dumps(r))
 EOF
+  done
 done
+
+# ZeRO on the recorded ub1/fp32 geometry (fp32 masters/moments are the
+# biggest optimizer stream — the largest 1/N win per roofline:
+# ub1_zero4 predicted 0.184 vs the measured 0.136)
+D9D_BENCH_MOE_ZERO=1 run_leg "MoE ub1 fp32 + ZeRO opt-state sharding" \
+  bench_results/bench_sweep.jsonl python - <<'EOF'
+import json
+import bench
+r = bench.run_bench_moe()
+r["detail"]["variant"] = "ub1_fp32_zero1n"
+print(json.dumps(r))
+EOF
 
 # best-combo candidate: bigger tiles AND no recompute of the permute +
 # grouped dots (HBM-marginal: ~16.1G estimated vs 15.75G — cheap to try,
