@@ -182,6 +182,18 @@ class MeshParameters:
             * self.tp
         )
 
+    # -- serialization (checkpoint manifest v2 "mesh" block) -----------
+
+    def as_dict(self) -> dict:
+        """JSON-serializable axis sizes — what a checkpoint records
+        about the topology that saved it (resilience/elastic.py)."""
+        return {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MeshParameters":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: int(v) for k, v in d.items() if k in names})
+
     @property
     def axis_sizes(self) -> tuple[int, ...]:
         return (

@@ -17,9 +17,21 @@ back through the rotation history to the newest step that both
 validates and restores, instead of crashing on a truncated directory —
 covering the machine-died-mid-async-save case the finalize rename alone
 cannot.
+
+Elastic topology (docs/design/elasticity.md): ``save()`` records the
+saving mesh in the manifest (schema v2 ``mesh`` block); ``restore()``
+compares it against the restore target's mesh and, on a mismatch,
+reshard-on-loads — orbax reads shard-local byte ranges into the new
+placement, counted and timed under ``resilience/reshard_restore``.
+With ``reshard_hbm_budget_bytes`` set, leaves whose per-device
+materialization would exceed the budget restore through a
+device-sharded staging layout and are then re-placed chunk by chunk
+(``resilience/elastic.redistribute_tree``), bounding the transient
+footprint of any single array.
 """
 
 import logging
+import time
 from pathlib import Path
 from typing import Any
 
@@ -29,6 +41,7 @@ import orbax.checkpoint as ocp
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.resilience.manifest import (
     CheckpointIntegrityError,
+    manifest_mesh,
     validate_checkpoint_dir,
     write_manifest,
 )
@@ -38,6 +51,27 @@ logger = logging.getLogger("d9d_tpu.checkpointer")
 
 _ARRAYS = "arrays"
 _META = "meta"
+
+# rate limit for the unverified-restore warning: the counter records
+# every occurrence, the log line shows up once per interval per process
+_UNVERIFIED_WARN_INTERVAL_S = 300.0
+_last_unverified_warn = -float("inf")
+
+
+def _note_unverified_restore(step: int) -> None:
+    """An operator-visible trace of a manifest-less restore attempt:
+    ``resilience/unverified_restore`` counts every one; the warning is
+    rate-limited so a tight resume loop cannot flood the log."""
+    global _last_unverified_warn
+    get_telemetry().counter("resilience/unverified_restore").add(1)
+    now = time.monotonic()
+    if now - _last_unverified_warn >= _UNVERIFIED_WARN_INTERVAL_S:
+        _last_unverified_warn = now
+        logger.warning(
+            "checkpoint step %d has no integrity manifest; attempting "
+            "unverified restore (further occurrences counted in "
+            "resilience/unverified_restore without this warning)", step,
+        )
 
 
 class StateCheckpointer:
@@ -57,6 +91,8 @@ class StateCheckpointer:
         # most recent step handed to save() — lets the trainer's
         # emergency/final save skip a duplicate same-step save
         self._manifest_pending: set[int] = set()
+        # per-step saving-mesh blocks awaiting their manifest write
+        self._mesh_specs: dict[int, dict[str, Any]] = {}
         self.last_saved_step: int | None = None
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -83,6 +119,8 @@ class StateCheckpointer:
         validation would then reject as corruption on an intact step).
         """
         if jax.process_index() != 0:
+            for step in self._manifest_pending:
+                self._mesh_specs.pop(step, None)
             self._manifest_pending.clear()
             return
         for step in sorted(self._manifest_pending):
@@ -91,9 +129,12 @@ class StateCheckpointer:
                 # rotated away before its manifest barrier, or the save
                 # never finalized — either way nothing to describe
                 self._manifest_pending.discard(step)
+                self._mesh_specs.pop(step, None)
                 continue
             try:
-                write_manifest(step_dir, step=step)
+                write_manifest(
+                    step_dir, step=step, mesh=self._mesh_specs.pop(step, None)
+                )
             except OSError as e:
                 # racing the rotation delete of an old step: the step is
                 # gone (or going); an unmanifested step still restores
@@ -114,8 +155,20 @@ class StateCheckpointer:
             and step % self.save_every_steps == 0
         )
 
-    def save(self, step: int, arrays: PyTree, meta: dict[str, Any]) -> None:
+    def save(
+        self,
+        step: int,
+        arrays: PyTree,
+        meta: dict[str, Any],
+        *,
+        mesh_spec: dict[str, Any] | None = None,
+    ) -> None:
+        """Save one step. ``mesh_spec`` (resilience/elastic.job_mesh_spec)
+        is recorded in the step's integrity manifest so a later restore
+        on a different topology can detect the mismatch before loading."""
         logger.info("checkpointing step %d -> %s", step, self.directory)
+        if mesh_spec is not None:
+            self._mesh_specs[step] = mesh_spec
         # the span covers the synchronous part only: under async save
         # that is the device→host snapshot; the background disk write is
         # timed by the io/checkpoint_wait span that eventually joins it
@@ -165,12 +218,21 @@ class StateCheckpointer:
         return self._mgr.latest_step()
 
     def _restore_one(
-        self, step: int, abstract_arrays: PyTree
+        self,
+        step: int,
+        abstract_arrays: PyTree,
+        *,
+        reshard: bool = False,
+        reshard_hbm_budget_bytes: int | None = None,
     ) -> tuple[int, PyTree, dict[str, Any]]:
         with get_telemetry().span("io/checkpoint_restore", step=step):
             abstract = jax.tree.map(
                 ocp.utils.to_shape_dtype_struct, abstract_arrays
             )
+            if reshard:
+                return self._restore_resharded(
+                    step, abstract, reshard_hbm_budget_bytes
+                )
             restored = self._mgr.restore(
                 step,
                 args=ocp.args.Composite(
@@ -182,8 +244,110 @@ class StateCheckpointer:
             )
         return step, restored[_ARRAYS], restored[_META]
 
+    def _restore_resharded(
+        self,
+        step: int,
+        abstract: PyTree,
+        hbm_budget_bytes: int | None,
+    ) -> tuple[int, PyTree, dict[str, Any]]:
+        """Cross-topology restore: the checkpoint was written by a
+        different mesh than the one ``abstract`` targets. Orbax itself
+        reads shard-local byte ranges into the new placement; under an
+        HBM budget, leaves whose per-device materialization would
+        exceed it restore into a flat device-sharded staging layout and
+        are re-placed chunk by chunk (elastic.redistribute_tree), so no
+        array's transient footprint ever exceeds target-shard + budget.
+        Timed and counted under ``resilience/reshard_restore``; the
+        ``resilience/reshard_bytes`` gauge records the total payload
+        landed on the new topology (every leaf changes device placement
+        in a cross-mesh restore; the chunked re-place traffic
+        specifically is ``resilience/reshard_bytes_total``)."""
+        from d9d_tpu.resilience.elastic import (
+            bounded_restore_shardings,
+            redistribute_tree,
+        )
+
+        tele = get_telemetry()
+        tele.counter("resilience/reshard_restores").add(1)
+        with tele.span("resilience/reshard_restore", step=step):
+            staging = bounded_restore_shardings(
+                abstract, hbm_budget_bytes=hbm_budget_bytes
+            )
+            load_target = jax.tree.map(
+                lambda stage, a: (
+                    a if stage is None
+                    else jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=stage)
+                ),
+                staging,
+                abstract,
+                is_leaf=lambda x: x is None,
+            )
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    **{
+                        _ARRAYS: ocp.args.StandardRestore(load_target),
+                        _META: ocp.args.JsonRestore(),
+                    }
+                ),
+            )
+            arrays = restored[_ARRAYS]
+            # re-place only the staged leaves; the rest already landed
+            # on their final shardings via orbax's shard-local reads
+            final = jax.tree.map(
+                lambda stage, a: (
+                    None if stage is None
+                    else getattr(a, "sharding", None)
+                ),
+                staging,
+                abstract,
+                is_leaf=lambda x: x is None,
+            )
+            arrays = redistribute_tree(
+                arrays, final, hbm_budget_bytes=hbm_budget_bytes,
+                telemetry=tele,
+            )
+            tele.gauge("resilience/reshard_bytes").set(
+                sum(
+                    getattr(leaf, "nbytes", 0)
+                    for leaf in jax.tree.leaves(arrays)
+                )
+            )
+        return step, arrays, restored[_META]
+
+    def _detect_topology_mismatch(
+        self, step: int, abstract_arrays: PyTree
+    ) -> bool:
+        """True when the step's manifest records a saving mesh that
+        differs from the restore target's (device count or axis sizes)
+        — the signal that routes restore through the resharding path.
+        Best-effort: pre-v2 manifests and unplaced targets read as
+        "same topology" (the plain path is always value-correct)."""
+        from d9d_tpu.resilience.elastic import (
+            topology_mismatch,
+            tree_mesh_summary,
+        )
+
+        saved = manifest_mesh(self._step_dir(step))
+        target = tree_mesh_summary(abstract_arrays)
+        if not topology_mismatch(saved, target):
+            return False
+        logger.warning(
+            "checkpoint step %d was saved on a different topology "
+            "(saved %s -> restoring onto %s); resharding on load",
+            step,
+            {k: saved.get(k) for k in ("device_count", "axes",
+                                       "zero_sharding")},
+            target,
+        )
+        return True
+
     def restore(
-        self, abstract_arrays: PyTree, step: int | None = None
+        self,
+        abstract_arrays: PyTree,
+        step: int | None = None,
+        *,
+        reshard_hbm_budget_bytes: int | None = None,
     ) -> tuple[int, PyTree, dict[str, Any]] | None:
         """Restore (step, arrays, meta); arrays land with the shardings of
         ``abstract_arrays`` (pass the live state — jax.eval_shape-style
@@ -191,22 +355,33 @@ class StateCheckpointer:
 
         With ``step=None`` (resume-latest), candidate steps are tried
         newest-first: each must pass manifest validation (steps without
-        a manifest are attempted unverified) and actually restore;
-        corrupt or truncated steps are logged, counted in
-        ``resilience/checkpoint_fallback`` telemetry, and skipped —
-        manifest-CONFIRMED corrupt steps newer than the restored one are
-        then pruned from the rotation. Returns None only when no steps
-        exist at all; raises when checkpoints exist but none restores
-        (silently training from scratch would be quiet data loss). An
-        explicit ``step`` keeps strict semantics: validation/restore
-        errors raise.
+        a manifest are attempted unverified — counted in
+        ``resilience/unverified_restore`` with a rate-limited warning)
+        and actually restore; corrupt or truncated steps are logged,
+        counted in ``resilience/checkpoint_fallback`` telemetry, and
+        skipped — manifest-CONFIRMED corrupt steps newer than the
+        restored one are then pruned from the rotation. Returns None
+        only when no steps exist at all; raises when checkpoints exist
+        but none restores (silently training from scratch would be
+        quiet data loss). An explicit ``step`` keeps strict semantics:
+        validation/restore errors raise.
+
+        A step saved on a different mesh (manifest v2 records it) is
+        resharded on load; ``reshard_hbm_budget_bytes`` bounds the
+        transient per-array footprint of that path (see
+        docs/design/elasticity.md).
         """
         if self.async_save:
             self._mgr.wait_until_finished()
         self._finalize_manifests()
         if step is not None:
-            validate_checkpoint_dir(self._step_dir(step))
-            result = self._restore_one(step, abstract_arrays)
+            if not validate_checkpoint_dir(self._step_dir(step)):
+                _note_unverified_restore(step)
+            result = self._restore_one(
+                step, abstract_arrays,
+                reshard=self._detect_topology_mismatch(step, abstract_arrays),
+                reshard_hbm_budget_bytes=reshard_hbm_budget_bytes,
+            )
             self.last_saved_step = None  # the save timeline restarts here
             return result
 
@@ -217,11 +392,14 @@ class StateCheckpointer:
             try:
                 verified = validate_checkpoint_dir(self._step_dir(s))
                 if not verified:
-                    logger.warning(
-                        "checkpoint step %d has no integrity manifest; "
-                        "attempting unverified restore", s,
-                    )
-                result = self._restore_one(s, abstract_arrays)
+                    _note_unverified_restore(s)
+                result = self._restore_one(
+                    s, abstract_arrays,
+                    reshard=self._detect_topology_mismatch(
+                        s, abstract_arrays
+                    ),
+                    reshard_hbm_budget_bytes=reshard_hbm_budget_bytes,
+                )
             except Exception as e:  # noqa: BLE001 — classified below
                 get_telemetry().counter(
                     "resilience/checkpoint_fallback"

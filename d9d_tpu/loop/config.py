@@ -99,6 +99,14 @@ class TrainerConfig(pydantic.BaseModel):
     # keep global shapes so saves round-trip across different settings
     # of this knob (gather-on-load). No-op at dp_replicate == 1.
     zero_sharding: bool = False
+    # elastic restore (docs/design/elasticity.md): when a checkpoint
+    # written on a DIFFERENT mesh is restored (manifest v2 records the
+    # saving topology), bound the transient per-array HBM footprint of
+    # the reshard-on-load path to this budget — oversized leaves are
+    # staged device-sharded and re-placed in <= budget chunks. None =
+    # restore each leaf straight into its final placement (orbax's
+    # shard-local reads, unbounded only for huge replicated leaves)
+    reshard_hbm_budget_mb: float | None = pydantic.Field(default=None, gt=0)
     # observability split (tracked_jit): compile the optimizer phase as
     # its own `train_opt_update` executable so the introspection
     # inventory attributes the update's FLOPs/HBM separately from

@@ -166,7 +166,14 @@ class Inference:
             self.module = model_provider.build_module(PipelineStageInfo())
             plan = model_provider.build_plan(ctx)
             if params is not None:
-                self.params = params
+                # handed-over params (trainer snapshot or a restored
+                # checkpoint) can carry uncommitted scalar leaves whose
+                # single-device placement conflicts with the mesh-placed
+                # majority at the first forward — the same latent
+                # placement class as the PR 5 resume bug
+                from d9d_tpu.core.tree_sharding import replicate_uncommitted
+
+                self.params = replicate_uncommitted(params, ctx.mesh)
             else:
                 sample = model_provider.sample_inputs(
                     self.microbatch_size, config.seq_len

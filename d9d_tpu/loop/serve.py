@@ -64,6 +64,17 @@ readback per chunk) is untouched; ``tests/telemetry`` pins
 ``stats.readbacks`` against it. Host dispatch/readback/admission
 regions carry ``serve.*`` ``core/tracing.annotate`` labels inside
 profiler capture windows (``tools/trace_summary.py`` groups them).
+
+Live weight publish (docs/design/elasticity.md): the jitted executables
+take the parameter tree as a *traced argument* — never a trace-time
+closure constant — so :meth:`ContinuousBatcher.install_weights` can
+swap in a freshly published tree at a chunk boundary with an unchanged
+``tracked_jit`` fingerprint (same shapes/dtypes/placements): no
+restart, no steady-state recompile (``tools/bench_compare.py`` gates
+this). Swaps are generation-stamped (``weights_version``); chunks
+already dispatched complete on the weights they were dispatched with,
+and ``defer_to_idle`` holds the swap until every in-flight request has
+finished, so those requests complete wholly on the old generation.
 """
 
 import _thread
@@ -78,8 +89,10 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 
 from d9d_tpu.core.tracing import annotate
+from d9d_tpu.core.tree_sharding import replicate_uncommitted
 from d9d_tpu.core.types import Array
 from d9d_tpu.telemetry import get_telemetry, tracked_jit
 
@@ -132,6 +145,7 @@ class _ChunkPlan:
     k: int
     rids: list            # rid per slot at dispatch (-1 = idle)
     emit_from: list       # first step index (within the chunk) that emits
+    version: int = 0      # weights generation this chunk dispatched with
 
 
 @dataclasses.dataclass
@@ -152,6 +166,9 @@ class RequestTelemetry:
     first_tok_t: float | None = None
     finish_t: float | None = None
     tokens: int = 0
+    # weights generation of the chunk that FINISHED this request (the
+    # publish-versioning audit trail: which params produced the tail)
+    weights_version: int | None = None
 
     @property
     def queue_wait_s(self) -> float | None:
@@ -227,6 +244,18 @@ def _zero_row(cache, row_mask: Array):
     return jax.tree.map(z, cache)
 
 
+def _normalize_params(params):
+    """Pin uncommitted leaves of a handed-over param tree to the
+    mesh-replicated placement of its committed leaves
+    (``core/tree_sharding.replicate_uncommitted``); identity for trees
+    with no committed NamedSharding to normalize against."""
+    for leaf in jax.tree.leaves(params):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return replicate_uncommitted(params, sh.mesh)
+    return params
+
+
 def _pin_cache_index(cache, live: Array):
     """Pin dead/idle rows' per-row write indices to 0: the jitted step
     advances every row's ``cache_index``, so without the pin a long-idle
@@ -291,7 +320,11 @@ class ContinuousBatcher:
                 f"stall_timeout_s must be > 0, got {stall_timeout_s}"
             )
         self._model = model
-        self._params = params
+        # latent-placement fix (same class as the PR 5 resume bug): a
+        # param tree handed over from a restored checkpoint can carry
+        # uncommitted scalar leaves whose single-device placement
+        # conflicts with the mesh-placed majority at the first dispatch
+        self._params = _normalize_params(params)
         self._b = batch_size
         self._eos = eos_id
         self._temp = temperature
@@ -365,6 +398,11 @@ class ContinuousBatcher:
         )
         self._cache = self._init_cache()
 
+        # live weight publish (docs/design/elasticity.md): staged tree
+        # swapped in at the next dispatch boundary, generation-stamped
+        self.weights_version = 0
+        self._pending_weights: tuple | None = None
+
         # fused-mode device carries (one buffer each, donated through)
         self._tok_d = jnp.zeros((batch_size,), jnp.int32)
         self._pos_d = jnp.zeros((batch_size,), jnp.int32)
@@ -394,14 +432,18 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------
     # jitted executables
 
-    def _model_step(self, cache, tok, pos):
+    def _model_step(self, params, cache, tok, pos):
         """One single-token decode call (trace-time helper shared by the
-        per-token and fused executables)."""
+        per-token and fused executables). ``params`` is a TRACED
+        argument, never a closure constant: that is what lets
+        :meth:`install_weights` swap trees without retracing — the
+        executable's signature (shapes/dtypes/placements) is identical
+        across publishes, so ``tracked_jit`` sees the same fingerprint."""
         kwargs = {"mask": None}
         if self._step_pad is not None:
             kwargs["padding_mask"] = self._step_pad
         logits, state = self._model.apply(
-            {"params": self._params, "cache": cache},
+            {"params": params, "cache": cache},
             tok[:, None], pos[:, None],
             method=self._method, mutable=["cache"], **kwargs,
         )
@@ -415,8 +457,8 @@ class ContinuousBatcher:
         ).astype(jnp.int32)
 
     def _build_step(self):
-        def step_fn(cache, tok, pos, key, live):
-            cache, row_logits = self._model_step(cache, tok, pos)
+        def step_fn(params, cache, tok, pos, key, live):
+            cache, row_logits = self._model_step(params, cache, tok, pos)
             nxt = self._sample(row_logits, key)
             # idle rows ride through the static-shape step; pin their
             # write index so an arbitrarily long idle stretch can't
@@ -425,8 +467,9 @@ class ContinuousBatcher:
 
         # donate the cache: XLA aliases input buffers to outputs, so the
         # per-step update is in place — no second cache residency or
-        # full-cache memcpy per token
-        return tracked_jit(step_fn, name="serve/step", donate_argnums=0)
+        # full-cache memcpy per token. Params are NOT donated: the same
+        # tree serves every following dispatch.
+        return tracked_jit(step_fn, name="serve/step", donate_argnums=1)
 
     def _build_fused(self, k: int, with_admit: bool):
         """Compile one fused K-step executable. ``with_admit`` variants
@@ -438,7 +481,7 @@ class ContinuousBatcher:
         avoid paying per chunk."""
         eos = self._eos
 
-        def fused_fn(cache, tok, pos, live, rem, key,
+        def fused_fn(params, cache, tok, pos, live, rem, key,
                      forced_t, n_forced, emit_from,
                      admit_mask=None, admit_budget=None):
             if with_admit:
@@ -458,7 +501,9 @@ class ContinuousBatcher:
                 inp = jnp.where((j < n_forced) & live, fj, tok)
                 inp = jnp.where(live, inp, 0)
                 pos_in = jnp.where(live, pos, 0)
-                cache, row_logits = self._model_step(cache, inp, pos_in)
+                cache, row_logits = self._model_step(
+                    params, cache, inp, pos_in
+                )
                 nxt = self._sample(row_logits, kj)
                 emit = live & (j >= emit_from)
                 out = jnp.where(emit, nxt, -1)
@@ -486,7 +531,7 @@ class ContinuousBatcher:
         return tracked_jit(
             fused_fn,
             name=f"serve/fused_k{k}" + ("_admit" if with_admit else ""),
-            donate_argnums=(0, 1, 2, 3, 4),
+            donate_argnums=(1, 2, 3, 4, 5),
         )
 
     # ------------------------------------------------------------------
@@ -576,6 +621,99 @@ class ContinuousBatcher:
         self._rate_prev_tokens = 0
 
     # ------------------------------------------------------------------
+    # live weight publish (docs/design/elasticity.md)
+
+    def install_weights(
+        self,
+        params,
+        *,
+        version: Optional[int] = None,
+        defer_to_idle: bool = False,
+    ) -> int:
+        """Stage a published parameter tree; the swap happens at the
+        next dispatch boundary (chunk boundary in fused mode, step
+        boundary in legacy mode) — never mid-chunk, so chunks already
+        in flight complete on the weights they were dispatched with.
+
+        The tree must match the serving model's structure, shapes and
+        placement (it is the same model, freshly trained): the jitted
+        executables then keep their compiled signature and NO
+        steady-state recompile happens. ``defer_to_idle`` holds the
+        swap until no slot is busy, so requests in flight at install
+        time finish wholly on the old generation (note: under sustained
+        load this can defer indefinitely — it is a drain-style publish
+        for low-traffic windows and deterministic tests). Returns the
+        generation number the install will carry.
+        """
+        # generations are strictly monotonic PER BATCHER: two installs
+        # before a boundary get distinct versions, and an external
+        # version (a publisher whose own counter lags this batcher's)
+        # is floored up rather than allowed to regress — otherwise two
+        # different trees could share a stamp and the audit trail
+        # couldn't tell which produced a request's tail
+        staged = (
+            self._pending_weights[1] if self._pending_weights is not None
+            else self.weights_version
+        )
+        floor = max(self.weights_version, staged) + 1
+        version = floor if version is None else max(int(version), floor)
+        self._pending_weights = (
+            _normalize_params(params), int(version), time.perf_counter(),
+            bool(defer_to_idle),
+        )
+        return int(version)
+
+    def _apply_pending_weights(self) -> None:
+        """Swap a staged publish in at a dispatch boundary. The old
+        tree's device buffers stay alive exactly as long as an
+        in-flight chunk references them (XLA holds the arguments), then
+        free — device-side donation of nothing: the swap itself moves
+        no data and dispatches nothing."""
+        if self._pending_weights is None:
+            return
+        params, version, t0, defer = self._pending_weights
+        if defer and self._busy():
+            return  # in-flight requests finish on the old weights
+        self._pending_weights = None
+        self._params = params
+        self.weights_version = int(version)
+        self._tele.counter("serve/weight_publish").add(1)
+        self._tele.histogram("serve/weight_publish_s").record(
+            time.perf_counter() - t0
+        )
+        self._tele.gauge("serve/weights_version").set(version)
+
+    # ------------------------------------------------------------------
+    # fleet support (resilience/elastic.ServingFleet)
+
+    def eject_queued(self) -> list[tuple[int, list, int, Optional[float]]]:
+        """Remove every queued (never-admitted) request from the
+        admission queue; returns ``[(rid, prompt, max_new_tokens,
+        deadline_t)]``. The rids' outputs/stats records are left in
+        place: the caller (``ServingFleet.shrink``) decides per request
+        whether to migrate it (and drop this replica's records) or to
+        retire it as an explicit failure — ejection must never make a
+        request silently unobservable."""
+        out = []
+        while self._queue:
+            req = self._queue.popleft()
+            out.append(
+                (req.rid, list(req.prompt), req.max_new_tokens,
+                 req.deadline_t)
+            )
+        if out:
+            self._tele.gauge("serve/queued").set(0)
+        return out
+
+    def fail_request(self, rid: int, reason: str) -> None:
+        """Retire a not-yet-finished request as an explicit failure
+        (``failed[rid] = reason``, partial output kept) — the fleet's
+        surface for requests it cannot migrate."""
+        if rid in self.done:
+            return
+        self._fail(rid, reason, time.perf_counter())
+
+    # ------------------------------------------------------------------
     # request latency telemetry (host clock only; see RequestTelemetry)
 
     def _note_admit(self, rid: int) -> None:
@@ -591,9 +729,14 @@ class ContinuousBatcher:
             self._tele.histogram("serve/ttft_s").record(rec.ttft_s)
         rec.tokens += n
 
-    def _note_finish(self, rid: int, now: float) -> None:
+    def _note_finish(
+        self, rid: int, now: float, version: Optional[int] = None
+    ) -> None:
         rec = self.request_stats[rid]
         rec.finish_t = now
+        rec.weights_version = (
+            version if version is not None else self.weights_version
+        )
         tpot = rec.tpot_s
         if tpot is not None:
             self._tele.histogram("serve/tpot_s").record(tpot)
@@ -619,8 +762,14 @@ class ContinuousBatcher:
     def _fail(self, rid: int, reason: str, now: float) -> None:
         self.failed[rid] = reason
         self.done.add(rid)
-        self.stats.expired += 1
-        self._tele.counter("serve/expired").add(1)
+        # accounting keyed on the reason: "expired" means deadline
+        # expiry and nothing else (the degraded-mode signal operators
+        # alert on); other retirements (fleet shrink) count separately
+        if reason == "deadline":
+            self.stats.expired += 1
+            self._tele.counter("serve/expired").add(1)
+        else:
+            self._tele.counter("serve/failed").add(1)
         rec = self.request_stats.get(rid)
         if rec is not None and rec.finish_t is None:
             rec.finish_t = now
@@ -720,6 +869,7 @@ class ContinuousBatcher:
                 self.stats.host_dispatches += 1
 
     def _step_legacy(self) -> dict[int, int]:
+        self._apply_pending_weights()
         self._admit_legacy()
         if not self._busy():
             return {}
@@ -730,8 +880,8 @@ class ContinuousBatcher:
         self._rng, sub = jax.random.split(self._rng)
         with annotate("serve.dispatch"):
             self._cache, nxt = self._step(
-                self._cache, jnp.asarray(self._tokens), jnp.asarray(pos),
-                sub, jnp.asarray(live),
+                self._params, self._cache, jnp.asarray(self._tokens),
+                jnp.asarray(pos), sub, jnp.asarray(live),
             )
         with annotate("serve.readback"):
             nxt = np.asarray(nxt)
@@ -796,6 +946,7 @@ class ContinuousBatcher:
         given the previous dispatch (prompt feeding advances host-side,
         everything else is a device carry).
         """
+        self._apply_pending_weights()
         admit_mask = np.zeros((self._b,), bool)
         admit_budget = np.zeros((self._b,), np.int32)
         if admit:
@@ -848,8 +999,8 @@ class ContinuousBatcher:
         with annotate("serve.dispatch"):
             (self._cache, self._tok_d, self._pos_d, self._live_d,
              self._rem_d, toks) = fused(
-                self._cache, self._tok_d, self._pos_d, self._live_d,
-                self._rem_d, sub,
+                self._params, self._cache, self._tok_d, self._pos_d,
+                self._live_d, self._rem_d, sub,
                 # forced_t: scan xs layout [K, B]
                 jnp.asarray(forced.T), jnp.asarray(n_forced),
                 jnp.asarray(emit_from),
@@ -857,7 +1008,8 @@ class ContinuousBatcher:
             )
         self._pending.append(
             (toks,
-             _ChunkPlan(k=k, rids=rids, emit_from=emit_from.tolist()))
+             _ChunkPlan(k=k, rids=rids, emit_from=emit_from.tolist(),
+                        version=self.weights_version))
         )
         self.stats.host_dispatches += 1
         self.stats.chunks += 1
@@ -906,7 +1058,7 @@ class ContinuousBatcher:
             if rid in emitted:
                 self._note_tokens(rid, len(emitted[rid]), now)
                 if rid in self.done:
-                    self._note_finish(rid, now)
+                    self._note_finish(rid, now, version=plan.version)
         self._tele.histogram("serve/slot_util", _UTIL_EDGES).record(
             chunk_busy / (self._b * plan.k)
         )

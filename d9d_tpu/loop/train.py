@@ -271,6 +271,9 @@ class Trainer:
         # once-per-process flag for the model-vs-XLA FLOPs cross-check
         # (telemetry/introspect.py inventory vs the roofline convention)
         self._flops_divergence_checked = False
+        # saving-mesh block for checkpoint manifests (elastic restore);
+        # built lazily at the first save — placement is stable by then
+        self._mesh_spec = None
         self.events.emit(ev.EVENT_TRAIN_READY, trainer=self)
 
     # -- live-MFU inputs (telemetry/flops.py roofline convention) ------
@@ -398,6 +401,20 @@ class Trainer:
             return self.pp_engine.job_arrays()
         return {"params": self.params, "opt_state": self.opt_state}
 
+    def _job_mesh_spec(self) -> dict:
+        """The saving-topology record for checkpoint manifests
+        (docs/design/elasticity.md): MeshParameters axes incl.
+        dp_replicate, the zero_sharding setting, per-leaf shardings."""
+        if self._mesh_spec is None:
+            from d9d_tpu.resilience.elastic import job_mesh_spec
+
+            self._mesh_spec = job_mesh_spec(
+                ctx=self.ctx,
+                zero_sharding=self.config.zero_sharding,
+                arrays=self._job_arrays(),
+            )
+        return self._mesh_spec
+
     def _job_meta(self) -> dict:
         meta = {"step": self.stepper.step}
         if self.data_loader is not None:
@@ -427,7 +444,8 @@ class Trainer:
         with self.events.bounded(ev.EVENT_CHECKPOINT, trainer=self, step=step):
             if self.checkpointer.last_saved_step != step:
                 self.checkpointer.save(
-                    step, self._job_arrays(), self._job_meta()
+                    step, self._job_arrays(), self._job_meta(),
+                    mesh_spec=self._job_mesh_spec(),
                 )
             if last:
                 # intermediate saves overlap training (async write-back);
@@ -442,7 +460,13 @@ class Trainer:
         restored step or None. Shared by resume and anomaly rollback."""
         if self.checkpointer is None:
             return None
-        restored = self.checkpointer.restore(self._job_arrays())
+        budget_mb = self.config.reshard_hbm_budget_mb
+        restored = self.checkpointer.restore(
+            self._job_arrays(),
+            reshard_hbm_budget_bytes=(
+                int(budget_mb * 2**20) if budget_mb is not None else None
+            ),
+        )
         if restored is None:
             return None
         step, arrays, meta = restored

@@ -37,7 +37,13 @@ the placement changes — so orbax saves/restores round-trip unchanged,
 and restoring a sharded save onto a replicated mesh layout (or vice
 versa) is just a resharding device_put on load (gather-on-load), driven
 by the live state the trainer passes as the restore target
-(tests/loop/test_zero_checkpoint.py).
+(tests/loop/test_zero_checkpoint.py). The same contract carries across
+*chip counts*: the trainer builds these tables from the live state on
+whatever mesh it initialized with, so an N-chip ``dp_replicate`` save
+restores onto M chips as the M-chip 1/M layout with no table
+translation — the elastic-restore path (docs/design/elasticity.md,
+tests/resilience/test_elastic_restore.py) only adds mismatch detection
+and HBM-bounded staging on top.
 """
 
 import dataclasses

@@ -23,8 +23,15 @@ single-controller host (docs/design/resilience.md):
   intact step instead of crashing on a truncated one.
 - :mod:`~d9d_tpu.resilience.chaos` — deterministic fault injectors (NaN
   grads, loss spikes, checkpoint truncation, prefetch-thread death,
-  SIGTERM mid-run, queue overflow) driving ``tests/resilience/``.
-  Imported on demand only; it pulls in the loop task surface.
+  SIGTERM mid-run, queue overflow, fleet shrink/kill) driving
+  ``tests/resilience/``. Imported on demand only; it pulls in the loop
+  task surface.
+- :mod:`~d9d_tpu.resilience.elastic` — elastic topology
+  (docs/design/elasticity.md): cross-mesh checkpoint restore (manifest
+  v2 saving-mesh block, memory-bounded chunked redistribution), live
+  train→serve weight publish (:class:`WeightPublisher`), and
+  preemption-driven serving-fleet shrink/grow (:class:`ServingFleet`).
+  The fleet/publisher import the serve surface lazily.
 
 Exit-code contract (see docs/design/resilience.md):
 
@@ -43,9 +50,19 @@ from d9d_tpu.resilience.anomaly import (
     AnomalyPolicy,
     HostAnomalyGuard,
 )
+from d9d_tpu.resilience.elastic import (
+    ServingFleet,
+    WeightPublisher,
+    job_mesh_spec,
+    redistribute_tree,
+    topology_mismatch,
+    tree_mesh_summary,
+)
 from d9d_tpu.resilience.manifest import (
     MANIFEST_NAME,
     CheckpointIntegrityError,
+    ManifestVersionError,
+    manifest_mesh,
     validate_checkpoint_dir,
     write_manifest,
 )
@@ -62,6 +79,14 @@ __all__ = [
     "HostAnomalyGuard",
     "MANIFEST_NAME",
     "CheckpointIntegrityError",
+    "ManifestVersionError",
+    "ServingFleet",
+    "WeightPublisher",
+    "job_mesh_spec",
+    "manifest_mesh",
+    "redistribute_tree",
+    "topology_mismatch",
+    "tree_mesh_summary",
     "validate_checkpoint_dir",
     "write_manifest",
     "EXIT_PREEMPTED",

@@ -21,6 +21,12 @@ timing races — so a chaos test asserts exact recovery behavior, not
   a chosen trainer step begins (event-bus hook).
 - :func:`wedge_batcher` — replace a serving batcher's harvest with a
   long sleep: a deterministic stand-in for a wedged device readback.
+- :func:`shrink_at_step` — arm a :class:`~d9d_tpu.resilience.elastic.
+  ServingFleet` to shrink a chosen replica at an exact scheduling
+  round (the deterministic form of a preemption landing mid-traffic).
+- :func:`kill_replica_mid_drain` — make a replica die partway through
+  its shrink drain (after an exact number of grace chunks): the fleet
+  must recover its unfinished requests onto survivors.
 
 Queue overflow needs no injector: submit past ``max_queue`` and assert
 :class:`~d9d_tpu.loop.serve.QueueFullError`.
@@ -222,6 +228,24 @@ def sigterm_at_step(
             os.kill(os.getpid(), signum)
 
     event_bus.subscribe(ev.EVENT_STEP.pre, hook)
+
+
+def shrink_at_step(fleet, replica_idx: int, step: int) -> None:
+    """Shrink ``replica_idx`` out of ``fleet`` when its scheduling-round
+    counter reaches ``step`` — a preemption arriving mid-traffic, raced
+    against nothing (the trigger is consumed at the exact round, before
+    that round's chunk dispatches)."""
+    fleet._chaos_shrink = (int(replica_idx), int(step))
+
+
+def kill_replica_mid_drain(
+    fleet, replica_idx: int, *, after_chunks: int = 1
+) -> None:
+    """Make ``replica_idx`` die after ``after_chunks`` grace chunks of
+    its shrink drain: the fleet must resubmit the replica's unfinished
+    requests to survivors as continuation prompts (prompt + tokens
+    already emitted), losing no committed work."""
+    fleet._chaos_kill = (int(replica_idx), int(after_chunks))
 
 
 def wedge_batcher(batcher, *, seconds: float = 3600.0) -> None:

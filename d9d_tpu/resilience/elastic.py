@@ -1,0 +1,786 @@
+"""Elastic topology: cross-mesh restore, live weight publish, fleet
+shrink/grow (docs/design/elasticity.md).
+
+Production means the chip count changes under you: a job trains on N
+chips and resumes on M after a preemption, a serving fleet loses a
+replica mid-drain and must not lose its requests, and freshly trained
+weights must reach live batcher replicas without a restart. PR 5 made
+the failure *exits* safe; this module is the recovery half (ROADMAP
+item 4):
+
+- **Topology-independent restore.** Checkpoints record the saving mesh
+  (manifest v2 ``mesh`` block — :func:`job_mesh_spec`); restore
+  compares it against the live job's mesh (:func:`tree_mesh_summary` /
+  :func:`topology_mismatch`) and reshard-on-loads across the mismatch.
+  The memory-bounded leg (PAPERS.md, arxiv 2112.01075's bounded
+  collective redistribution, in its load-time form):
+  :func:`bounded_restore_shardings` stages oversized leaves sharded
+  flat across the new mesh's devices, and :func:`redistribute_tree`
+  moves them to their final placement in chunks — never gathering more
+  than ``hbm_budget_bytes`` of any array at once. The chunked path is
+  SINGLE-CONTROLLER: its per-chunk host round-trip would touch
+  non-addressable shards on a multi-process mesh, so under
+  ``jax.process_count() > 1`` it degrades to direct placement —
+  orbax's tensorstore reads stay shard-local and per-rank there (the
+  arxiv 2412.14374 per-rank constraint), just not budget-capped for a
+  huge replicated leaf.
+- **Live train→serve weight publish.** :class:`WeightPublisher`
+  snapshots trainer params at a step boundary and installs them into
+  attached ``ContinuousBatcher`` replicas; each batcher swaps at its
+  next chunk boundary (``install_weights``) with generation-stamped
+  versioning — already-dispatched chunks complete on the weights they
+  were dispatched with, and ``defer_to_idle`` holds the swap until
+  in-flight *requests* finish. The batcher's jitted executables take
+  params as a traced argument with an unchanged ``tracked_jit``
+  fingerprint, so a publish causes zero steady-state recompiles
+  (gated by ``tools/bench_compare.py``).
+- **Preemption-driven shrink/grow.** :class:`ServingFleet` routes
+  requests across N batcher replicas under the PR 5 backpressure
+  contract (``QueueFullError`` cascades replica → fleet). ``shrink``
+  — wired to PR 5's preemption signal via :meth:`bind_preemption` —
+  drains the dying replica: queued requests migrate into survivors,
+  running rows finish inside the grace window. If the replica dies
+  mid-drain (``chaos.kill_replica_mid_drain``), its unfinished
+  requests are resubmitted to survivors as *continuation prompts*
+  (original prompt + tokens already emitted), which the serving loop's
+  teacher-forced prompt consumption replays bit-identically to an
+  uninterrupted decode under greedy sampling. ``grow`` cold-starts a
+  replacement replica from the latest published weights.
+
+Import note: like :mod:`~d9d_tpu.resilience.chaos`, anything that
+touches the loop/serve surface is imported lazily — the module itself
+only needs jax + telemetry.
+"""
+
+import dataclasses
+import logging
+import math
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from d9d_tpu.core.types import PyTree
+from d9d_tpu.telemetry import get_telemetry
+
+logger = logging.getLogger("d9d_tpu.resilience")
+
+__all__ = [
+    "ServingFleet",
+    "WeightPublisher",
+    "bounded_restore_shardings",
+    "job_mesh_spec",
+    "redistribute_tree",
+    "topology_mismatch",
+    "tree_mesh_summary",
+]
+
+# staging axis name for the bounded restore path; underscore-prefixed so
+# it can never collide with the framework's mesh axis vocabulary
+_STAGING_AXIS = "_elastic"
+
+
+# ---------------------------------------------------------------------------
+# mesh specs: what a checkpoint records about the topology that wrote it
+
+
+def _leaf_nbytes(leaf: Any) -> int:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return math.prod(shape) * jnp.dtype(dtype).itemsize
+
+
+def _committed_mesh(tree: PyTree) -> Mesh | None:
+    """The mesh of the first NamedSharding-placed leaf, or None."""
+    for leaf in jax.tree.leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return sh.mesh
+    return None
+
+
+def tree_mesh_summary(tree: PyTree) -> dict[str, Any] | None:
+    """``{"device_count", "axes"}`` of the mesh placing ``tree``'s leaves
+    (read off the first NamedSharding), or None for an unplaced tree."""
+    mesh = _committed_mesh(tree)
+    if mesh is None:
+        return None
+    return {
+        "device_count": int(mesh.devices.size),
+        "axes": {str(k): int(v) for k, v in mesh.shape.items()},
+    }
+
+
+def leaf_sharding_specs(tree: PyTree) -> dict[str, str | None]:
+    """Per-leaf PartitionSpec strings keyed by tree path — the manifest's
+    record of how the save was laid out (diagnostic; restore placement is
+    driven by the live target, never by these)."""
+    out: dict[str, str | None] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sh = getattr(leaf, "sharding", None)
+        key = jax.tree_util.keystr(path)
+        out[key] = str(sh.spec) if isinstance(sh, NamedSharding) else None
+    return out
+
+
+def job_mesh_spec(
+    *,
+    ctx=None,
+    mesh: Mesh | None = None,
+    zero_sharding: bool = False,
+    arrays: PyTree | None = None,
+) -> dict[str, Any]:
+    """The saving-topology block a checkpoint records (manifest v2
+    ``mesh``): MeshParameters axis sizes (incl. ``dp_r``), device count,
+    the ``zero_sharding`` setting, and per-leaf sharding specs.
+
+    ``ctx`` is a :class:`~d9d_tpu.core.mesh.MeshContext`; a bare ``mesh``
+    also works (axis sizes read off ``mesh.shape``).
+    """
+    spec: dict[str, Any] = {"zero_sharding": bool(zero_sharding)}
+    if ctx is not None:
+        spec["mesh_parameters"] = ctx.params.as_dict()
+        mesh = ctx.mesh
+    if mesh is not None:
+        spec["device_count"] = int(mesh.devices.size)
+        spec["axes"] = {str(k): int(v) for k, v in mesh.shape.items()}
+    if arrays is not None:
+        spec["leaf_shardings"] = leaf_sharding_specs(arrays)
+    return spec
+
+
+def topology_mismatch(
+    saved: dict[str, Any] | None, target: dict[str, Any] | None
+) -> bool:
+    """Did the checkpoint's saving mesh differ from the restore target's?
+
+    Conservative: unknown on either side (pre-v2 manifest, unplaced
+    target tree) reads as "no mismatch" — the plain restore path is
+    always correct, the elastic path is an optimization + telemetry.
+    """
+    if not saved or not target:
+        return False
+    if "device_count" in saved and (
+        int(saved["device_count"]) != int(target["device_count"])
+    ):
+        return True
+    if saved.get("axes") and dict(saved["axes"]) != dict(target["axes"]):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# memory-bounded redistribution (chunked gather → re-place)
+
+
+def _shard_slice_shape(
+    idx: tuple[slice, ...], shape: tuple[int, ...]
+) -> tuple[int, ...]:
+    out = []
+    for sl, dim in zip(idx, shape):
+        start, stop, step = sl.indices(dim)
+        out.append(max(0, (stop - start + step - 1) // step))
+    return tuple(out)
+
+
+def _zeros_on(shape, dtype, sharding) -> jax.Array:
+    """An all-zeros array materialized shard-by-shard on ``sharding`` —
+    never a full host or single-device copy."""
+    return jax.make_array_from_callback(
+        shape,
+        sharding,
+        lambda idx: np.zeros(_shard_slice_shape(idx, shape), dtype),
+    )
+
+
+def _chunked_place(
+    leaf: jax.Array, target: NamedSharding, budget: int
+) -> tuple[jax.Array, int]:
+    """Move ``leaf`` onto ``target`` without ever gathering more than
+    ``budget`` bytes of it at once: slice dim-0 chunks off the source,
+    round-trip each through the host, and write it into a
+    target-sharded accumulator via a donated dynamic_update_slice.
+    Peak transient footprint per device: the target shard (required)
+    plus one replicated ≤ budget chunk. Returns (placed, n_chunks)."""
+    rows = leaf.shape[0]
+    row_bytes = max(1, _leaf_nbytes(leaf) // max(rows, 1))
+    chunk_rows = max(1, int(budget // row_bytes))
+    repl = NamedSharding(target.mesh, P())
+    out = _zeros_on(leaf.shape, leaf.dtype, target)
+
+    def write(buf, chunk, start):
+        zeros = (jnp.int32(0),) * (buf.ndim - 1)
+        return lax.dynamic_update_slice(buf, chunk, (start,) + zeros)
+
+    write_j = jax.jit(write, donate_argnums=0, out_shardings=target)
+    n = 0
+    for a in range(0, rows, chunk_rows):
+        b = min(rows, a + chunk_rows)
+        host_chunk = np.asarray(leaf[a:b])  # gather: ≤ budget bytes
+        dev_chunk = jax.device_put(host_chunk, repl)
+        out = write_j(out, dev_chunk, jnp.int32(a))
+        n += 1
+    return out, n
+
+
+def redistribute_tree(
+    tree: PyTree,
+    target_shardings: PyTree,
+    *,
+    hbm_budget_bytes: int | None = None,
+    telemetry=None,
+) -> PyTree:
+    """Re-place ``tree``'s leaves onto ``target_shardings`` (None leaves
+    pass through untouched), moving any leaf larger than
+    ``hbm_budget_bytes`` through the chunked gather→re-place path so no
+    more than the budget of it is ever materialized outside its source
+    and destination shards. Bumps ``resilience/reshard_chunks`` and
+    returns the re-placed tree; with no budget this degrades to plain
+    ``device_put`` per leaf (still one transfer, just unbounded)."""
+    tele = telemetry if telemetry is not None else get_telemetry()
+    moved = 0
+    chunks = 0
+
+    def place(sh, leaf):
+        nonlocal moved, chunks
+        if sh is None or not isinstance(leaf, jax.Array):
+            return leaf
+        cur = getattr(leaf, "sharding", None)
+        try:
+            if cur is not None and cur.is_equivalent_to(sh, leaf.ndim):
+                return leaf
+        except Exception:  # noqa: BLE001 — exotic sharding: fall through
+            pass
+        nbytes = _leaf_nbytes(leaf)
+        moved += nbytes
+        if (
+            hbm_budget_bytes is None
+            or nbytes <= hbm_budget_bytes
+            or leaf.ndim == 0
+            or leaf.shape[0] < 2
+            or not isinstance(sh, NamedSharding)
+            # chunking round-trips through THIS host: on a multi-process
+            # mesh the slice would span non-addressable shards — degrade
+            # to direct placement (shard-local, just not budget-capped)
+            or jax.process_count() > 1
+        ):
+            chunks += 1
+            return jax.device_put(leaf, sh)
+        placed, n = _chunked_place(leaf, sh, hbm_budget_bytes)
+        chunks += n
+        return placed
+
+    out = jax.tree.map(
+        place, target_shardings, tree, is_leaf=lambda x: x is None
+    )
+    if chunks:
+        tele.counter("resilience/reshard_chunks").add(chunks)
+        tele.counter("resilience/reshard_bytes_total").add(moved)
+    return out
+
+
+def bounded_restore_shardings(
+    target_tree: PyTree, *, hbm_budget_bytes: int | None
+) -> PyTree:
+    """Staging shardings for a cross-topology restore under an HBM
+    budget: a tree of NamedShardings (or None = restore directly).
+
+    A leaf stages when restoring it straight into its final placement
+    would materialize more than the budget *per device* (a big
+    replicated leaf) and dim 0 divides over the new mesh's device
+    count: orbax then reads it 1/ndev-sharded (shard-local byte
+    ranges), and :func:`redistribute_tree` re-places it chunked.
+    Leaves whose final shard already fits the budget restore directly —
+    tensorstore reads are shard-local and thus already bounded.
+    """
+    none_tree = jax.tree.map(lambda _: None, target_tree)
+    if hbm_budget_bytes is None:
+        return none_tree
+    if jax.process_count() > 1:
+        # the chunked re-place behind this staging is single-controller
+        # (see redistribute_tree); multi-process restores go direct
+        logger.warning(
+            "elastic restore: HBM-budgeted staging is single-process "
+            "only; restoring directly on %d processes",
+            jax.process_count(),
+        )
+        return none_tree
+    mesh = _committed_mesh(target_tree)
+    if mesh is None or mesh.devices.size <= 1:
+        return none_tree
+    devs = mesh.devices.reshape(-1)
+    flat = Mesh(devs, (_STAGING_AXIS,))
+    staged = NamedSharding(flat, P(_STAGING_AXIS))
+
+    def plan(leaf):
+        sh = getattr(leaf, "sharding", None)
+        shape = getattr(leaf, "shape", None)
+        if not isinstance(sh, NamedSharding) or not shape or len(shape) == 0:
+            return None
+        nbytes = _leaf_nbytes(leaf)
+        if nbytes <= hbm_budget_bytes:
+            return None
+        try:
+            per_dev = (
+                math.prod(sh.shard_shape(tuple(shape)))
+                * jnp.dtype(leaf.dtype).itemsize
+            )
+        except Exception:  # noqa: BLE001 — odd sharding: assume worst
+            per_dev = nbytes
+        if per_dev <= hbm_budget_bytes:
+            return None
+        if shape[0] % devs.size != 0:
+            # can't stage evenly over the devices: restore direct — the
+            # budget is best-effort per-leaf, so say which leaf escaped
+            logger.warning(
+                "elastic restore: leaf of shape %s (%d bytes) exceeds "
+                "the %d-byte HBM budget but dim 0 does not divide over "
+                "%d devices; restoring unbounded",
+                tuple(shape), nbytes, hbm_budget_bytes, devs.size,
+            )
+            return None
+        return staged
+
+    return jax.tree.map(plan, target_tree)
+
+
+def normalize_published_params(params: PyTree) -> PyTree:
+    """Pin uncommitted leaves of a to-be-published param tree to a
+    mesh-replicated placement — the same latent-placement class as the
+    PR 5 resume bug: params coming out of a restored checkpoint (or a
+    fresh ``jit(init)``) can carry uncommitted scalars whose placement
+    conflicts with the batcher's mesh-placed cache at the first
+    post-publish dispatch. No-op when the tree has no committed mesh to
+    normalize against. Delegates to the batcher's own helper so the
+    two can never drift; ``install_weights`` re-running it on an
+    already-normalized tree is a pure traversal (no transfers)."""
+    from d9d_tpu.loop.serve import _normalize_params
+
+    return _normalize_params(params)
+
+
+# ---------------------------------------------------------------------------
+# live train→serve weight publish
+
+
+class WeightPublisher:
+    """Fan a trainer's step-boundary param snapshot out to live serving
+    replicas, generation-stamped.
+
+    ``publish(params)`` normalizes placement, bumps the generation, and
+    stages the tree into every attached batcher via
+    ``ContinuousBatcher.install_weights`` — each swaps at its own next
+    chunk boundary (no restart, no steady-state recompile; see
+    serve.py). The publisher retains the newest published tree so a
+    grown replica (:meth:`ServingFleet.grow`) can cold-start from it.
+
+    Batchers are held by weakref: a retired replica must not be pinned
+    (with its device cache) by the publish fan-out list.
+    """
+
+    def __init__(self, *, telemetry=None):
+        self._targets: list[weakref.ref] = []
+        self._tele = telemetry if telemetry is not None else get_telemetry()
+        self.version = 0
+        self.latest_params: PyTree | None = None
+
+    def attach(self, batcher) -> None:
+        self._targets.append(weakref.ref(batcher))
+
+    def publish(self, params: PyTree, *, defer_to_idle: bool = False) -> int:
+        """Install ``params`` into every live attached batcher; returns
+        the new generation number. ``defer_to_idle`` asks each batcher
+        to hold the swap until its in-flight requests finish."""
+        params = normalize_published_params(params)
+        self.version += 1
+        self.latest_params = params
+        live = []
+        for ref in self._targets:
+            b = ref()
+            if b is None:
+                continue
+            live.append(ref)
+            b.install_weights(
+                params, version=self.version, defer_to_idle=defer_to_idle
+            )
+        self._targets = live
+        if live:
+            self._tele.counter("serve/weight_publish_fanout").add(len(live))
+        return self.version
+
+    def publish_from(self, trainer, **kwargs) -> int:
+        """Snapshot ``trainer.merged_params()`` (PEFT adapters folded,
+        PP stages merged) and publish it. Call between trainer steps —
+        the step boundary is what makes the snapshot consistent."""
+        return self.publish(trainer.merged_params(), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# serving fleet: preemption-driven shrink/grow
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    prompt: list[int]
+    max_new_tokens: int
+    # ABSOLUTE perf_counter deadline, fixed at fleet submit time: a
+    # migration resubmits with the REMAINING budget, so shrink/kill
+    # recovery can never extend a request's lifetime past its contract
+    deadline_t: float | None
+    replica: int | None = None
+    local_rid: int | None = None
+    # tokens already emitted on replicas that died before finishing this
+    # request; resubmission feeds prompt + prefix as a continuation
+    prefix: list[int] = dataclasses.field(default_factory=list)
+    migrations: int = 0
+
+
+class ServingFleet:
+    """Route requests over N ``ContinuousBatcher`` replicas; shrink on
+    preemption, grow from published weights.
+
+    Admission rides the PR 5 backpressure contract: :meth:`submit`
+    tries live replicas least-loaded-first and lets each replica's
+    bounded queue reject (``QueueFullError``); when every replica
+    rejects, the fleet re-raises — overload stays an explicit,
+    retryable signal end to end. Internal *migrations* (shrink/kill
+    recovery) are never dropped on backpressure: they wait in a
+    fleet-level overflow queue and re-place at each step boundary.
+
+    Deterministic chaos hooks (``resilience/chaos.py``): the
+    ``shrink_at_step`` / ``kill_replica_mid_drain`` injectors arm
+    ``_chaos_shrink`` / ``_chaos_kill``, consumed at exact step-round /
+    drain-chunk indices.
+    """
+
+    def __init__(self, *, publisher: WeightPublisher | None = None,
+                 telemetry=None):
+        self._replicas: dict[int, Any] = {}
+        self._live: set[int] = set()
+        self._next_idx = 0
+        self._reqs: dict[int, _FleetRequest] = {}
+        self._by_replica: dict[tuple[int, int], int] = {}
+        self._next_frid = 0
+        self._overflow: deque[int] = deque()
+        self._publisher = publisher
+        self._tele = telemetry if telemetry is not None else get_telemetry()
+        self._preemption: tuple[Any, int] | None = None
+        self._chaos_shrink: tuple[int, int] | None = None
+        self._chaos_kill: tuple[int, int] | None = None
+        self._rounds = 0
+        self.retired: set[int] = set()  # drained cleanly
+        self.dead: set[int] = set()     # killed mid-drain
+        # fleet-level retirement without completion (mirrors the PR 5
+        # batcher surface): frid → reason, partial output kept
+        self.failed: dict[int, str] = {}
+        # finished requests retire out of _reqs into a bounded-FIFO
+        # output snapshot: a long-lived fleet must not grow host memory
+        # with total requests served, and finished() must not depend on
+        # the replicas' own bounded done-FIFO staying warm (the same
+        # retention invariant ContinuousBatcher._retire protects)
+        self._finished_outputs: dict[int, list[int]] = {}
+        self._finished_fifo: deque[int] = deque()
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def add_replica(self, batcher) -> int:
+        idx = self._next_idx
+        self._next_idx += 1
+        self._replicas[idx] = batcher
+        self._live.add(idx)
+        if self._publisher is not None:
+            self._publisher.attach(batcher)
+            if self._publisher.latest_params is not None:
+                batcher.install_weights(
+                    self._publisher.latest_params,
+                    version=self._publisher.version,
+                )
+        self._tele.gauge("serve/fleet_replicas").set(len(self._live))
+        return idx
+
+    def grow(self, make_batcher: Callable[[PyTree], Any]) -> int:
+        """Cold-start a replacement replica from the latest *published*
+        weights — the recovery half of a preemption shrink. The factory
+        receives the published param tree and returns a batcher."""
+        if self._publisher is None or self._publisher.latest_params is None:
+            raise RuntimeError(
+                "grow() cold-starts replicas from the latest published "
+                "weights; attach a WeightPublisher and publish first"
+            )
+        idx = self.add_replica(make_batcher(self._publisher.latest_params))
+        self._tele.counter("serve/fleet_grows").add(1)
+        return idx
+
+    def bind_preemption(self, guard, replica_idx: int) -> None:
+        """Wire PR 5's preemption signal as the shrink trigger: once
+        ``guard.triggered`` (SIGTERM landed), the next :meth:`step`
+        drains ``replica_idx`` into the survivors."""
+        self._preemption = (guard, int(replica_idx))
+
+    # -- admission ------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Queue a request on the least-loaded live replica; returns the
+        fleet-level request id. Raises ``QueueFullError`` when every
+        live replica's bounded queue rejects (fleet-level backpressure:
+        shed or retry, exactly like the single-replica contract)."""
+        from d9d_tpu.loop.serve import QueueFullError
+
+        frid = self._next_frid
+        self._next_frid += 1
+        req = _FleetRequest(
+            [int(x) for x in prompt], int(max_new_tokens),
+            time.perf_counter() + deadline_s
+            if deadline_s is not None else None,
+        )
+        self._reqs[frid] = req
+        try:
+            placed = self._try_place(frid)
+        except BaseException:
+            # a replica-side validation error (bad budget, prompt over
+            # decode_max_length, ...) must not leave a ghost request
+            # that can never finish and wedges every later drain()
+            del self._reqs[frid]
+            raise
+        if not placed:
+            del self._reqs[frid]
+            raise QueueFullError(
+                f"all {len(self._live)} live replicas rejected the "
+                "request (bounded queues full); retry after drain"
+            )
+        return frid
+
+    def _try_place(self, frid: int, *, exclude: frozenset = frozenset()) -> bool:
+        from d9d_tpu.loop.serve import QueueFullError
+
+        req = self._reqs[frid]
+        remaining = req.max_new_tokens - len(req.prefix)
+        if remaining <= 0:
+            return True  # fully emitted before its last replica died
+        deadline_s = None
+        if req.deadline_t is not None:
+            # preserve the ABSOLUTE deadline across migrations: the
+            # survivor gets only the time still left on the contract
+            deadline_s = req.deadline_t - time.perf_counter()
+            if deadline_s <= 0:
+                self.failed[frid] = "deadline"
+                self._tele.counter("serve/expired").add(1)
+                req.replica = req.local_rid = None
+                return True  # retired: partial prefix kept, like PR 5
+        order = sorted(
+            (i for i in self._live if i not in exclude),
+            key=lambda i: self._replicas[i].active,
+        )
+        prompt = req.prompt + req.prefix
+        for i in order:
+            try:
+                rid = self._replicas[i].submit(
+                    prompt,
+                    max_new_tokens=remaining,
+                    deadline_s=deadline_s,
+                )
+            except QueueFullError:
+                continue
+            req.replica, req.local_rid = i, rid
+            self._by_replica[(i, rid)] = frid
+            return True
+        req.replica = req.local_rid = None
+        return False
+
+    # -- progress -------------------------------------------------------
+
+    # finished-request output snapshots retained for the host API
+    _MAX_FINISHED = 50_000
+
+    def finished(self, frid: int) -> bool:
+        if frid in self._finished_outputs or frid in self.failed:
+            return True
+        req = self._reqs.get(frid)
+        if req is None:
+            if 0 <= frid < self._next_frid:
+                return True  # retired beyond the retention horizon
+            raise KeyError(f"unknown fleet request id {frid}")
+        if req.replica is None:
+            return len(req.prefix) >= req.max_new_tokens
+        return req.local_rid in self._replicas[req.replica].done
+
+    def outputs(self, frid: int) -> list[int]:
+        """Emitted tokens for a fleet request: dead-replica prefix plus
+        whatever its current replica has harvested (a retired request
+        returns its snapshot, within the bounded retention horizon —
+        like the batcher's ``_MAX_FINISHED_STATS`` contract, read
+        results within it; past it this raises with an explanation)."""
+        if frid in self._finished_outputs:
+            return list(self._finished_outputs[frid])
+        req = self._reqs.get(frid)
+        if req is None:
+            if 0 <= frid < self._next_frid:
+                raise KeyError(
+                    f"fleet request {frid} finished and was evicted from "
+                    f"the bounded retention horizon "
+                    f"({self._MAX_FINISHED} snapshots)"
+                )
+            raise KeyError(f"unknown fleet request id {frid}")
+        toks = list(req.prefix)
+        if req.replica is not None:
+            toks += list(
+                self._replicas[req.replica].outputs.get(req.local_rid, [])
+            )
+        return toks[: req.max_new_tokens]
+
+    def _retire_finished(self) -> None:
+        """Snapshot finished requests' outputs and drop their live
+        records (bounded FIFO) — called at the end of every drain so
+        neither ``_reqs`` nor ``_by_replica`` grows with lifetime
+        traffic, and a finished request's result stays readable even
+        after its replica's own done-FIFO rotates."""
+        for frid in [f for f in self._reqs if self.finished(f)]:
+            self._finished_outputs[frid] = self.outputs(frid)
+            req = self._reqs.pop(frid)
+            if req.replica is not None:
+                # surface replica-level retirements (deadline expiry on
+                # the replica) at the fleet: "finished" must not make a
+                # failed request read as a successful short completion
+                reason = self._replicas[req.replica].failed.get(
+                    req.local_rid
+                )
+                if reason is not None:
+                    self.failed.setdefault(frid, reason)
+                self._by_replica.pop((req.replica, req.local_rid), None)
+            self._finished_fifo.append(frid)
+        while len(self._finished_fifo) > self._MAX_FINISHED:
+            old = self._finished_fifo.popleft()
+            self._finished_outputs.pop(old, None)
+            self.failed.pop(old, None)
+
+    def step(self) -> None:
+        """One scheduling round: consume the preemption/chaos triggers,
+        retry overflow placements, advance every live replica a chunk."""
+        self._rounds += 1
+        if self._preemption is not None:
+            guard, idx = self._preemption
+            if guard.triggered and idx in self._live:
+                self._preemption = None
+                self._tele.counter("resilience/preempt_shrinks").add(1)
+                self.shrink(idx)
+        if (
+            self._chaos_shrink is not None
+            and self._rounds >= self._chaos_shrink[1]
+            and self._chaos_shrink[0] in self._live
+        ):
+            idx = self._chaos_shrink[0]
+            self._chaos_shrink = None
+            self.shrink(idx)
+        for frid in [self._overflow.popleft() for _ in range(len(self._overflow))]:
+            if not self._try_place(frid):
+                self._overflow.append(frid)
+        for i in sorted(self._live):
+            self._replicas[i].step_chunk()
+
+    def drain(self, max_rounds: int = 10_000) -> dict[int, list[int]]:
+        """Run scheduling rounds until every live fleet request
+        finishes; returns ``{fleet_rid: tokens}`` for them, then
+        retires their records into the bounded snapshot store."""
+        rounds = 0
+        while not all(self.finished(frid) for frid in self._reqs):
+            self.step()
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError("fleet drain exceeded max_rounds")
+        out = {frid: self.outputs(frid) for frid in self._reqs}
+        self._retire_finished()
+        return out
+
+    # -- shrink / recovery ---------------------------------------------
+
+    def shrink(self, idx: int) -> None:
+        """Retire replica ``idx``: stop routing to it, migrate its
+        queued (never-admitted) requests into survivors under the
+        backpressure contract, and drain its running rows to completion
+        inside the preemption grace window. A replica that dies during
+        this drain is recovered by :meth:`_recover_killed`."""
+        b = self._replicas[idx]
+        self._live.discard(idx)
+        self._tele.counter("serve/fleet_shrinks").add(1)
+        self._tele.gauge("serve/fleet_replicas").set(len(self._live))
+        for rid, _prompt, _mnt, _dl in b.eject_queued():
+            frid = self._by_replica.pop((idx, rid), None)
+            if frid is None:
+                # submitted directly to the batcher, not through the
+                # fleet: it can't be migrated (the caller holds THIS
+                # replica's rid), so retire it as an explicit failure
+                # instead of silently destroying it
+                b.fail_request(rid, "shrunk")
+                continue
+            # migrated: the receiving replica re-admits under a new
+            # local rid; drop the dying replica's now-dead records
+            b.outputs.pop(rid, None)
+            b.request_stats.pop(rid, None)
+            req = self._reqs[frid]
+            req.replica = req.local_rid = None
+            req.migrations += 1
+            self._tele.counter("serve/fleet_migrated").add(1)
+            if not self._try_place(frid, exclude=frozenset({idx})):
+                self._overflow.append(frid)
+        chunks = 0
+        while b._busy() or b._pending:
+            if (
+                self._chaos_kill is not None
+                and self._chaos_kill[0] == idx
+                and chunks >= self._chaos_kill[1]
+            ):
+                self._chaos_kill = None
+                self._recover_killed(idx)
+                return
+            b.step_chunk()
+            chunks += 1
+            # the grace drain must not stall the rest of the fleet: the
+            # survivors — now carrying the migrated queue — keep
+            # dispatching while the dying replica finishes its rows
+            # (their own deadlines are absolute; a synchronous-only
+            # drain would expire them spuriously)
+            for i in sorted(self._live):
+                self._replicas[i].step_chunk()
+        self.retired.add(idx)
+
+    def _recover_killed(self, idx: int) -> None:
+        """The dying replica is gone mid-drain: resubmit its unfinished
+        requests to survivors as continuation prompts (original prompt +
+        tokens already harvested), so completed work is kept and greedy
+        decoding resumes token-identically."""
+        b = self._replicas[idx]
+        self.dead.add(idx)
+        self._tele.counter("serve/fleet_replica_deaths").add(1)
+        for frid, req in self._reqs.items():
+            if req.replica != idx or req.local_rid in b.done:
+                continue
+            # the dead replica's mapping is gone with it — drop it so
+            # the index doesn't accumulate stale (dead-replica, rid)
+            # entries across migrations
+            self._by_replica.pop((idx, req.local_rid), None)
+            req.prefix = req.prefix + list(b.outputs.get(req.local_rid, []))
+            req.replica = req.local_rid = None
+            req.migrations += 1
+            self._tele.counter("serve/fleet_migrated").add(1)
+            if len(req.prefix) >= req.max_new_tokens:
+                continue
+            if not self._try_place(frid, exclude=frozenset({idx})):
+                self._overflow.append(frid)
+
+    @property
+    def live_replicas(self) -> tuple[int, ...]:
+        return tuple(sorted(self._live))

@@ -22,6 +22,18 @@ save whose process died between finalize and manifest write) is treated
 as *unverified*, not invalid: restore still attempts it inside the same
 walk-back guard, so a corrupt unverified step degrades to a fallback,
 not a crash.
+
+Schema v2 (docs/design/checkpointing.md, elasticity.md): the manifest
+additionally records the **saving mesh** under ``"mesh"`` — MeshSpec
+axis sizes (incl. ``dp_replicate``), device count, the
+``zero_sharding`` setting and per-leaf sharding specs — so restore can
+detect a topology mismatch *before* loading and route through the
+resharding path. Versioning follows the telemetry schema's ≤-current
+rule: v1 files (no ``version``-gated fields beyond the inventory) stay
+fully readable; a manifest from a *newer* writer raises
+:class:`ManifestVersionError` — which the restore walk-back treats as
+"skip this step", never as confirmed corruption (a newer format must
+not get an intact checkpoint pruned).
 """
 
 import hashlib
@@ -34,7 +46,7 @@ from typing import Any
 logger = logging.getLogger("d9d_tpu.resilience")
 
 MANIFEST_NAME = "d9d_manifest.json"
-MANIFEST_VERSION = 1
+MANIFEST_VERSION = 2
 
 # files at or under this size get full content checksums (the meta item
 # and the orbax/tensorstore index files all qualify); bigger array chunk
@@ -45,6 +57,16 @@ _CHECKSUM_MAX_BYTES = 4 * 1024 * 1024
 
 class CheckpointIntegrityError(RuntimeError):
     """A checkpoint step directory failed manifest validation."""
+
+
+class ManifestVersionError(RuntimeError):
+    """The manifest was written by a newer schema than this reader.
+
+    Deliberately NOT a :class:`CheckpointIntegrityError`: the restore
+    walk-back prunes integrity-confirmed corrupt steps, and a
+    format-from-the-future checkpoint is (presumably) intact — it must
+    be skipped, never deleted.
+    """
 
 
 def _sha256(path: Path) -> str:
@@ -71,15 +93,21 @@ def _inventory(step_dir: Path) -> list[dict[str, Any]]:
     return files
 
 
-def write_manifest(step_dir: str | Path, *, step: int) -> Path:
+def write_manifest(
+    step_dir: str | Path, *, step: int, mesh: dict[str, Any] | None = None
+) -> Path:
     """Inventory a *finalized* step directory and write its manifest
-    atomically. Returns the manifest path."""
+    atomically. ``mesh`` is the saving-topology block (v2 — see
+    :func:`d9d_tpu.resilience.elastic.job_mesh_spec`). Returns the
+    manifest path."""
     step_dir = Path(step_dir)
     manifest = {
         "version": MANIFEST_VERSION,
         "step": step,
         "files": _inventory(step_dir),
     }
+    if mesh is not None:
+        manifest["mesh"] = mesh
     path = step_dir / MANIFEST_NAME
     tmp = step_dir / (MANIFEST_NAME + ".tmp")
     with open(tmp, "w") as fh:
@@ -91,17 +119,42 @@ def write_manifest(step_dir: str | Path, *, step: int) -> Path:
 
 
 def read_manifest(step_dir: str | Path) -> dict[str, Any] | None:
-    """The parsed manifest, or None when the step has none (unverified)."""
+    """The parsed manifest, or None when the step has none (unverified).
+
+    Accepts any version ≤ :data:`MANIFEST_VERSION` (the telemetry
+    schema's rule); raises :class:`ManifestVersionError` on a manifest
+    from a newer writer.
+    """
     path = Path(step_dir) / MANIFEST_NAME
     if not path.exists():
         return None
     try:
         with open(path) as fh:
-            return json.load(fh)
+            manifest = json.load(fh)
     except (OSError, json.JSONDecodeError) as e:
         raise CheckpointIntegrityError(
             f"unreadable checkpoint manifest {path}: {e}"
         ) from e
+    version = int(manifest.get("version", 1))
+    if version > MANIFEST_VERSION:
+        raise ManifestVersionError(
+            f"checkpoint manifest {path} has schema version {version}; "
+            f"this reader supports <= {MANIFEST_VERSION}"
+        )
+    return manifest
+
+
+def manifest_mesh(step_dir: str | Path) -> dict[str, Any] | None:
+    """The saving-mesh block of a step's manifest, or None (pre-v2
+    manifest, no manifest at all, or unreadable/newer manifest — mesh
+    detection is best-effort; integrity validation stays strict)."""
+    try:
+        manifest = read_manifest(step_dir)
+    except (CheckpointIntegrityError, ManifestVersionError):
+        return None
+    if manifest is None:
+        return None
+    return manifest.get("mesh")
 
 
 def validate_checkpoint_dir(step_dir: str | Path) -> bool:

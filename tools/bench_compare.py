@@ -11,7 +11,12 @@ tripwire that works even while the TPU tunnel is flaky:
   tokens, readbacks, emitted tokens, compile counts and recompiles
   (from the ``telemetry/introspect.py`` inventory), peak executable HBM
   claim — plus wall-clock tokens/s as a loose catastrophic-collapse
-  floor.
+  floor. Mid-bench the workload PUBLISHES the model's own weights back
+  into the live batcher (``install_weights`` — the elastic train→serve
+  handoff, docs/design/elasticity.md): a publish must add zero
+  steady-state compiles and zero dispatches, so the same exact-count
+  gates that catch a dispatch regression also catch a publish-induced
+  recompile.
 - ``--current FILE`` compares an existing summary instead of running.
 - ``--from-bench-jsonl FILE`` extracts the comparable metrics from a
   ``bench_results/bench.jsonl`` row (the on-chip ``bench.py`` output)
@@ -95,11 +100,20 @@ def run_micro() -> dict:
 
     pending = list(workload)
     clock = 0
+    publishes = 0
     t0 = time.perf_counter()
     while pending:
         while pending and pending[0][0] <= clock:
             _, prompt, gen = pending.pop(0)
             batcher.submit(prompt, max_new_tokens=gen)
+        if publishes == 0 and len(pending) <= MICRO["requests"] // 2:
+            # live weight publish mid-bench: re-installing the same tree
+            # exercises the full swap path (stage → boundary apply →
+            # generation bump) without changing emissions — the
+            # steady_state_compiles/host_dispatches gates then prove a
+            # publish is dispatch- and recompile-free
+            batcher.install_weights(params)
+            publishes += 1
         if batcher.active:
             before = batcher.stats.device_steps
             batcher.step_chunk()
@@ -138,6 +152,10 @@ def run_micro() -> dict:
                 {"serve_micro.peak_hbm_bytes": max(peaks)}
                 if peaks else {}
             ),
+            # the mid-bench publish actually applied (weights generation
+            # advanced); its dispatch/compile cost is gated by the
+            # exact-count metrics above
+            "serve_micro.weight_publishes": batcher.weights_version,
             # wall clock — wide-tolerance collapse floor only
             "serve_micro.tok_per_s": round(st.emitted_tokens / dt, 2),
         },
@@ -306,7 +324,9 @@ def default_thresholds(metrics: dict) -> dict:
             specs[name] = {
                 "value": value, "direction": "higher", "rel_tol": 0.9,
             }
-        elif name.endswith(".emitted_tokens"):
+        elif name.endswith((".emitted_tokens", ".weight_publishes")):
+            # the publish leg must keep RUNNING (a silently skipped
+            # publish would let a publish-induced recompile hide)
             specs[name] = {
                 "value": value, "direction": "higher", "rel_tol": 0.0,
             }

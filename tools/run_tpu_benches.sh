@@ -342,6 +342,69 @@ PYEOF
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
+# elastic restore timing: save a ZeRO-sharded job on N chips, restore
+# it on N/2 (the cross-topology reshard-on-load path,
+# docs/design/elasticity.md) — wall-clock restore time + bytes moved
+: > bench_results/elastic.jsonl
+run_leg "elastic N->M restore time (reshard-on-load)" \
+  bench_results/elastic.jsonl python - <<'PYEOF'
+import json, tempfile, time
+
+import jax
+
+n = len(jax.devices())
+if n < 2:
+    print(json.dumps({"rc": 3, "skipped": "needs >= 2 chips"}))
+    raise SystemExit(0)
+
+from tests.resilience.conftest import MicroLoaderProvider, MicroProvider
+
+from d9d_tpu.core.mesh import MeshParameters
+from d9d_tpu.loop import AdamWProvider, CausalLMTask, Trainer, TrainerConfig
+from d9d_tpu.telemetry import get_telemetry
+
+
+def trainer(ckpt_dir, dp):
+    ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+    return Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=8, microbatch_size=8, seq_len=8,
+            total_steps=4, log_every=1, prefetch_batches=0,
+            telemetry_console=False, gc_every_steps=None,
+            checkpoint_dir=ckpt_dir, checkpoint_every_steps=100,
+            checkpoint_async=False, zero_sharding=True,
+        ),
+        model_provider=MicroProvider(),
+        dataset_provider=MicroLoaderProvider(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+
+
+with tempfile.TemporaryDirectory() as d:
+    t1 = trainer(d, n)
+    t1.train()
+    t1.close()
+    t2 = trainer(d, n // 2)
+    t2.data_loader = t2.dataset_provider.build()
+    t0 = time.perf_counter()
+    step = t2._restore_state()
+    dt = time.perf_counter() - t0
+    tele = get_telemetry()
+    print(json.dumps({
+        "metric": "elastic_restore_s", "value": round(dt, 4),
+        "detail": {
+            "dp_save": n, "dp_restore": n // 2, "restored_step": step,
+            "reshard_restores":
+                tele.counter("resilience/reshard_restores").value,
+            "reshard_bytes":
+                tele.gauge("resilience/reshard_bytes").value,
+        },
+    }))
+    t2.close()
+PYEOF
+
 run_leg "kernel latency harness" bench_results/kernels.jsonl \
   python tools/bench_kernels.py
 
