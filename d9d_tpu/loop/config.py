@@ -89,6 +89,14 @@ class TrainerConfig(pydantic.BaseModel):
     telemetry_every_steps: int | None = pydantic.Field(default=None, ge=1)
     telemetry_console: bool = True
     telemetry_console_interval_s: float = 30.0
+    # live metrics endpoint (telemetry/export.py): serve /metrics
+    # (Prometheus text), /healthz and /readyz from a background thread
+    # for the duration of train() — 0 binds an ephemeral port (read it
+    # back from trainer.metrics_server.port), None disables. /readyz
+    # reports ready once the session is past introspect_warmup_steps
+    # (every legitimate signature compiled — "compiling" never reads as
+    # "serving traffic")
+    metrics_port: int | None = pydantic.Field(default=None, ge=0)
 
     # ZeRO-style optimizer-state sharding (parallel/zero.py,
     # docs/design/zero_sharding.md): partition fp32 masters + Adam

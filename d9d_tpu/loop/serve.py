@@ -64,6 +64,13 @@ readback per chunk) is untouched; ``tests/telemetry`` pins
 ``stats.readbacks`` against it. Host dispatch/readback/admission
 regions carry ``serve.*`` ``core/tracing.annotate`` labels inside
 profiler capture windows (``tools/trace_summary.py`` groups them).
+The monitoring plane rides the same boundaries: every request carries
+a fleet-stable trace id (``request_trace`` JSONL milestones),
+``replica_label`` namespaces the serve instruments per replica
+(``serve/r{i}/...`` with base-name rollups), and ``metrics_port``
+serves live Prometheus ``/metrics`` + ``/healthz`` + ``/readyz`` from
+a background thread — all pure host work, zero added readbacks (gated
+by ``tools/bench_compare.py``'s exporter leg).
 
 Live weight publish (docs/design/elasticity.md): the jitted executables
 take the parameter tree as a *traced argument* — never a trace-time
@@ -81,6 +88,8 @@ import _thread
 import collections
 import dataclasses
 import inspect
+import itertools
+import os
 import threading
 import time
 import weakref
@@ -98,6 +107,19 @@ from d9d_tpu.telemetry import get_telemetry, tracked_jit
 
 # slot-occupancy fraction per chunk/step: 20 linear bins over [0, 1]
 _UTIL_EDGES = tuple(i / 20 for i in range(21))
+
+# per-request trace ids (docs/design/observability.md): pid + a process
+# counter — unique across a multi-process fleet without coordination,
+# deterministic within one process (chaos tests assert exact sequences)
+_TRACE_IDS = itertools.count()
+
+
+def mint_trace_id() -> str:
+    """A fleet-stable request trace id: minted once at the FIRST submit
+    (fleet front door or direct batcher submit) and carried through
+    queue → chunk dispatch → migration → kill-recovery continuation, so
+    one id follows the request across every replica it touches."""
+    return f"req-{os.getpid():x}-{next(_TRACE_IDS):x}"
 
 
 class QueueFullError(RuntimeError):
@@ -134,6 +156,7 @@ class _Request:
     prompt: list
     max_new_tokens: int
     deadline_t: float | None = None
+    trace_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -169,6 +192,8 @@ class RequestTelemetry:
     # weights generation of the chunk that FINISHED this request (the
     # publish-versioning audit trail: which params produced the tail)
     weights_version: int | None = None
+    # fleet-stable per-request trace id (schema v3 request_trace events)
+    trace_id: str | None = None
 
     @property
     def queue_wait_s(self) -> float | None:
@@ -300,6 +325,8 @@ class ContinuousBatcher:
         telemetry=None,
         max_queue: Optional[int] = None,
         stall_timeout_s: Optional[float] = None,
+        replica_label: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         """Degraded-mode knobs (docs/design/resilience.md): ``max_queue``
         bounds the admission queue — ``submit()`` past it raises
@@ -308,7 +335,19 @@ class ContinuousBatcher:
         that expire them cleanly whether queued or running.
         ``stall_timeout_s`` arms a drain watchdog: no host
         dispatch/readback progress for that long with work outstanding
-        raises :class:`ServeStalledError` instead of hanging."""
+        raises :class:`ServeStalledError` instead of hanging.
+
+        Monitoring-plane knobs (docs/design/observability.md):
+        ``replica_label`` (e.g. ``"r0"`` — ``ServingFleet.add_replica``
+        assigns these) namespaces this batcher's serve instruments as
+        ``serve/{label}/...`` so N same-process replicas stop blending
+        into the shared ``serve/*`` names; counters and latency
+        histograms additionally feed the base name as the fleet rollup.
+        ``metrics_port`` (0 = ephemeral) starts a
+        :class:`~d9d_tpu.telemetry.MetricsServer` for this batcher —
+        ``/metrics`` in Prometheus text, ``/readyz`` not-ready until the
+        first readback has round-tripped; call :meth:`close` (or use the
+        fleet's endpoint instead) to shut it down."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
         if chunk_size is not None and chunk_size < 1:
@@ -373,11 +412,23 @@ class ContinuousBatcher:
         self._rate_prev_t0 = now
         self._rate_prev_tokens = 0
         this = weakref.ref(self)
-        self._tele.gauge_fn(
-            "serve/tokens_per_s",
+        self._rate_fn = (
             lambda: b._live_rate() if (b := this()) is not None
-            else float("nan"),
+            else float("nan")
         )
+        # label set BEFORE the first gauge_fn registration: a batcher
+        # constructed with a label must never transiently claim (and on
+        # labeling, delete) the base-name registration an earlier
+        # unlabeled batcher may hold
+        self._replica_label: Optional[str] = None
+        if replica_label is not None:
+            self._replica_label = self._validate_label(replica_label)
+        self._tele.gauge_fn(self._rate_gauge_name(), self._rate_fn)
+        # readiness (telemetry/export.py /readyz contract): a batcher is
+        # ready once one readback has round-tripped — the executables
+        # are compiled and the device answered. Deliberately NOT reset
+        # by reset_measurement: warmth survives a bench window reset.
+        self._first_readback_t: Optional[float] = None
 
         method = getattr(model, "logits_last", None) or model.logits
         self._method = method
@@ -410,6 +461,125 @@ class ContinuousBatcher:
         self._rem_d = jnp.zeros((batch_size,), jnp.int32)
         # dispatched-but-unharvested fused chunks, FIFO
         self._pending: collections.deque[tuple] = collections.deque()
+
+        # opt-in live metrics endpoint (telemetry/export.py); weakrefs so
+        # the endpoint can never pin a discarded batcher's device cache
+        self.metrics_server = None
+        if metrics_port is not None:
+            from d9d_tpu.telemetry import MetricsServer
+
+            ref = weakref.ref(self)
+            self.metrics_server = MetricsServer(
+                self._tele,
+                port=metrics_port,
+                readiness=lambda: (
+                    (b.ready, {"replica": b._replica_label})
+                    if (b := ref()) is not None else (False, {})
+                ),
+                health=lambda: (
+                    {
+                        "replica": b._replica_label,
+                        "active": b.active,
+                        "ready": b.ready,
+                        "stalled": b._stalled,
+                    }
+                    if (b := ref()) is not None else {"gone": True}
+                ),
+            ).start()
+
+    @property
+    def ready(self) -> bool:
+        """Past the first readback round-trip (compiled + device alive)
+        — the /readyz contract for this batcher."""
+        return self._first_readback_t is not None
+
+    def close(self) -> None:
+        """Release host-side attachments (the metrics endpoint and this
+        batcher's gauge registrations); the batcher itself stays usable
+        except for scraping."""
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
+        self._tele.registry.unregister_gauge_fn(
+            self._rate_gauge_name(), self._rate_fn
+        )
+
+    # -- instrument naming (replica namespacing, ISSUE satellite) ------
+
+    def _rate_gauge_name(self) -> str:
+        return (
+            f"serve/{self._replica_label}/tokens_per_s"
+            if self._replica_label else "serve/tokens_per_s"
+        )
+
+    @staticmethod
+    def _validate_label(label: str) -> str:
+        if not label or "/" in label:
+            raise ValueError(f"replica_label must be path-free, got {label!r}")
+        return str(label)
+
+    def set_replica_label(self, label: str) -> None:
+        """Namespace this batcher's serve instruments as
+        ``serve/{label}/...`` (the fleet assigns ``r{i}``). Re-homes the
+        live-rate callback gauge; subsequent records use the new name.
+        Counters/histograms keep feeding the base ``serve/*`` name too —
+        the fleet rollup the unlabeled world saw stays intact. (Prefer
+        ``replica_label=`` at construction: an unlabeled batcher holds
+        the base-name rate gauge until this call, with the pre-existing
+        last-registration-wins semantics across unlabeled batchers.)"""
+        label = self._validate_label(label)
+        # fn-guarded: only tears down THIS batcher's registration
+        self._tele.registry.unregister_gauge_fn(
+            self._rate_gauge_name(), self._rate_fn
+        )
+        self._replica_label = label
+        self._tele.gauge_fn(self._rate_gauge_name(), self._rate_fn)
+
+    def _mname(self, name: str) -> str:
+        # name always carries the "serve/" prefix at call sites
+        return f"serve/{self._replica_label}/{name[6:]}"
+
+    def _count(self, name: str, n: float = 1.0) -> None:
+        self._tele.counter(name).add(n)
+        if self._replica_label:
+            self._tele.counter(self._mname(name)).add(n)
+
+    def _observe(self, name: str, v: float, edges=None) -> None:
+        # base name first: SLO digests key on the fleet-level metric
+        self._tele.observe(name, v, edges)
+        if self._replica_label:
+            self._tele.observe(self._mname(name), v, edges)
+
+    def _gauge_set(self, name: str, v: float) -> None:
+        # gauges are last-write-wins: a shared base name would blend N
+        # replicas (the conflation bug this satellite fixes), so labeled
+        # batchers write ONLY their namespaced gauge; fleet-level gauges
+        # are computed by ServingFleet as explicit rollups
+        self._tele.gauge(
+            self._mname(name) if self._replica_label else name
+        ).set(v)
+
+    # -- per-request trace events (schema v3, docs/design/observability.md)
+
+    def _trace(
+        self,
+        trace_id: Optional[str],
+        event: str,
+        t: float,
+        *,
+        rid: Optional[int] = None,
+        **meta,
+    ) -> None:
+        if trace_id is None:
+            return
+        rec: dict = {"trace_id": trace_id, "event": event, "t": t}
+        if self._replica_label is not None:
+            rec["replica"] = self._replica_label
+        if rid is not None:
+            rec["rid"] = rid
+        if meta:
+            rec["meta"] = meta
+        self._tele.record_request_trace(rec)
 
     def _init_cache(self):
         z = jnp.zeros((self._b, 1), jnp.int32)
@@ -541,6 +711,7 @@ class ContinuousBatcher:
         *,
         max_new_tokens: int,
         deadline_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue a request; returns its request id. Admission happens at
         the next step/chunk boundary with a free slot.
@@ -551,6 +722,12 @@ class ContinuousBatcher:
         lands in ``failed[rid] == "deadline"``). With ``max_queue``
         configured, a full queue rejects with :class:`QueueFullError`
         before a rid is allocated.
+
+        ``trace_id`` carries an existing per-request trace id (the fleet
+        mints one at ITS front door and re-submits with it across
+        migrations); a direct submit mints a fresh one. Milestones ride
+        schema-v3 ``request_trace`` events and the id is readable as
+        ``request_stats[rid].trace_id``.
         """
         prompt = [int(x) for x in prompt]
         if not prompt:
@@ -568,13 +745,23 @@ class ContinuousBatcher:
                 f" - 1 = {need} exceeds decode_max_length={self._dml}"
             )
         now = time.perf_counter()
+        minted_here = trace_id is None
+        if minted_here:
+            trace_id = mint_trace_id()
         if self._max_queue is not None:
             # count only live waiters: requests whose deadline already
             # passed must not hold queue capacity against new traffic
             self._expire_queued(now)
             if len(self._queue) >= self._max_queue:
                 self.stats.rejected += 1
-                self._tele.counter("serve/rejected").add(1)
+                self._count("serve/rejected")
+                if minted_here:
+                    # terminal only for a front-door submit: a fleet
+                    # placement attempt (external trace id) that this
+                    # replica rejects may still land on a survivor —
+                    # the fleet emits the terminal event if ALL reject
+                    self._trace(trace_id, "rejected", now,
+                                queued=len(self._queue))
                 raise QueueFullError(
                     f"admission queue full ({len(self._queue)} >= "
                     f"max_queue={self._max_queue}); retry after drain"
@@ -584,10 +771,17 @@ class ContinuousBatcher:
         self._queue.append(_Request(
             rid, prompt, max_new_tokens,
             deadline_t=now + deadline_s if deadline_s is not None else None,
+            trace_id=trace_id,
         ))
         self.outputs[rid] = []
-        self.request_stats[rid] = RequestTelemetry(submit_t=now)
-        self._tele.gauge("serve/queued").set(len(self._queue))
+        self.request_stats[rid] = RequestTelemetry(
+            submit_t=now, trace_id=trace_id
+        )
+        self._gauge_set("serve/queued", len(self._queue))
+        self._trace(
+            trace_id, "submit", now, rid=rid,
+            prompt_len=len(prompt), max_new_tokens=max_new_tokens,
+        )
         return rid
 
     @property
@@ -677,11 +871,11 @@ class ContinuousBatcher:
         self._pending_weights = None
         self._params = params
         self.weights_version = int(version)
-        self._tele.counter("serve/weight_publish").add(1)
-        self._tele.histogram("serve/weight_publish_s").record(
-            time.perf_counter() - t0
+        self._count("serve/weight_publish")
+        self._observe(
+            "serve/weight_publish_s", time.perf_counter() - t0
         )
-        self._tele.gauge("serve/weights_version").set(version)
+        self._gauge_set("serve/weights_version", version)
 
     # ------------------------------------------------------------------
     # fleet support (resilience/elastic.ServingFleet)
@@ -702,7 +896,7 @@ class ContinuousBatcher:
                  req.deadline_t)
             )
         if out:
-            self._tele.gauge("serve/queued").set(0)
+            self._gauge_set("serve/queued", 0)
         return out
 
     def fail_request(self, rid: int, reason: str) -> None:
@@ -719,14 +913,16 @@ class ContinuousBatcher:
     def _note_admit(self, rid: int) -> None:
         rec = self.request_stats[rid]
         rec.admit_t = time.perf_counter()
-        self._tele.histogram("serve/queue_wait_s").record(rec.queue_wait_s)
-        self._tele.gauge("serve/queued").set(len(self._queue))
+        self._observe("serve/queue_wait_s", rec.queue_wait_s)
+        self._gauge_set("serve/queued", len(self._queue))
+        self._trace(rec.trace_id, "admit", rec.admit_t, rid=rid)
 
     def _note_tokens(self, rid: int, n: int, now: float) -> None:
         rec = self.request_stats[rid]
         if rec.first_tok_t is None:
             rec.first_tok_t = now
-            self._tele.histogram("serve/ttft_s").record(rec.ttft_s)
+            self._observe("serve/ttft_s", rec.ttft_s)
+            self._trace(rec.trace_id, "first_token", now, rid=rid)
         rec.tokens += n
 
     def _note_finish(
@@ -739,8 +935,12 @@ class ContinuousBatcher:
         )
         tpot = rec.tpot_s
         if tpot is not None:
-            self._tele.histogram("serve/tpot_s").record(tpot)
-        self._tele.counter("serve/requests_finished").add(1)
+            self._observe("serve/tpot_s", tpot)
+        self._count("serve/requests_finished")
+        self._trace(
+            rec.trace_id, "finish", now, rid=rid,
+            tokens=rec.tokens, weights_version=rec.weights_version,
+        )
         self._retire(rid)
 
     def _retire(self, rid: int) -> None:
@@ -767,12 +967,18 @@ class ContinuousBatcher:
         # alert on); other retirements (fleet shrink) count separately
         if reason == "deadline":
             self.stats.expired += 1
-            self._tele.counter("serve/expired").add(1)
+            self._count("serve/expired")
         else:
-            self._tele.counter("serve/failed").add(1)
+            self._count("serve/failed")
         rec = self.request_stats.get(rid)
         if rec is not None and rec.finish_t is None:
             rec.finish_t = now
+        if rec is not None:
+            self._trace(
+                rec.trace_id,
+                "expired" if reason == "deadline" else "failed",
+                now, rid=rid, reason=reason, tokens=rec.tokens,
+            )
         self._retire(rid)
 
     def _expire_queued(self, now: float) -> None:
@@ -788,7 +994,7 @@ class ContinuousBatcher:
                 live.append(req)
         if len(live) != len(self._queue):
             self._queue = live
-            self._tele.gauge("serve/queued").set(len(self._queue))
+            self._gauge_set("serve/queued", len(self._queue))
 
     def _expire_running(self, now: float) -> np.ndarray:
         """Evict running rows past their deadline at a boundary; returns
@@ -827,9 +1033,9 @@ class ContinuousBatcher:
         return (self._rate_win_tokens + self._rate_prev_tokens) / dt
 
     def _note_throughput(self, new_tokens: int, now: float) -> None:
-        self._tele.counter("serve/tokens").add(new_tokens)
-        self._tele.gauge("serve/slot_utilization").set(
-            self.stats.slot_utilization
+        self._count("serve/tokens", new_tokens)
+        self._gauge_set(
+            "serve/slot_utilization", self.stats.slot_utilization
         )
         self._rate_win_tokens += new_tokens
         if now - self._rate_win_t0 >= self._RATE_WINDOW_S:
@@ -887,14 +1093,14 @@ class ContinuousBatcher:
             nxt = np.asarray(nxt)
         now = time.perf_counter()
         self._progress_t = now
+        if self._first_readback_t is None:
+            self._first_readback_t = now
         self.stats.host_dispatches += 1
         self.stats.readbacks += 1
         self.stats.device_steps += 1
         self.stats.slot_steps_total += self._b
         self.stats.slot_steps_busy += int(live.sum())
-        self._tele.histogram("serve/slot_util", _UTIL_EDGES).record(
-            live.sum() / self._b
-        )
+        self._observe("serve/slot_util", live.sum() / self._b, _UTIL_EDGES)
 
         emitted: dict[int, int] = {}
         evict_mask = np.zeros((self._b,), bool)
@@ -1024,6 +1230,8 @@ class ContinuousBatcher:
             toks = np.asarray(toks_d)  # the single [B, K] readback
         now = time.perf_counter()
         self._progress_t = now
+        if self._first_readback_t is None:
+            self._first_readback_t = now
         self.stats.readbacks += 1
         self.stats.slot_steps_total += self._b * plan.k
         chunk_busy = 0
@@ -1059,8 +1267,8 @@ class ContinuousBatcher:
                 self._note_tokens(rid, len(emitted[rid]), now)
                 if rid in self.done:
                     self._note_finish(rid, now, version=plan.version)
-        self._tele.histogram("serve/slot_util", _UTIL_EDGES).record(
-            chunk_busy / (self._b * plan.k)
+        self._observe(
+            "serve/slot_util", chunk_busy / (self._b * plan.k), _UTIL_EDGES
         )
         self._note_throughput(chunk_tokens, now)
         return emitted
@@ -1182,7 +1390,7 @@ class ContinuousBatcher:
                         return
                     self._stalled = True
                     if fired == 0:
-                        self._tele.counter("serve/stalls").add(1)
+                        self._count("serve/stalls")
                     fired += 1
                     try:
                         # a real signal: wakes blocking C calls (sleeps,
@@ -1206,6 +1414,17 @@ class ContinuousBatcher:
             return self._drain_impl(max_steps)
         except KeyboardInterrupt:
             if self._stalled:
+                # black-box dump before surfacing the wedge: the recent
+                # metric windows + span tail at the moment of the stall
+                # (no-op unless a flight recorder is configured)
+                self._tele.dump_flight_record(
+                    "serve_stall",
+                    extra={
+                        "replica": self._replica_label,
+                        "active": self.active,
+                        "stall_timeout_s": self._stall_timeout_s,
+                    },
+                )
                 raise ServeStalledError(
                     f"serving drain made no dispatch/readback progress "
                     f"for {self._stall_timeout_s}s with "

@@ -271,6 +271,20 @@ class Trainer:
         # once-per-process flag for the model-vs-XLA FLOPs cross-check
         # (telemetry/introspect.py inventory vs the roofline convention)
         self._flops_divergence_checked = False
+        # monitoring plane (docs/design/observability.md): steps run by
+        # the CURRENT train() session — the /readyz warmup contract;
+        # the metrics endpoint itself is started/stopped inside train()
+        self._session_steps = 0
+        self.metrics_server = None
+        # anomaly flight recorder: with a telemetry dir configured, the
+        # guard/watchdog failure paths dump flight_recorder_{event}.json
+        # NEXT TO that dir (its parent) — one black box per job dir
+        if config.telemetry_dir is not None:
+            from pathlib import Path
+
+            self.telemetry.configure_flight_recorder(
+                Path(config.telemetry_dir).parent
+            )
         # saving-mesh block for checkpoint manifests (elastic restore);
         # built lazily at the first save — placement is stable by then
         self._mesh_spec = None
@@ -526,12 +540,31 @@ class Trainer:
             else self.config.log_every
         )
         last_tele_flush = None  # step of the loop's most recent flush
+        self._session_steps = 0
         # silent-recompile guard: re-arm for this session — every
         # legitimate signature compiles within the warmup steps, after
         # which any compile is a flagged steady-state recompile
         guard = recompile_guard()
         guard.configure(self.config.introspect_warmup_steps)
         try:
+            # live metrics endpoint for the duration of this train()
+            # session (telemetry/export.py): ready once past the
+            # introspection warmup. Started INSIDE the try: a bind
+            # failure (port taken) must still run the finally that
+            # detaches the sinks attached above
+            if self.config.metrics_port is not None:
+                from d9d_tpu.telemetry import MetricsServer
+
+                self.metrics_server = MetricsServer(
+                    tele,
+                    port=self.config.metrics_port,
+                    readiness=lambda: (
+                        self._session_steps
+                        >= self.config.introspect_warmup_steps,
+                        {"session_steps": self._session_steps},
+                    ),
+                    health=lambda: {"step": self.stepper.step},
+                ).start()
             self.data_loader = self.dataset_provider.build()
             self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)
             self.run = self.tracker.new_run(self.config.run_name)
@@ -618,6 +651,7 @@ class Trainer:
                         self.metric_collector.collect(metrics)
                     step = self.stepper.advance()
                     session_steps += 1
+                    self._session_steps = session_steps
                     steps_since_sync += 1
                     guard.note_step(session_steps)
                     self.profiler.step_end(step - 1)
@@ -806,6 +840,11 @@ class Trainer:
             if self._prefetcher is not None:
                 self._prefetcher.close()
                 self._prefetcher = None
+            if self.metrics_server is not None:
+                # the endpoint serves THIS session; a crashed step must
+                # not leave the port bound (the next train() rebinds it)
+                self.metrics_server.close()
+                self.metrics_server = None
             self.profiler.close()
             # final telemetry flush (short runs still get one flush event,
             # and early exits flush the tail steps) unless the loop already

@@ -131,6 +131,20 @@ class HostAnomalyGuard:
         if not anomalous:
             return "ok"
 
+        # black-box dump at the first sight of the anomaly (no-op until
+        # a flight recorder is configured on the hub; rate-limited there
+        # so a NaN storm dumps once per interval, not once per step)
+        dump = getattr(self._tele, "dump_flight_record", None)
+        if dump is not None:
+            dump("anomaly", extra={
+                "step": step,
+                "loss": float(loss) if loss is not None else None,
+                "spike": bool(spike),
+                "device_streak": device_streak,
+                "device_total": device_total,
+                "policy": self.policy,
+            })
+
         if self.policy == "rollback" and (
             device_streak >= self.rollback_after
             or self._spike_streak >= self.rollback_after

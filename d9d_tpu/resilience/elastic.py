@@ -441,6 +441,11 @@ class _FleetRequest:
     # request; resubmission feeds prompt + prefix as a continuation
     prefix: list[int] = dataclasses.field(default_factory=list)
     migrations: int = 0
+    # fleet-stable trace id (docs/design/observability.md): minted once
+    # at the fleet front door and re-submitted verbatim across every
+    # migration and kill-recovery continuation, so the request is ONE
+    # continuous track however many replicas it crosses
+    trace_id: str | None = None
 
 
 class ServingFleet:
@@ -462,7 +467,7 @@ class ServingFleet:
     """
 
     def __init__(self, *, publisher: WeightPublisher | None = None,
-                 telemetry=None):
+                 telemetry=None, metrics_port: int | None = None):
         self._replicas: dict[int, Any] = {}
         self._live: set[int] = set()
         self._next_idx = 0
@@ -476,6 +481,42 @@ class ServingFleet:
         self._chaos_shrink: tuple[int, int] | None = None
         self._chaos_kill: tuple[int, int] | None = None
         self._rounds = 0
+        # fleet-level rollup gauges (the per-replica gauges are
+        # namespaced serve/r{i}/* — last-write-wins gauges cannot share
+        # a name across replicas, so the fleet computes explicit sums);
+        # weakref'd so the hub never pins a discarded fleet + replicas
+        fleet_ref = weakref.ref(self)
+        self._gauge_fns = {
+            "serve/fleet_queue_depth":
+                lambda: f._queue_depth() if (f := fleet_ref()) is not None
+                else float("nan"),
+            "serve/fleet_tokens_per_s":
+                lambda: f._fleet_rate() if (f := fleet_ref()) is not None
+                else float("nan"),
+        }
+        for name, fn in self._gauge_fns.items():
+            self._tele.gauge_fn(name, fn)
+        # opt-in fleet metrics endpoint (telemetry/export.py): /metrics
+        # aggregates every replica's namespaced instruments + the fleet
+        # rollups from the shared registry; /healthz reports per-replica
+        # status; /readyz = at least one live replica past its first
+        # readback. close() shuts it down.
+        self.metrics_server = None
+        if metrics_port is not None:
+            from d9d_tpu.telemetry import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self._tele,
+                port=metrics_port,
+                readiness=lambda: (
+                    (f.ready, {"live_replicas": list(f.live_replicas)})
+                    if (f := fleet_ref()) is not None else (False, {})
+                ),
+                health=lambda: (
+                    f.replica_health() if (f := fleet_ref()) is not None
+                    else {"gone": True}
+                ),
+            ).start()
         self.retired: set[int] = set()  # drained cleanly
         self.dead: set[int] = set()     # killed mid-drain
         # fleet-level retirement without completion (mirrors the PR 5
@@ -489,6 +530,74 @@ class ServingFleet:
         self._finished_outputs: dict[int, list[int]] = {}
         self._finished_fifo: deque[int] = deque()
 
+    # -- monitoring plane ----------------------------------------------
+
+    def _queue_depth(self) -> float:
+        """Waiting requests across the fleet: every live replica's
+        admission queue plus the fleet-level overflow queue."""
+        depth = len(self._overflow)
+        for i in self._live:
+            depth += len(self._replicas[i]._queue)
+        return float(depth)
+
+    def _fleet_rate(self) -> float:
+        return float(sum(
+            self._replicas[i]._live_rate() for i in self._live
+        ))
+
+    @property
+    def ready(self) -> bool:
+        """At least one live replica past its first readback — the
+        fleet /readyz contract (a cold fleet mid-compile is not ready,
+        a fleet that lost one replica but still serves is)."""
+        return any(
+            getattr(self._replicas[i], "ready", False) for i in self._live
+        )
+
+    def replica_health(self) -> dict[str, Any]:
+        """Per-replica status block for the fleet /healthz endpoint."""
+        replicas = {}
+        for idx, b in self._replicas.items():
+            replicas[str(idx)] = {
+                "live": idx in self._live,
+                "retired": idx in self.retired,
+                "dead": idx in self.dead,
+                "ready": bool(getattr(b, "ready", False)),
+                "active": int(b.active),
+            }
+        return {
+            "replicas": replicas,
+            "overflow": len(self._overflow),
+            "ready": self.ready,
+        }
+
+    def close(self) -> None:
+        """Release the fleet's host-side attachments (metrics endpoint,
+        the fleet rollup gauges, every replica's)."""
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
+        for name, fn in self._gauge_fns.items():
+            # fn-guarded: a newer fleet's registration under the same
+            # name must survive this (older) fleet's close
+            self._tele.registry.unregister_gauge_fn(name, fn)
+        for b in self._replicas.values():
+            close = getattr(b, "close", None)
+            if close is not None:
+                close()
+
+    def _trace(self, trace_id: str | None, event: str, **meta) -> None:
+        """Fleet-side request_trace event (migrations, continuations —
+        milestones no single replica can see)."""
+        if trace_id is None:
+            return
+        rec: dict[str, Any] = {
+            "trace_id": trace_id, "event": event, "t": time.perf_counter(),
+        }
+        if meta:
+            rec["meta"] = meta
+        self._tele.record_request_trace(rec)
+
     # -- replica lifecycle ---------------------------------------------
 
     def add_replica(self, batcher) -> int:
@@ -496,6 +605,14 @@ class ServingFleet:
         self._next_idx += 1
         self._replicas[idx] = batcher
         self._live.add(idx)
+        # replica conflation fix (docs/design/observability.md): each
+        # replica's serve instruments get a fleet-assigned namespace
+        # (serve/r{i}/...) unless the embedder labeled it already
+        if (
+            getattr(batcher, "_replica_label", None) is None
+            and hasattr(batcher, "set_replica_label")
+        ):
+            batcher.set_replica_label(f"r{idx}")
         if self._publisher is not None:
             self._publisher.attach(batcher)
             if self._publisher.latest_params is not None:
@@ -537,8 +654,13 @@ class ServingFleet:
         """Queue a request on the least-loaded live replica; returns the
         fleet-level request id. Raises ``QueueFullError`` when every
         live replica's bounded queue rejects (fleet-level backpressure:
-        shed or retry, exactly like the single-replica contract)."""
-        from d9d_tpu.loop.serve import QueueFullError
+        shed or retry, exactly like the single-replica contract).
+
+        The fleet front door mints the request's trace id here; every
+        placement (including migrations and kill-recovery continuations)
+        re-submits with the same id, so the request's schema-v3
+        ``request_trace`` stream is one continuous track."""
+        from d9d_tpu.loop.serve import QueueFullError, mint_trace_id
 
         frid = self._next_frid
         self._next_frid += 1
@@ -546,6 +668,7 @@ class ServingFleet:
             [int(x) for x in prompt], int(max_new_tokens),
             time.perf_counter() + deadline_s
             if deadline_s is not None else None,
+            trace_id=mint_trace_id(),
         )
         self._reqs[frid] = req
         try:
@@ -558,6 +681,11 @@ class ServingFleet:
             raise
         if not placed:
             del self._reqs[frid]
+            # the fleet owns the terminal rejection event: individual
+            # replica rejections during placement are not terminal (a
+            # survivor may still accept), this is
+            self._trace(req.trace_id, "rejected",
+                        live_replicas=len(self._live))
             raise QueueFullError(
                 f"all {len(self._live)} live replicas rejected the "
                 "request (bounded queues full); retry after drain"
@@ -579,6 +707,10 @@ class ServingFleet:
             if deadline_s <= 0:
                 self.failed[frid] = "deadline"
                 self._tele.counter("serve/expired").add(1)
+                self._trace(
+                    req.trace_id, "expired", reason="deadline",
+                    at="fleet_place", tokens=len(req.prefix),
+                )
                 req.replica = req.local_rid = None
                 return True  # retired: partial prefix kept, like PR 5
         order = sorted(
@@ -592,6 +724,7 @@ class ServingFleet:
                     prompt,
                     max_new_tokens=remaining,
                     deadline_s=deadline_s,
+                    trace_id=req.trace_id,
                 )
             except QueueFullError:
                 continue
@@ -734,6 +867,10 @@ class ServingFleet:
             req.replica = req.local_rid = None
             req.migrations += 1
             self._tele.counter("serve/fleet_migrated").add(1)
+            self._trace(
+                req.trace_id, "migrate", reason="shrink",
+                from_replica=idx, migrations=req.migrations,
+            )
             if not self._try_place(frid, exclude=frozenset({idx})):
                 self._overflow.append(frid)
         chunks = 0
@@ -765,6 +902,7 @@ class ServingFleet:
         b = self._replicas[idx]
         self.dead.add(idx)
         self._tele.counter("serve/fleet_replica_deaths").add(1)
+        recovered = 0
         for frid, req in self._reqs.items():
             if req.replica != idx or req.local_rid in b.done:
                 continue
@@ -775,11 +913,27 @@ class ServingFleet:
             req.prefix = req.prefix + list(b.outputs.get(req.local_rid, []))
             req.replica = req.local_rid = None
             req.migrations += 1
+            recovered += 1
             self._tele.counter("serve/fleet_migrated").add(1)
+            # the continuation keeps the ORIGINAL trace id: the harvested
+            # prefix + the survivor's teacher-forced replay stay one track
+            self._trace(
+                req.trace_id, "continuation", reason="replica_death",
+                from_replica=idx, prefix_tokens=len(req.prefix),
+                migrations=req.migrations,
+            )
             if len(req.prefix) >= req.max_new_tokens:
                 continue
             if not self._try_place(frid, exclude=frozenset({idx})):
                 self._overflow.append(frid)
+        # black-box dump at the moment of death (no-op unless a flight
+        # recorder is configured on the hub): the last metric windows +
+        # span tail are exactly the post-mortem a dead replica can no
+        # longer answer for itself
+        self._tele.dump_flight_record(
+            "replica_death",
+            extra={"replica": idx, "recovered_requests": recovered},
+        )
 
     @property
     def live_replicas(self) -> tuple[int, ...]:

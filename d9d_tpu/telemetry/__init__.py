@@ -19,7 +19,9 @@ continuous batching, ``io/*`` checkpoint + data IO.
 """
 
 import contextlib
+import logging
 import threading
+import time as _time
 from typing import Any
 
 from d9d_tpu.telemetry.flops import (
@@ -70,6 +72,13 @@ __all__ = [
     "device_peak_flops",
     "tracked_jit",
     "recompile_guard",
+    # monitoring plane (docs/design/observability.md)
+    "MetricsServer",
+    "render_prometheus",
+    "SloMonitor",
+    "SloPolicy",
+    "StreamingQuantileDigest",
+    "FlightRecorder",
 ]
 
 
@@ -87,6 +96,13 @@ class Telemetry:
         self.registry.span_observers.append(self._on_span)
         self._sinks: list[TelemetrySink] = []
         self._lock = threading.Lock()
+        # monitoring plane attachments (both optional): the SLO monitor
+        # is evaluated on every flush (and by /metrics scrapes); the
+        # flight recorder makes dump_flight_record a real dump instead of
+        # a no-op (telemetry/flight_recorder.py)
+        self.slo_monitor = None
+        self.flight_recorder = None
+        self._slo_eval_warned_t = -float("inf")
 
     # -- instrument passthrough (the API components actually use) ------
 
@@ -101,6 +117,11 @@ class Telemetry:
 
     def histogram(self, name: str, edges=None) -> Histogram:
         return self.registry.histogram(name, edges)
+
+    def observe(self, name: str, value: float, edges=None) -> None:
+        """Record one raw latency/value sample: the fixed-bin histogram
+        plus every value observer (SLO streaming digests)."""
+        self.registry.record_value(name, value, edges)
 
     def span(self, name: str, *, step: int | None = None, **meta: Any):
         return self.registry.span(name, step=step, **meta)
@@ -149,13 +170,75 @@ class Telemetry:
         for sink in self.sinks:
             sink.on_executable(record)
 
+    def record_request_trace(self, record: dict[str, Any]) -> None:
+        """Stream one per-request milestone (schema v3 ``request_trace``,
+        docs/design/observability.md) to every sink. With no sinks
+        attached this is a loop over an empty tuple — the serving hot
+        path pays nothing for tracing it isn't exporting."""
+        for sink in self.sinks:
+            sink.on_request_trace(record)
+
     def flush(self, step: int | None = None) -> dict[str, Any]:
         """Snapshot every instrument and hand it to each sink; returns
-        the snapshot (callers fold headline values into their own logs)."""
+        the snapshot (callers fold headline values into their own logs).
+        Each flush also (a) evaluates the attached SLO monitor first, so
+        slo/* instruments in the snapshot are current, and (b) appends
+        the snapshot to the registry's flight-recorder ring."""
+        if self.slo_monitor is not None:
+            try:
+                self.slo_monitor.evaluate()
+            except Exception:  # noqa: BLE001 — SLO eval must not kill flush
+                # rate-limited log: a broken policy silently freezing the
+                # slo/* surface would be invisible on scraper-less jobs
+                now = _time.monotonic()
+                if now - self._slo_eval_warned_t >= 60.0:
+                    self._slo_eval_warned_t = now
+                    logging.getLogger("d9d_tpu.telemetry").exception(
+                        "SLO evaluation failed during flush; slo/* "
+                        "instruments are stale until this is fixed"
+                    )
         snapshot = self.registry.snapshot()
+        self.registry.flush_ring.append({
+            "unix_time": _time.time(),
+            "step": step,
+            "snapshot": snapshot,
+        })
         for sink in self.sinks:
             sink.on_flush(snapshot, step)
         return snapshot
+
+    def dump_flight_record(self, event: str, *, extra=None):
+        """Dump the flight-recorder ring (recent flush windows + span
+        tail + executable inventory) as ``flight_recorder_{event}.json``
+        — a no-op returning None until a recorder is configured
+        (:meth:`configure_flight_recorder`). Never raises: the recorder
+        exists to observe failures, not to cause new ones."""
+        if self.flight_recorder is None:
+            return None
+        try:
+            return self.flight_recorder.dump(
+                event, self.registry, extra=extra
+            )
+        except Exception:  # noqa: BLE001 — see docstring
+            return None
+
+    def configure_flight_recorder(self, directory, **kwargs):
+        """Install a :class:`FlightRecorder` writing into ``directory``;
+        returns it. Idempotent per directory: re-configuring the same
+        directory keeps the existing recorder (and its per-event
+        rate-limit state — a second Trainer over the same telemetry dir
+        must not reset the one-dump-per-interval guarantee)."""
+        from pathlib import Path
+
+        from d9d_tpu.telemetry.flight_recorder import FlightRecorder
+
+        if (
+            self.flight_recorder is not None
+            and self.flight_recorder.directory == Path(directory)
+        ):
+            return self.flight_recorder
+        self.flight_recorder = FlightRecorder(directory, **kwargs)
+        return self.flight_recorder
 
     def close(self) -> None:
         for sink in self.sinks:
@@ -190,6 +273,16 @@ def set_telemetry(hub: Telemetry) -> Telemetry:
 from d9d_tpu.telemetry.introspect import (  # noqa: E402
     recompile_guard,
     tracked_jit,
+)
+from d9d_tpu.telemetry.export import (  # noqa: E402
+    MetricsServer,
+    render_prometheus,
+)
+from d9d_tpu.telemetry.flight_recorder import FlightRecorder  # noqa: E402
+from d9d_tpu.telemetry.slo import (  # noqa: E402
+    SloMonitor,
+    SloPolicy,
+    StreamingQuantileDigest,
 )
 
 

@@ -1,0 +1,113 @@
+"""Anomaly flight recorder: dump the recent telemetry history when
+something goes wrong (docs/design/observability.md).
+
+The registry keeps a bounded ring of recent flush snapshots
+(``MetricRegistry.flush_ring``, appended by ``Telemetry.flush``) and a
+bounded span timeline. When a failure path fires — the anomaly guard
+sees a non-finite step, the serving drain-stall watchdog trips, a fleet
+replica dies mid-drain — the recorder serializes that history as
+``flight_recorder_{event}.json`` next to the telemetry directory: the
+last N metric windows, the span tail, the instruments' current values,
+and the executable inventory (``telemetry/introspect.py``) *at the
+moment things went wrong*. Post-mortem starts from the crash site's own
+black box instead of re-running the failure under instrumentation.
+
+Dumps are rate-limited per event kind (a NaN storm produces one dump per
+interval, not one per step) and never raise into the failing code path
+— the recorder observes failures, it must not compound them.
+"""
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+__all__ = ["FlightRecorder"]
+
+logger = logging.getLogger("d9d_tpu.telemetry")
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort JSON coercion: telemetry snapshots are plain dicts of
+    floats already; anything exotic (inf, numpy scalars) degrades to
+    ``repr`` rather than failing the dump."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _jsonable(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_jsonable(v) for v in obj]
+        try:
+            return float(obj)
+        except (TypeError, ValueError):
+            return repr(obj)
+
+
+class FlightRecorder:
+    """Serialize the registry's recent history on failure events.
+
+    ``directory`` is where the dumps land (the trainer points this next
+    to its telemetry dir — ``Path(telemetry_dir).parent``); it is
+    created on first dump, not at construction, so configuring the
+    recorder costs nothing on healthy runs.
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        span_tail: int = 256,
+        min_interval_s: float = 30.0,
+    ):
+        self.directory = Path(directory)
+        self.span_tail = int(span_tail)
+        self.min_interval_s = float(min_interval_s)
+        self._last_dump: dict[str, float] = {}
+
+    def dump(
+        self, event: str, registry, *, extra: dict | None = None
+    ) -> Path | None:
+        """Write ``flight_recorder_{event}.json``; returns the path, or
+        None when rate-limited. Never raises (logged instead)."""
+        now = time.monotonic()
+        last = self._last_dump.get(event)
+        if last is not None and now - last < self.min_interval_s:
+            return None
+        self._last_dump[event] = now
+        try:
+            spans = list(registry.spans)[-self.span_tail:]
+            try:
+                from d9d_tpu.telemetry.introspect import inventory
+
+                executables = [r.event() for r in inventory()]
+            except Exception:  # noqa: BLE001 — inventory is best-effort
+                executables = []
+            record = {
+                "kind": "flight_record",
+                "event": event,
+                "unix_time": time.time(),
+                "windows": _jsonable(list(registry.flush_ring)),
+                "current": _jsonable(registry.snapshot()),
+                "spans": [
+                    {
+                        "name": s.name, "t0": s.t0, "dur_s": s.dur_s,
+                        **({"step": s.step} if s.step is not None else {}),
+                        **({"meta": _jsonable(s.meta)} if s.meta else {}),
+                    }
+                    for s in spans
+                ],
+                "executables": _jsonable(executables),
+                **({"extra": _jsonable(extra)} if extra else {}),
+            }
+            self.directory.mkdir(parents=True, exist_ok=True)
+            path = self.directory / f"flight_recorder_{event}.json"
+            with open(path, "w") as fh:
+                json.dump(record, fh, indent=1, sort_keys=True)
+            logger.warning("flight recorder: dumped %s -> %s", event, path)
+            return path
+        except Exception:  # noqa: BLE001 — see module docstring
+            logger.exception("flight recorder: dump for %r failed", event)
+            return None

@@ -45,7 +45,10 @@ __all__ = [
 # any breaking change to event shapes emitted by sinks.JsonlSink.
 # v2: adds the ``executable`` event kind (per-executable compile/HBM/FLOPs
 # records from telemetry/introspect.py); v1 files remain readable.
-SCHEMA_VERSION = 2
+# v3: adds the ``request_trace`` event kind (per-request serving
+# milestones keyed by a fleet-stable trace id); v1/v2 files remain
+# readable.
+SCHEMA_VERSION = 3
 
 
 def exp_edges(lo: float, hi: float, bins: int) -> tuple[float, ...]:
@@ -204,7 +207,9 @@ class MetricRegistry:
     JSONL sink streams the timeline through one); keep observers cheap.
     """
 
-    def __init__(self, *, timeline_capacity: int = 8192):
+    def __init__(
+        self, *, timeline_capacity: int = 8192, flush_ring_capacity: int = 16
+    ):
         self._lock = threading.Lock()
         self.counters: dict[str, Counter] = {}
         self.gauges: dict[str, Gauge] = {}
@@ -214,6 +219,16 @@ class MetricRegistry:
             maxlen=timeline_capacity
         )
         self.span_observers: list[Callable[[Span], None]] = []
+        # raw-value observers, fired by record_value (the SLO layer's
+        # streaming digests subscribe here — the fixed-bin histograms
+        # are too coarse for tail SLOs, so digests need the raw samples)
+        self.value_observers: list[Callable[[str, float], None]] = []
+        # flight-recorder ring (docs/design/observability.md): the last N
+        # flush snapshots, appended by Telemetry.flush — what the anomaly
+        # flight recorder dumps when something goes wrong
+        self.flush_ring: collections.deque[dict[str, Any]] = (
+            collections.deque(maxlen=flush_ring_capacity)
+        )
         # loop-global step tag: the trainer advances it; components that
         # have no step plumbed through (executor, checkpointer) stamp
         # their spans with it
@@ -245,6 +260,17 @@ class MetricRegistry:
         with self._lock:
             self.gauge_fns[name] = fn
 
+    def unregister_gauge_fn(self, name: str, fn=None) -> None:
+        """Remove a callback gauge registration. With ``fn`` given, the
+        removal only happens if the registration still points at that
+        exact callable — a component renaming its gauge (replica
+        labelling) must not tear down a different component's later
+        registration under the same name."""
+        with self._lock:
+            cur = self.gauge_fns.get(name)
+            if cur is not None and (fn is None or cur is fn):
+                del self.gauge_fns[name]
+
     def histogram(
         self, name: str, edges: Iterable[float] | None = None
     ) -> Histogram:
@@ -255,6 +281,17 @@ class MetricRegistry:
                     name, edges if edges is not None else DEFAULT_LATENCY_EDGES
                 )
             return h
+
+    def record_value(
+        self, name: str, value: float, edges: Iterable[float] | None = None
+    ) -> None:
+        """Record one raw sample: feeds the fixed-bin histogram AND every
+        registered value observer (the SLO layer's streaming quantile
+        digests). Components whose latencies may carry tail SLOs record
+        through this instead of ``histogram(...).record``."""
+        self.histogram(name, edges).record(value)
+        for obs in list(self.value_observers):
+            obs(name, value)
 
     # -- timeline ------------------------------------------------------
 

@@ -50,6 +50,8 @@ class TelemetrySink:
 
     def on_executable(self, record: dict[str, Any]) -> None: ...
 
+    def on_request_trace(self, record: dict[str, Any]) -> None: ...
+
     def close(self) -> None: ...
 
 
@@ -119,6 +121,11 @@ class JsonlSink(TelemetrySink):
         # right after a multi-minute compile still leaves its record
         self._write({"kind": "executable", **record})
         self._fh.flush()
+
+    def on_request_trace(self, record: dict[str, Any]) -> None:
+        # per-request milestones (schema v3): buffered like spans — a
+        # handful of events per request, flushed on the flush cadence
+        self._write({"kind": "request_trace", **record})
 
     def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
         self._file()  # ensure the meta header exists even for span-free runs
@@ -200,6 +207,11 @@ class ConsoleSink(TelemetrySink):
         "train/mfu",
         "serve/tokens_per_s",
         "serve/slot_utilization",
+        # fleet rollups (resilience/elastic.ServingFleet): present only
+        # while a fleet is active, so single-batcher jobs pay no line width
+        "serve/fleet_replicas",
+        "serve/fleet_queue_depth",
+        "serve/fleet_tokens_per_s",
     )
     _HEADLINE_HISTS = (
         "train/step",
@@ -231,6 +243,16 @@ class ConsoleSink(TelemetrySink):
                     f"{name.split('/', 1)[1]}"
                     f"[p50={h['p50']:.4g}s p99={h['p99']:.4g}s]"
                 )
+        # SLO status (telemetry/slo.py): one word on the headline — the
+        # operator's console must say "burning" without a dashboard
+        burning = gauges.get("slo/burning")
+        if burning is not None and math.isfinite(burning):
+            violations = snapshot["counters"].get("slo/violations", 0)
+            parts.append(
+                "slo=ok" if burning == 0
+                else f"slo=BURNING({int(burning)} policy(ies), "
+                     f"{int(violations)} violation(s))"
+            )
         logger.info("telemetry %s", " ".join(parts))
 
 
@@ -241,14 +263,16 @@ _REQUIRED = {
     "span": ("name", "t0", "dur_s"),
     "flush": ("step", "counters", "gauges", "histograms"),
     "executable": ("name", "signature", "lower_s", "compile_s"),
+    "request_trace": ("trace_id", "event", "t"),
 }
 
 
 def validate_event(event: dict[str, Any]) -> None:
     """Raise ``ValueError`` if ``event`` is not a well-formed telemetry
     event (the contract bench harness tests pin). Files written by any
-    schema version up to the current one stay readable — v2 only added
-    the ``executable`` kind, which a v1 file simply never contains."""
+    schema version up to the current one stay readable — v2 added the
+    ``executable`` kind and v3 the ``request_trace`` kind, which older
+    files simply never contain."""
     kind = event.get("kind")
     if kind not in _REQUIRED:
         raise ValueError(f"unknown event kind {kind!r}")

@@ -1,0 +1,395 @@
+"""SLO layer: rolling-window streaming quantile digests + declarative
+burn-rate policies (docs/design/observability.md).
+
+The registry's fixed-bin histograms are cumulative-since-start and
+log-bin coarse — fine for dashboards, too blunt for tail SLOs ("p99
+TTFT over the last minute"). This module adds the missing half:
+
+- :class:`StreamingQuantileDigest` — a time-bucketed merging sketch.
+  The window is split into sub-buckets; each holds a bounded set of
+  weighted points that is *compressed* (sorted, every other point kept
+  at doubled weight) whenever it outgrows its capacity. Quantile
+  queries merge the live buckets' points. Memory is
+  O(buckets x capacity) regardless of traffic; samples age out with
+  their bucket, so the digest always describes the last ``window_s``
+  seconds. Rank error stays within a few parts per thousand at the
+  default capacity (pinned against exact quantiles in
+  ``tests/telemetry/test_slo.py``).
+- :class:`SloPolicy` — one declarative rule: a quantile objective
+  ("serve/ttft_s p99 <= 300ms") or an error-budget rate objective
+  ("deadline misses <= 1% of finished requests"), each with a window
+  and a *burn rate* threshold: ``burn = observed / target``; the policy
+  is burning when ``burn >= burn_rate`` (the SRE multi-window alerting
+  convention — ``burn_rate=1`` pages on any overrun, higher values page
+  only on fast budget burn).
+- :class:`SloMonitor` — evaluates every policy against the live
+  registry. Quantile policies read their digests (fed by
+  ``Telemetry.observe`` raw-value observers); rate policies difference
+  the named counters over the window (sampled at each evaluation, so no
+  instrumented component changes). Each evaluation sets
+  ``slo/{name}/observed``, ``slo/{name}/burn`` and
+  ``slo/{name}/violating`` gauges plus the fleet-wide ``slo/burning``
+  gauge; a policy that is burning bumps ``slo/violations`` (and
+  ``slo/{name}/violations``) **once per window** and logs one
+  rate-limited warning — a sustained burn pages once per window, not
+  once per scrape.
+
+Pure host Python, no jax anywhere: evaluation runs inside /metrics
+scrapes and telemetry flushes, neither of which may touch the device.
+"""
+
+import bisect
+import dataclasses
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Literal, Sequence
+
+__all__ = [
+    "SloMonitor",
+    "SloPolicy",
+    "StreamingQuantileDigest",
+]
+
+logger = logging.getLogger("d9d_tpu.telemetry")
+
+
+def _stratified_compress(
+    points: list[tuple[float, float]], m: int
+) -> list[tuple[float, float]]:
+    """Downsample weighted points to ``m`` representatives placed at the
+    centers of ``m`` equal cumulative-weight strata — the weighted
+    empirical CDF is preserved to within half a stratum of rank
+    (``total/2m``) per compression, so error grows additively with the
+    stratum width rather than multiplicatively with weight doubling."""
+    points.sort()
+    total = sum(w for _, w in points)
+    step = total / m
+    out: list[float] = []
+    cum = 0.0
+    ti = 0
+    for v, w in points:
+        cum += w
+        while ti < m and (ti + 0.5) * step <= cum + 1e-12:
+            out.append(v)
+            ti += 1
+    while ti < m:  # float-tail guard: always exactly m representatives
+        out.append(points[-1][0])
+        ti += 1
+    return [(v, step) for v in out]
+
+
+class _Bucket:
+    __slots__ = ("points", "raw")
+
+    def __init__(self):
+        self.points: list[tuple[float, float]] = []  # (value, weight)
+        self.raw = 0  # raw samples observed (pre-compression count)
+
+    def add(self, value: float, capacity: int) -> None:
+        self.points.append((value, 1.0))
+        self.raw += 1
+        if len(self.points) > capacity:
+            self.points = _stratified_compress(self.points, capacity // 2)
+
+
+class StreamingQuantileDigest:
+    """Windowed quantile sketch over a value stream.
+
+    ``record(v)`` is O(1) amortized (an append, occasionally a
+    sort-and-halve of one bucket); ``quantile(p)`` merges the live
+    buckets — called on the scrape/flush cadence, not the hot path.
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        *,
+        window_s: float = 60.0,
+        buckets: int = 8,
+        capacity: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if window_s <= 0 or buckets < 1 or capacity < 8:
+            raise ValueError(
+                f"need window_s > 0, buckets >= 1, capacity >= 8; got "
+                f"{window_s}, {buckets}, {capacity}"
+            )
+        self.window_s = float(window_s)
+        self._span = self.window_s / buckets
+        self._n_buckets = buckets
+        self._capacity = capacity
+        self._clock = clock
+        self._buckets: dict[int, _Bucket] = {}
+
+    def _prune(self, now: float) -> None:
+        # live window = the current bucket plus the n-1 before it, i.e.
+        # indices > cur - n; anything older has fully aged out
+        cur = int(now // self._span)
+        dead = [i for i in self._buckets if i <= cur - self._n_buckets]
+        for i in dead:
+            del self._buckets[i]
+
+    def record(self, value: float) -> None:
+        now = self._clock()
+        idx = int(now // self._span)
+        bucket = self._buckets.get(idx)
+        if bucket is None:
+            self._prune(now)
+            bucket = self._buckets[idx] = _Bucket()
+        bucket.add(float(value), self._capacity)
+
+    def count(self) -> int:
+        """Raw samples currently inside the window."""
+        self._prune(self._clock())
+        return sum(b.raw for b in self._buckets.values())
+
+    def quantile(self, p: float) -> float:
+        """Approximate ``p``-quantile (p in [0, 1]) of the samples in the
+        window; NaN when the window is empty."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        self._prune(self._clock())
+        merged: list[tuple[float, float]] = []
+        for b in self._buckets.values():
+            merged.extend(b.points)
+        if not merged:
+            return float("nan")
+        merged.sort()
+        total = sum(w for _, w in merged)
+        target = p * total
+        cum = 0.0
+        for v, w in merged:
+            cum += w
+            if cum >= target:
+                return v
+        return merged[-1][0]
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """One declarative SLO rule (see module docstring for semantics).
+
+    ``kind="quantile"``: ``metric`` names the digest stream (a raw-value
+    metric recorded through ``Telemetry.observe``, e.g. ``serve/ttft_s``)
+    and ``observed = quantile(quantile)`` in seconds; ``target`` is the
+    objective in the same unit.
+
+    ``kind="rate"``: ``bad`` names the failure counter (e.g.
+    ``serve/expired``) and ``good`` the success counters; over the
+    window ``observed = Δbad / (Δbad + ΣΔgood)`` and ``target`` is the
+    allowed bad fraction (the error budget, e.g. 0.01 for 1%).
+    """
+
+    name: str
+    target: float
+    window_s: float = 60.0
+    burn_rate: float = 1.0
+    kind: Literal["quantile", "rate"] = "quantile"
+    metric: str = ""
+    quantile: float = 0.99
+    bad: str = ""
+    good: tuple[str, ...] = ()
+    min_samples: int = 1
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("SloPolicy needs a name")
+        if self.target <= 0 or self.window_s <= 0 or self.burn_rate <= 0:
+            raise ValueError(
+                f"{self.name}: target/window_s/burn_rate must be > 0"
+            )
+        if self.kind == "quantile":
+            if not self.metric:
+                raise ValueError(f"{self.name}: quantile policy needs metric")
+            if not 0.0 <= self.quantile <= 1.0:
+                raise ValueError(f"{self.name}: quantile must be in [0, 1]")
+        elif self.kind == "rate":
+            if not self.bad:
+                raise ValueError(f"{self.name}: rate policy needs bad counter")
+        else:
+            raise ValueError(f"{self.name}: unknown kind {self.kind!r}")
+
+
+@dataclasses.dataclass
+class SloStatus:
+    """One policy's evaluation result (also mirrored into gauges)."""
+
+    policy: SloPolicy
+    observed: float
+    burn: float
+    violating: bool
+    samples: int
+
+
+class SloMonitor:
+    """Evaluate :class:`SloPolicy` rules against a telemetry registry.
+
+    ``attach(hub)`` subscribes the digests to the hub's raw-value stream
+    and registers the monitor for per-flush evaluation; /metrics scrapes
+    (``telemetry/export.py``) evaluate it too, so an operator polling
+    only the endpoint still gets fresh burn rates.
+    """
+
+    def __init__(
+        self,
+        policies: Sequence[SloPolicy],
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        digest_buckets: int = 8,
+        digest_capacity: int = 256,
+    ):
+        self.policies = tuple(policies)
+        names = [p.name for p in self.policies]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate policy names in {names}")
+        self._clock = clock
+        # one digest PER (metric, window): two policies with different
+        # windows over the same metric must each see their own horizon —
+        # a shared widest-window digest would let a 4-minute-old spike
+        # keep a 60s policy burning
+        self._digests: dict[tuple[str, float], StreamingQuantileDigest] = {}
+        self._digests_by_metric: dict[
+            str, list[StreamingQuantileDigest]
+        ] = {}
+        for p in self.policies:
+            if p.kind != "quantile":
+                continue
+            key = (p.metric, p.window_s)
+            if key not in self._digests:
+                d = self._digests[key] = StreamingQuantileDigest(
+                    window_s=p.window_s,
+                    buckets=digest_buckets,
+                    capacity=digest_capacity,
+                    clock=clock,
+                )
+                self._digests_by_metric.setdefault(p.metric, []).append(d)
+        # counter history rings for rate policies: (t, value) samples
+        # appended at each evaluation; the windowed delta is current
+        # minus the newest sample at/before (now - window)
+        self._counter_rings: dict[str, deque[tuple[float, float]]] = {}
+        self._max_window = max(
+            (p.window_s for p in self.policies), default=60.0
+        )
+        self._last_violation: dict[str, float] = {}
+        # evaluate() runs from scrape threads (MetricsServer) AND the
+        # flush path concurrently; the once-per-window violation bump is
+        # check-then-set and the counter rings mutate — serialize it
+        self._eval_lock = threading.Lock()
+        self._hub = None
+
+    def attach(self, hub) -> "SloMonitor":
+        hub.registry.value_observers.append(self._on_value)
+        hub.slo_monitor = self
+        self._hub = hub
+        return self
+
+    def detach(self) -> None:
+        if self._hub is None:
+            return
+        observers = self._hub.registry.value_observers
+        if self._on_value in observers:
+            observers.remove(self._on_value)
+        if self._hub.slo_monitor is self:
+            self._hub.slo_monitor = None
+        self._hub = None
+
+    def _on_value(self, name: str, value: float) -> None:
+        for d in self._digests_by_metric.get(name, ()):
+            d.record(value)
+
+    # -- counter windowing ---------------------------------------------
+
+    def _counter_value(self, registry, name: str) -> float:
+        c = registry.counters.get(name)
+        return float(c.value) if c is not None else 0.0
+
+    def _windowed_delta(
+        self, registry, name: str, window_s: float, now: float
+    ) -> float:
+        cur = self._counter_value(registry, name)
+        ring = self._counter_rings.setdefault(name, deque())
+        # baseline: the newest sample at/before the window start; if the
+        # ring doesn't reach back that far yet (cold start), the oldest
+        # sample — best-effort until a full window of history exists
+        cutoff = now - window_s
+        base = ring[0][1] if ring else cur
+        times = [t for t, _ in ring]
+        i = bisect.bisect_right(times, cutoff) - 1
+        if i >= 0:
+            base = ring[i][1]
+        ring.append((now, cur))
+        while ring and ring[0][0] < now - 2 * self._max_window:
+            ring.popleft()
+        return max(0.0, cur - base)
+
+    # -- evaluation -----------------------------------------------------
+
+    def evaluate(self, registry=None) -> list[SloStatus]:
+        """Evaluate every policy; set the ``slo/*`` gauges; bump
+        ``slo/violations`` once per window per burning policy.
+        Thread-safe: scrapes and flushes may evaluate concurrently."""
+        if registry is None:
+            if self._hub is None:
+                return []
+            registry = self._hub.registry
+        with self._eval_lock:
+            return self._evaluate_locked(registry)
+
+    def _evaluate_locked(self, registry) -> list[SloStatus]:
+        now = self._clock()
+        statuses: list[SloStatus] = []
+        burning = 0
+        for p in self.policies:
+            if p.kind == "quantile":
+                digest = self._digests[(p.metric, p.window_s)]
+                samples = digest.count()
+                observed = (
+                    digest.quantile(p.quantile)
+                    if samples >= p.min_samples else float("nan")
+                )
+                burn = observed / p.target if math.isfinite(observed) else 0.0
+            else:
+                bad = self._windowed_delta(registry, p.bad, p.window_s, now)
+                den = bad + sum(
+                    self._windowed_delta(registry, g, p.window_s, now)
+                    for g in p.good
+                )
+                samples = int(den)
+                observed = bad / den if den >= p.min_samples else float("nan")
+                burn = observed / p.target if math.isfinite(observed) else 0.0
+            violating = burn >= p.burn_rate
+            # NaN clears the gauge from snapshots (the registry filters
+            # NaN): an emptied window must DROP the observed value, not
+            # keep exporting the last spike next to burn=0
+            registry.gauge(f"slo/{p.name}/observed").set(
+                observed if math.isfinite(observed) else float("nan")
+            )
+            registry.gauge(f"slo/{p.name}/burn").set(burn)
+            registry.gauge(f"slo/{p.name}/violating").set(
+                1.0 if violating else 0.0
+            )
+            if violating:
+                burning += 1
+                last = self._last_violation.get(p.name)
+                if last is None or now - last >= p.window_s:
+                    # once per window, however often evaluation runs: a
+                    # sustained burn pages once per window, not per scrape
+                    self._last_violation[p.name] = now
+                    registry.counter("slo/violations").add(1)
+                    registry.counter(f"slo/{p.name}/violations").add(1)
+                    logger.warning(
+                        "SLO %s burning: observed %.6g vs target %.6g "
+                        "(burn %.2fx >= %.2fx) over %.0fs window "
+                        "[%d sample(s)]",
+                        p.name, observed, p.target, burn, p.burn_rate,
+                        p.window_s, samples,
+                    )
+            statuses.append(SloStatus(
+                policy=p, observed=observed, burn=burn,
+                violating=violating, samples=samples,
+            ))
+        registry.gauge("slo/burning").set(float(burning))
+        return statuses

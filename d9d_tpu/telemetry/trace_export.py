@@ -22,6 +22,13 @@ Perfetto JSON (``{"traceEvents": [...]}``) in which:
 - **executable** records (telemetry/introspect.py) become ``"i"``
   instant events on the ``compile`` track with the FLOPs/HBM payload in
   ``args``, so a recompile shows up as a visible pin on the timeline;
+- **request_trace** events (schema v3 — per-request serving milestones
+  keyed by a fleet-stable trace id) become one track *per request*
+  (``req/{trace_id}``): consecutive milestones turn into ``"X"`` state
+  spans (``queued`` → ``running@r0`` → ``decoding@r0`` → ``migrating``
+  → …) and terminal milestones into ``"i"`` pins, so a request that
+  crossed a preemption-driven migration reads as ONE contiguous lane —
+  the continuity the fleet's kill-recovery contract promises;
 - process/thread ``"M"`` metadata events name every lane.
 
 The output ordering is deterministic (sorted by timestamp, then pid,
@@ -54,6 +61,18 @@ __all__ = [
 logger = logging.getLogger("d9d_tpu.telemetry.trace_export")
 
 _PROC_RE = re.compile(r"_proc(\d+)\.jsonl$")
+
+# request_trace rendering: the state a request ENTERS at each milestone
+# (the span runs until the next milestone) and the milestones that end
+# the request (rendered as instant pins, no outgoing span)
+_REQUEST_STATE = {
+    "submit": "queued",
+    "admit": "running",
+    "first_token": "decoding",
+    "migrate": "migrating",
+    "continuation": "recovering",
+}
+_REQUEST_TERMINAL = frozenset({"finish", "expired", "failed", "rejected"})
 
 
 def _read_events_lenient(path: Path) -> list[dict[str, Any]]:
@@ -160,9 +179,12 @@ def merge_to_chrome_trace(paths: Iterable[str | Path]) -> dict[str, Any]:
                 tracks.append(track)
             return tid
 
+        req_events: dict[str, list[dict[str, Any]]] = {}
         for ev in events:
             kind = ev["kind"]
-            if kind == "span":
+            if kind == "request_trace":
+                req_events.setdefault(ev["trace_id"], []).append(ev)
+            elif kind == "span":
                 args: dict[str, Any] = {}
                 if "step" in ev:
                     args["step"] = ev["step"]
@@ -202,6 +224,41 @@ def merge_to_chrome_trace(paths: Iterable[str | Path]) -> dict[str, Any]:
                     "args": {
                         k: v for k, v in ev.items() if k != "kind"
                     },
+                })
+        # per-request tracks: one lane per trace id, milestones turned
+        # into contiguous state spans + terminal pins (request_trace
+        # timestamps are perf_counter values — same rebase as spans)
+        for trace_id in sorted(req_events):
+            evs = sorted(req_events[trace_id], key=lambda e: e["t"])
+            tid = tid_of(f"req/{trace_id}")
+            for i, ev in enumerate(evs):
+                milestone = ev["event"]
+                args: dict[str, Any] = {"trace_id": trace_id}
+                if ev.get("replica") is not None:
+                    args["replica"] = ev["replica"]
+                if ev.get("rid") is not None:
+                    args["rid"] = ev["rid"]
+                if ev.get("meta"):
+                    args.update(ev["meta"])
+                if milestone in _REQUEST_TERMINAL:
+                    trace_events.append({
+                        "ph": "i", "pid": pid, "tid": tid,
+                        "ts": wall_us(ev["t"]), "name": milestone,
+                        "cat": "request", "s": "t", "args": args,
+                    })
+                    continue
+                if i + 1 >= len(evs):
+                    continue  # still in flight at log end: no close time
+                state = _REQUEST_STATE.get(milestone, milestone)
+                label = (
+                    f"{state}@{ev['replica']}"
+                    if ev.get("replica") is not None else state
+                )
+                trace_events.append({
+                    "ph": "X", "pid": pid, "tid": tid,
+                    "ts": wall_us(ev["t"]),
+                    "dur": (evs[i + 1]["t"] - ev["t"]) * 1e6,
+                    "name": label, "cat": "request", "args": args,
                 })
         for track in sorted(tracks):
             meta_events.append({

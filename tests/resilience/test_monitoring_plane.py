@@ -1,0 +1,345 @@
+"""Monitoring-plane acceptance demo (docs/design/observability.md):
+a 2-replica fleet serves with the exporter up — the /metrics scrape
+shows per-replica and fleet rollup values, a migrated request's trace
+stays continuous under one trace id through shrink AND kill-mid-drain
+continuation, an induced deadline burn trips ``slo/violations`` exactly
+once per window, and an induced NaN / replica death produces a
+flight-recorder dump — all at zero added device readbacks."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from tests.resilience.conftest import ToyDecodeLM, toy_expected
+from tests.telemetry.test_export import parse_prometheus
+
+from d9d_tpu.loop.serve import ContinuousBatcher
+from d9d_tpu.resilience import ServingFleet
+from d9d_tpu.resilience.chaos import kill_replica_mid_drain, shrink_at_step
+from d9d_tpu.telemetry import (
+    JsonlSink,
+    SloMonitor,
+    SloPolicy,
+    Telemetry,
+    get_telemetry,
+    set_telemetry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    """Isolate each test's instruments from the process hub (fleet and
+    batcher default to get_telemetry())."""
+    old = get_telemetry()
+    hub = set_telemetry(Telemetry())
+    yield hub
+    set_telemetry(old)
+
+
+def _make_batcher(**kwargs):
+    model = ToyDecodeLM()
+    kwargs.setdefault("batch_size", 2)
+    kwargs.setdefault("chunk_size", 4)
+    return ContinuousBatcher(model, {}, **kwargs)
+
+
+def _fleet(n=2, **fleet_kwargs):
+    fleet = ServingFleet(**fleet_kwargs)
+    for _ in range(n):
+        fleet.add_replica(_make_batcher())
+    return fleet
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_fleet_scrape_shows_per_replica_and_rollup_values():
+    fleet = _fleet(2, metrics_port=0)
+    try:
+        url = fleet.metrics_server.url
+        # before any readback: compiling must not read as serving
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url("/readyz"))
+        assert exc.value.code == 503
+        prompts = [[3], [7, 8], [1], [5], [9], [2, 6]]
+        frids = [fleet.submit(p, max_new_tokens=4) for p in prompts]
+        out = fleet.drain()
+        for frid, p in zip(frids, prompts):
+            assert out[frid] == toy_expected(p, 4)
+        _, text = _get(url("/metrics"))
+        samples = parse_prometheus(text)  # asserts valid Prometheus text
+        total = samples[("d9d_serve_tokens", "")]
+        r0 = samples[("d9d_serve_tokens", 'replica="0"')]
+        r1 = samples[("d9d_serve_tokens", 'replica="1"')]
+        assert total == 6 * 4
+        assert r0 > 0 and r1 > 0 and r0 + r1 == total
+        # scrape matches the registry mid-run, not a stale copy
+        snap = get_telemetry().registry.snapshot()
+        assert snap["counters"]["serve/tokens"] == total
+        assert samples[("d9d_serve_fleet_replicas", "")] == 2
+        assert ("d9d_serve_fleet_queue_depth", "") in samples
+        # per-replica health + fleet readiness
+        code, body = _get(url("/healthz"))
+        health = json.loads(body)
+        assert code == 200
+        assert health["replicas"]["0"]["ready"] is True
+        assert health["replicas"]["1"]["live"] is True
+        code, _ = _get(url("/readyz"))
+        assert code == 200
+        # the monitoring plane added ZERO device readbacks: one readback
+        # per chunk, exactly the pre-exporter contract
+        for i in (0, 1):
+            b = fleet._replicas[i]
+            assert b.stats.readbacks == b.stats.chunks
+            assert b.stats.host_dispatches == b.stats.chunks
+    finally:
+        fleet.close()
+    # close() tears the fleet rollup gauges down — a closed fleet must
+    # not keep reporting stale depth/rate into later snapshots
+    gauges = get_telemetry().registry.snapshot()["gauges"]
+    assert "serve/fleet_queue_depth" not in gauges
+    assert "serve/fleet_tokens_per_s" not in gauges
+
+
+def test_trace_id_continuous_across_migration_and_kill(tmp_path):
+    """One trace id follows a request through shrink migration AND
+    kill-mid-drain continuation; the Perfetto export renders it as one
+    contiguous track."""
+    hub = get_telemetry()
+    sink = hub.add_sink(JsonlSink(tmp_path, run_name="fleet"))
+    fleet = _fleet(2)
+    prompts = [[3], [7], [12], [1]]
+    frids = [fleet.submit(p, max_new_tokens=10) for p in prompts]
+    fleet.step()  # let chunks land so the dying replica holds progress
+    shrink_at_step(fleet, 0, step=2)
+    kill_replica_mid_drain(fleet, 0, after_chunks=1)
+    out = fleet.drain()
+    for frid, p in zip(frids, prompts):
+        assert out[frid] == toy_expected(p, 10)
+    hub.flush(step=0)
+    hub.remove_sink(sink)
+
+    from d9d_tpu.telemetry import iter_events
+
+    traces = {}
+    for ev in iter_events(sink.path):  # schema-validates every event
+        if ev["kind"] == "request_trace":
+            traces.setdefault(ev["trace_id"], []).append(ev)
+    assert len(traces) == len(prompts)
+    continued = [
+        tid for tid, evs in traces.items()
+        if any(e["event"] == "continuation" for e in evs)
+    ]
+    assert continued, "the kill must have recovered at least one request"
+    for tid, evs in traces.items():
+        evs.sort(key=lambda e: e["t"])
+        assert evs[0]["event"] == "submit"
+        # every request finishes exactly once, under its original id
+        assert [e["event"] for e in evs].count("finish") == 1
+        assert evs[-1]["event"] == "finish"
+    for tid in continued:
+        evs = traces[tid]
+        replicas = {
+            e["replica"] for e in evs
+            if e["event"] == "submit" and "replica" in e
+        }
+        assert len(replicas) >= 2, (
+            "a continuation must re-submit on a DIFFERENT replica "
+            f"under the same trace id (saw {replicas})"
+        )
+
+    # Perfetto: the migrated request is ONE track whose state spans
+    # tile the submit→finish interval with no gaps
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    trace = merge_to_chrome_trace([sink.path])
+    tid0 = continued[0]
+    lane_names = {
+        e["tid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    req_lanes = {
+        t for t, name in lane_names.items() if name == f"req/{tid0}"
+    }
+    assert len(req_lanes) == 1, "one request = one track"
+    lane = req_lanes.pop()
+    xs = sorted(
+        (e for e in trace["traceEvents"]
+         if e["ph"] == "X" and e["tid"] == lane),
+        key=lambda e: e["ts"],
+    )
+    assert xs, "the request must have state spans"
+    for a, b in zip(xs, xs[1:]):
+        assert a["ts"] + a["dur"] == pytest.approx(b["ts"], abs=1.0), (
+            "state spans must tile the request's lifetime contiguously"
+        )
+    pins = [
+        e for e in trace["traceEvents"]
+        if e["ph"] == "i" and e["tid"] == lane
+    ]
+    assert [p["name"] for p in pins] == ["finish"]
+
+
+def test_rejected_trace_terminal_only_at_the_front_door(tmp_path):
+    """A replica rejecting a FLEET placement attempt is not terminal (a
+    survivor may accept); exactly one terminal 'rejected' appears — from
+    the fleet when every replica rejects, or from a direct batcher
+    submit (its own front door)."""
+    from d9d_tpu.loop.serve import QueueFullError
+    from d9d_tpu.telemetry import iter_events
+
+    hub = get_telemetry()
+    sink = hub.add_sink(JsonlSink(tmp_path, run_name="rej"))
+    fleet = ServingFleet()
+    for _ in range(2):
+        fleet.add_replica(_make_batcher(max_queue=1, batch_size=1))
+    placed = [fleet.submit([3], max_new_tokens=2) for _ in range(2)]
+    with pytest.raises(QueueFullError):
+        fleet.submit([4], max_new_tokens=2)  # every replica rejects
+    # direct front-door rejection on a full replica mints its own id
+    with pytest.raises(QueueFullError):
+        fleet._replicas[0].submit([5], max_new_tokens=2)
+    out = fleet.drain()
+    for frid in placed:
+        assert out[frid] == toy_expected([3], 2)
+    hub.flush(step=0)
+    hub.remove_sink(sink)
+    rejected = []
+    finished = set()
+    for ev in iter_events(sink.path):
+        if ev["kind"] != "request_trace":
+            continue
+        if ev["event"] == "rejected":
+            rejected.append(ev)
+        if ev["event"] == "finish":
+            finished.add(ev["trace_id"])
+    # exactly two terminal rejections: the fleet's all-replicas-full one
+    # + the direct submit's — NO per-replica placement-attempt noise
+    assert len(rejected) == 2
+    assert not any(r["trace_id"] in finished for r in rejected), (
+        "a trace that finished must never also carry a terminal reject"
+    )
+    fleet_rej = [r for r in rejected if "replica" not in r]
+    direct_rej = [r for r in rejected if r.get("replica") == "r0"]
+    assert len(fleet_rej) == 1 and len(direct_rej) == 1
+
+
+def test_deadline_burn_trips_slo_violations_once_per_window():
+    hub = get_telemetry()
+    monitor = SloMonitor([
+        SloPolicy(
+            name="deadline_miss", kind="rate", bad="serve/expired",
+            good=("serve/requests_finished",), target=0.01,
+            window_s=60.0,
+        ),
+    ]).attach(hub)
+    monitor.evaluate()  # baseline counter sample before the burn
+    fleet = _fleet(1)
+    import time
+
+    doomed = [
+        fleet.submit([3], max_new_tokens=4, deadline_s=0.001)
+        for _ in range(3)
+    ]
+    ok = fleet.submit([9], max_new_tokens=4)
+    time.sleep(0.02)  # all three deadlines expire while queued
+    out = fleet.drain()
+    assert out[ok] == toy_expected([9], 4)
+    assert all(fleet.failed.get(d) == "deadline" for d in doomed)
+    # 3 misses of 4 requests vs a 1% budget: a hard burn — but however
+    # many flushes/scrapes evaluate it, ONE violation per window
+    for _ in range(4):
+        hub.flush(step=0)
+    reg = hub.registry
+    assert reg.counter("slo/violations").value == 1
+    assert reg.counter("slo/deadline_miss/violations").value == 1
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo/deadline_miss/violating"] == 1.0
+    assert snap["gauges"]["slo/burning"] == 1.0
+    monitor.detach()
+
+
+def test_replica_death_dumps_flight_recorder(tmp_path):
+    hub = get_telemetry()
+    hub.configure_flight_recorder(tmp_path)
+    fleet = _fleet(2)
+    frids = [
+        fleet.submit(p, max_new_tokens=10)
+        for p in ([3], [7], [12], [1])
+    ]
+    fleet.step()
+    shrink_at_step(fleet, 0, step=2)
+    kill_replica_mid_drain(fleet, 0, after_chunks=1)
+    fleet.drain()
+    assert 0 in fleet.dead, "the chaos kill must have fired"
+    path = tmp_path / "flight_recorder_replica_death.json"
+    assert path.exists()
+    record = json.loads(path.read_text())
+    assert record["event"] == "replica_death"
+    assert record["extra"]["replica"] == 0
+    assert record["extra"]["recovered_requests"] >= 1
+    assert record["current"]["counters"]["serve/fleet_replica_deaths"] == 1
+    for frid in frids:
+        assert len(fleet.outputs(frid)) == 10
+
+
+def test_trainer_nan_dumps_flight_recorder(tmp_path):
+    """A deterministic ChaosScaleTask NaN must leave
+    flight_recorder_anomaly.json next to the telemetry dir."""
+    from tests.resilience.conftest import make_micro_trainer
+
+    from d9d_tpu.loop import CausalLMTask
+    from d9d_tpu.resilience.chaos import ChaosScaleTask
+
+    tele_dir = tmp_path / "telemetry"
+    task = ChaosScaleTask(CausalLMTask(), scale_at={2: float("nan")})
+    trainer = make_micro_trainer(
+        task, total_steps=5, anomaly_policy="warn",
+        telemetry_dir=str(tele_dir),
+    )
+    trainer.train()
+    path = tmp_path / "flight_recorder_anomaly.json"
+    assert path.exists(), "the anomaly guard must dump the black box"
+    record = json.loads(path.read_text())
+    assert record["event"] == "anomaly"
+    assert record["extra"]["policy"] == "warn"
+    assert record["extra"]["step"] >= 1
+    # the dump carries executable inventory at the moment of the anomaly
+    assert any(
+        e.get("name") == "train_step" for e in record["executables"]
+    )
+
+
+def test_trainer_metrics_endpoint_readiness(tmp_path):
+    """TrainerConfig.metrics_port serves /metrics during train() and the
+    endpoint is closed (port released) when train() returns."""
+    from tests.resilience.conftest import make_micro_trainer
+
+    from d9d_tpu.loop import CausalLMTask
+
+    trainer = make_micro_trainer(
+        CausalLMTask(), total_steps=4, metrics_port=0,
+    )
+    seen = {}
+
+    def probe(**payload):
+        if payload.get("step") == 3 and "text" not in seen:
+            url = trainer.metrics_server.url
+            seen["ready_code"] = _get(url("/readyz"))[0]
+            seen["text"] = _get(url("/metrics"))[1]
+
+    from d9d_tpu.loop import event as ev
+
+    trainer.events.subscribe(ev.EVENT_STEP.pre, probe)
+    trainer.train()
+    assert seen["ready_code"] == 200  # past warmup (2 steps) at step 3
+    samples = parse_prometheus(seen["text"])
+    assert samples[("d9d_train_steps", "")] >= 2
+    assert trainer.metrics_server is None  # closed in the finally block

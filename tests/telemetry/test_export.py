@@ -1,0 +1,202 @@
+"""Live metrics endpoint (telemetry/export.py): the Prometheus text
+rendering must be valid exposition format with cumulative histogram
+buckets and replica-label folding, and the live server must serve
+scrapes that match the registry mid-run, report health/readiness, and
+shut down cleanly."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from d9d_tpu.telemetry import (
+    MetricsServer,
+    SloMonitor,
+    SloPolicy,
+    Telemetry,
+    render_prometheus,
+)
+
+# Prometheus text exposition: every non-comment line is a sample
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? (?P<value>[0-9eE+.infNa-]+)$"
+)
+_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ")
+
+
+def parse_prometheus(text):
+    """Strict-enough parser: asserts well-formedness, returns
+    ``{(name, labels_str): value}``."""
+    samples = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert _COMMENT.match(line), line
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        samples[(m.group("name"), m.group("labels") or "")] = float(
+            m.group("value")
+        )
+    return samples
+
+
+def _hub_with_instruments():
+    hub = Telemetry()
+    hub.counter("serve/tokens").add(30)
+    hub.counter("serve/r0/tokens").add(10)
+    hub.counter("serve/r1/tokens").add(20)
+    hub.gauge("serve/fleet_replicas").set(2)
+    h = hub.histogram("serve/ttft_s", edges=(0.0, 0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 5.0):
+        h.record(v)
+    return hub
+
+
+def test_render_is_valid_and_matches_registry():
+    hub = _hub_with_instruments()
+    text = render_prometheus(hub.registry.snapshot())
+    samples = parse_prometheus(text)
+    assert samples[("d9d_serve_tokens", "")] == 30
+    assert samples[("d9d_serve_fleet_replicas", "")] == 2
+    # histogram: cumulative buckets, +Inf == count, sum matches. The
+    # registry's FINAL bin absorbs over-range samples, so its upper
+    # edge is never emitted as a `le` bound — the 5.0 sample is only
+    # representable under +Inf
+    assert samples[("d9d_serve_ttft_s_bucket", 'le="0.1"')] == 2
+    assert samples[("d9d_serve_ttft_s_bucket", 'le="1"')] == 3
+    assert ("d9d_serve_ttft_s_bucket", 'le="10"') not in samples
+    assert samples[("d9d_serve_ttft_s_bucket", 'le="+Inf"')] == 4
+    assert samples[("d9d_serve_ttft_s_count", "")] == 4
+    assert samples[("d9d_serve_ttft_s_sum", "")] == pytest.approx(5.6)
+    # deterministic output
+    assert text == render_prometheus(hub.registry.snapshot())
+
+
+def test_render_never_claims_over_range_samples_in_a_finite_bucket():
+    """A 50s latency in a 10s-top histogram must not read as <= 10s —
+    histogram_quantile over the scrape would otherwise cap every tail
+    at the top edge (the exact signal the SLO plane exists to expose)."""
+    hub = Telemetry()
+    h = hub.histogram("serve/ttft_s", edges=(0.0, 0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 50.0):  # 50.0 lands in the final (absorbing) bin
+        h.record(v)
+    samples = parse_prometheus(render_prometheus(hub.registry.snapshot()))
+    finite = {
+        k[1] for k in samples
+        if k[0] == "d9d_serve_ttft_s_bucket" and k[1] != 'le="+Inf"'
+    }
+    assert finite == {'le="0.1"', 'le="1"'}
+    assert samples[("d9d_serve_ttft_s_bucket", 'le="1"')] == 2
+    assert samples[("d9d_serve_ttft_s_bucket", 'le="+Inf"')] == 3
+
+
+def test_replica_namespace_folds_into_labels():
+    hub = _hub_with_instruments()
+    samples = parse_prometheus(render_prometheus(hub.registry.snapshot()))
+    assert samples[("d9d_serve_tokens", 'replica="0"')] == 10
+    assert samples[("d9d_serve_tokens", 'replica="1"')] == 20
+    # the rollup and the per-replica series agree
+    assert (
+        samples[("d9d_serve_tokens", 'replica="0"')]
+        + samples[("d9d_serve_tokens", 'replica="1"')]
+        == samples[("d9d_serve_tokens", "")]
+    )
+    # any path-free replica label folds into the family (not just r{i})
+    # — a custom-labeled replica must not escape fleet aggregations
+    hub.counter("serve/east1/tokens").add(5)
+    samples = parse_prometheus(render_prometheus(hub.registry.snapshot()))
+    assert samples[("d9d_serve_tokens", 'replica="east1"')] == 5
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def test_server_scrape_matches_registry_mid_run():
+    hub = Telemetry()
+    hub.counter("serve/tokens").add(3)
+    server = MetricsServer(hub, port=0).start()
+    try:
+        _, text = _get(server.url("/metrics"))
+        assert parse_prometheus(text)[("d9d_serve_tokens", "")] == 3
+        # mid-run: the next scrape sees the live registry, not a cache
+        hub.counter("serve/tokens").add(2)
+        _, text = _get(server.url("/metrics"))
+        assert parse_prometheus(text)[("d9d_serve_tokens", "")] == 5
+    finally:
+        server.close()
+    with pytest.raises(urllib.error.URLError):
+        _get(server.url("/metrics"), timeout=1)
+
+
+def test_readyz_transitions_and_healthz_detail():
+    hub = Telemetry()
+    state = {"ready": False}
+    server = MetricsServer(
+        hub, port=0,
+        readiness=lambda: (state["ready"], {"why": "warming"}),
+        health=lambda: {"replicas": {"0": {"live": True}}},
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/readyz"))
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["ready"] is False
+        state["ready"] = True
+        code, body = _get(server.url("/readyz"))
+        assert code == 200 and json.loads(body)["ready"] is True
+        code, body = _get(server.url("/healthz"))
+        detail = json.loads(body)
+        assert code == 200 and detail["status"] == "ok"
+        assert detail["replicas"]["0"]["live"] is True
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/nope"))
+        assert exc.value.code == 404
+    finally:
+        server.close()
+
+
+def test_readiness_exception_reads_as_not_ready():
+    hub = Telemetry()
+
+    def broken():
+        raise RuntimeError("boom")
+
+    server = MetricsServer(
+        hub, port=0, readiness=broken, health=broken
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/readyz"))
+        assert exc.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/healthz"))
+        assert exc.value.code == 500
+    finally:
+        server.close()
+
+
+def test_scrape_evaluates_attached_slo_monitor():
+    """Polling only /metrics must still refresh burn rates — the scrape
+    evaluates the hub's SLO monitor before rendering."""
+    hub = Telemetry()
+    SloMonitor(
+        [SloPolicy(name="q", metric="serve/ttft_s", quantile=0.5,
+                   target=0.1)],
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 1.0)  # 10x over target — nothing flushed
+    server = MetricsServer(hub, port=0).start()
+    try:
+        _, text = _get(server.url("/metrics"))
+        samples = parse_prometheus(text)
+        assert samples[("d9d_slo_q_burn", "")] == pytest.approx(10.0)
+        assert samples[("d9d_slo_q_violating", "")] == 1.0
+        assert samples[("d9d_slo_violations", "")] == 1.0
+    finally:
+        server.close()

@@ -1,0 +1,57 @@
+"""Flight recorder (telemetry/flight_recorder.py): flushes populate the
+registry's bounded window ring, a dump captures the last N windows +
+span tail + current instruments, repeats are rate-limited per event
+kind, and an unconfigured hub's dump is a no-op."""
+
+import json
+
+from d9d_tpu.telemetry import Telemetry
+
+
+def test_flush_ring_is_bounded_and_ordered():
+    hub = Telemetry()
+    hub.registry.flush_ring = type(hub.registry.flush_ring)(maxlen=3)
+    for i in range(5):
+        hub.counter("train/steps").add(1)
+        hub.flush(step=i)
+    ring = list(hub.registry.flush_ring)
+    assert [w["step"] for w in ring] == [2, 3, 4]
+    assert ring[-1]["snapshot"]["counters"]["train/steps"] == 5
+
+
+def test_dump_contents(tmp_path):
+    hub = Telemetry()
+    hub.configure_flight_recorder(tmp_path)
+    hub.counter("serve/tokens").add(7)
+    with hub.span("serve/step"):
+        pass
+    hub.flush(step=1)
+    hub.counter("serve/tokens").add(3)
+    hub.flush(step=2)
+    path = hub.dump_flight_record(
+        "test_event", extra={"reason": "unit"}
+    )
+    assert path is not None and path.name == "flight_recorder_test_event.json"
+    record = json.loads(path.read_text())
+    assert record["event"] == "test_event"
+    assert record["extra"]["reason"] == "unit"
+    # the last windows, in order, with their values at flush time
+    assert [w["step"] for w in record["windows"]] == [1, 2]
+    assert record["windows"][0]["snapshot"]["counters"]["serve/tokens"] == 7
+    assert record["current"]["counters"]["serve/tokens"] == 10
+    # the span tail includes the recorded span
+    assert any(s["name"] == "serve/step" for s in record["spans"])
+    assert "executables" in record
+
+
+def test_dump_rate_limited_per_event(tmp_path):
+    hub = Telemetry()
+    hub.configure_flight_recorder(tmp_path, min_interval_s=3600)
+    assert hub.dump_flight_record("storm") is not None
+    assert hub.dump_flight_record("storm") is None  # limited
+    assert hub.dump_flight_record("other") is not None  # separate kind
+
+
+def test_unconfigured_dump_is_noop():
+    hub = Telemetry()
+    assert hub.dump_flight_record("anything") is None

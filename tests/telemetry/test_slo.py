@@ -1,0 +1,247 @@
+"""SLO layer (telemetry/slo.py): the streaming windowed quantile digest
+must track exact quantiles on known distributions, samples must age out
+with the window, and SloPolicy evaluation must bump ``slo/violations``
+exactly once per window while a burn is sustained."""
+
+import logging
+import math
+
+import numpy as np
+import pytest
+
+from d9d_tpu.telemetry import (
+    SloMonitor,
+    SloPolicy,
+    StreamingQuantileDigest,
+    Telemetry,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+@pytest.mark.parametrize(
+    "sampler",
+    [
+        lambda rng, n: rng.uniform(0.0, 1.0, n),
+        lambda rng, n: rng.lognormal(mean=-3.0, sigma=1.0, size=n),
+        lambda rng, n: rng.exponential(0.05, n),
+    ],
+    ids=["uniform", "lognormal", "exponential"],
+)
+def test_digest_tracks_exact_quantiles(sampler):
+    """Rank error vs exact quantiles stays within 2% of n on 20k samples
+    — rank (not value) tolerance makes the bound distribution-free."""
+    clock = FakeClock()
+    digest = StreamingQuantileDigest(window_s=60.0, clock=clock)
+    xs = sampler(np.random.RandomState(0), 20_000)
+    for v in xs:
+        digest.record(v)
+    xs_sorted = np.sort(xs)
+    n = len(xs)
+    assert digest.count() == n
+    for p in (0.5, 0.9, 0.99):
+        est = digest.quantile(p)
+        rank = np.searchsorted(xs_sorted, est) / n
+        assert abs(rank - p) <= 0.02, (p, est, rank)
+
+
+def test_digest_window_expiry():
+    clock = FakeClock()
+    digest = StreamingQuantileDigest(window_s=10.0, clock=clock)
+    for _ in range(500):
+        digest.record(100.0)
+    assert digest.count() == 500
+    clock.advance(11.0)  # the whole window aged out
+    assert digest.count() == 0
+    assert math.isnan(digest.quantile(0.5))
+    # new samples describe only the new window
+    for _ in range(100):
+        digest.record(2.0)
+    assert digest.count() == 100
+    assert digest.quantile(0.5) == 2.0
+
+
+def test_digest_partial_expiry_keeps_recent_buckets():
+    clock = FakeClock()
+    digest = StreamingQuantileDigest(window_s=10.0, buckets=5, clock=clock)
+    digest.record(1.0)
+    clock.advance(6.0)  # old sample still inside the 10s window
+    for _ in range(99):
+        digest.record(2.0)
+    assert digest.count() == 100
+    assert digest.quantile(0.5) == 2.0
+    clock.advance(6.0)  # now the 1.0 sample (age 12s) has aged out
+    assert digest.count() == 99
+    assert min(v for v, _ in _all_points(digest)) == 2.0
+
+
+def _all_points(digest):
+    for b in digest._buckets.values():
+        yield from b.points
+
+
+def test_digest_validation():
+    with pytest.raises(ValueError):
+        StreamingQuantileDigest(window_s=0)
+    d = StreamingQuantileDigest()
+    with pytest.raises(ValueError):
+        d.quantile(1.5)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="needs metric"):
+        SloPolicy(name="x", target=1.0)
+    with pytest.raises(ValueError, match="needs bad"):
+        SloPolicy(name="x", target=1.0, kind="rate")
+    with pytest.raises(ValueError, match="must be > 0"):
+        SloPolicy(name="x", target=0.0, metric="m")
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor([
+            SloPolicy(name="x", target=1.0, metric="m"),
+            SloPolicy(name="x", target=2.0, metric="m"),
+        ])
+
+
+def test_quantile_policy_violates_once_per_window(caplog):
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="ttft_p90", metric="serve/ttft_s", quantile=0.9,
+                   target=0.1, window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    for _ in range(50):
+        hub.observe("serve/ttft_s", 0.5)  # 5x over target
+    with caplog.at_level(logging.WARNING, "d9d_tpu.telemetry"):
+        (status,) = monitor.evaluate()
+        assert status.violating and status.burn == pytest.approx(5.0)
+        # sustained burn, many evaluations: ONE violation per window and
+        # one warning (scrape cadence must not multiply pages)
+        for _ in range(5):
+            clock.advance(1.0)
+            monitor.evaluate()
+    reg = hub.registry
+    assert reg.counter("slo/violations").value == 1
+    assert reg.counter("slo/ttft_p90/violations").value == 1
+    warnings = [r for r in caplog.records if "SLO ttft_p90" in r.message]
+    assert len(warnings) == 1
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo/ttft_p90/burn"] == pytest.approx(5.0)
+    assert snap["gauges"]["slo/ttft_p90/violating"] == 1.0
+    assert snap["gauges"]["slo/burning"] == 1.0
+    # next window, burn still sustained: exactly one more violation
+    clock.advance(10.0)
+    for _ in range(50):
+        hub.observe("serve/ttft_s", 0.5)
+    monitor.evaluate()
+    monitor.evaluate()
+    assert reg.counter("slo/violations").value == 2
+
+
+def test_quantile_policy_recovers():
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="ttft", metric="serve/ttft_s", quantile=0.9,
+                   target=1.0, window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 5.0)
+    (status,) = monitor.evaluate()
+    assert status.violating
+    clock.advance(11.0)  # the bad sample ages out
+    hub.observe("serve/ttft_s", 0.2)
+    (status,) = monitor.evaluate()
+    assert not status.violating
+    assert hub.registry.snapshot()["gauges"]["slo/burning"] == 0.0
+
+
+def test_rate_policy_burn_over_window():
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="miss", kind="rate", bad="serve/expired",
+                   good=("serve/requests_finished",), target=0.1,
+                   window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    (status,) = monitor.evaluate()  # baseline sample: nothing counted yet
+    assert not status.violating
+    hub.counter("serve/expired").add(5)
+    hub.counter("serve/requests_finished").add(5)
+    clock.advance(1.0)
+    (status,) = monitor.evaluate()
+    # 5 bad of 10 → 50% miss rate vs 10% budget → 5x burn
+    assert status.observed == pytest.approx(0.5)
+    assert status.burn == pytest.approx(5.0)
+    assert status.violating
+    assert hub.registry.counter("slo/violations").value == 1
+    # the deltas age out of the window: burn clears
+    clock.advance(11.0)
+    (status,) = monitor.evaluate()
+    assert not status.violating
+
+
+def test_no_samples_means_no_violation():
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="q", metric="serve/ttft_s", target=0.001),
+         SloPolicy(name="r", kind="rate", bad="serve/expired",
+                   target=0.001)],
+    ).attach(hub)
+    statuses = monitor.evaluate()
+    assert not any(s.violating for s in statuses)
+    assert hub.registry.counter("slo/violations").value == 0
+
+
+def test_flush_evaluates_attached_monitor():
+    hub = Telemetry()
+    SloMonitor(
+        [SloPolicy(name="q", metric="serve/ttft_s", quantile=0.5,
+                   target=0.1)],
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 1.0)
+    snap = hub.flush(step=0)
+    assert snap["gauges"]["slo/q/violating"] == 1.0
+    assert snap["counters"]["slo/violations"] == 1
+
+
+def test_detach_stops_observation():
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="q", metric="serve/ttft_s", target=0.1)],
+    ).attach(hub)
+    monitor.detach()
+    assert hub.slo_monitor is None
+    hub.observe("serve/ttft_s", 9.9)
+    assert monitor._digests[("serve/ttft_s", 60.0)].count() == 0
+
+
+def test_same_metric_different_windows_get_separate_digests():
+    """A 10s policy and a 60s policy over the same metric must each see
+    their OWN horizon: a spike that aged out of the short window must
+    not keep the short policy burning via a shared wide digest."""
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="short", metric="serve/ttft_s", quantile=0.9,
+                   target=0.1, window_s=10.0),
+         SloPolicy(name="long", metric="serve/ttft_s", quantile=0.9,
+                   target=0.1, window_s=60.0)],
+        clock=clock,
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 5.0)  # a spike, way over target
+    clock.advance(20.0)  # outside the 10s window, inside the 60s one
+    hub.observe("serve/ttft_s", 0.05)  # currently healthy
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert not by_name["short"].violating  # the spike aged out for it
+    assert by_name["long"].violating       # but is still in ITS window

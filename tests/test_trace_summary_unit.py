@@ -222,6 +222,107 @@ def test_perfetto_merge_tolerates_crash_truncated_tail(tmp_path):
     assert [e["name"] for e in xs] == ["train/step"]
 
 
+def _append_request_trace(path, trace_id, events):
+    """events: [(event, t, replica_or_None, meta_or_None)]"""
+    with open(path, "a") as fh:
+        for event, t, replica, meta in events:
+            ev = {"kind": "request_trace", "trace_id": trace_id,
+                  "event": event, "t": t}
+            if replica is not None:
+                ev["replica"] = replica
+            if meta:
+                ev["meta"] = meta
+            fh.write(json.dumps(ev) + "\n")
+
+
+def test_perfetto_renders_per_request_tracks(tmp_path):
+    """Schema-v3 request_trace milestones become one contiguous track
+    per trace id: state spans between milestones, a terminal pin, and
+    a migration crossing replicas stays on the SAME lane."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "req_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0, spans=[],
+    )
+    _append_request_trace(path, "req-1-0", [
+        ("submit", 1.0, "r0", None),
+        ("admit", 1.2, "r0", None),
+        ("first_token", 1.5, "r0", None),
+        ("migrate", 2.0, None, {"from_replica": 0}),
+        ("submit", 2.1, "r1", None),
+        ("admit", 2.2, "r1", None),
+        ("finish", 3.0, "r1", {"tokens": 8}),
+    ])
+    trace = merge_to_chrome_trace([path])
+    lanes = {
+        e["tid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    req_tids = [t for t, n in lanes.items() if n == "req/req-1-0"]
+    assert len(req_tids) == 1
+    tid = req_tids[0]
+    xs = sorted(
+        (e for e in trace["traceEvents"]
+         if e["ph"] == "X" and e["tid"] == tid),
+        key=lambda e: e["ts"],
+    )
+    assert [e["name"] for e in xs] == [
+        "queued@r0", "running@r0", "decoding@r0", "migrating",
+        "queued@r1", "running@r1",
+    ]
+    # contiguous: each state span ends where the next begins
+    import pytest
+
+    for a, b in zip(xs, xs[1:]):
+        assert a["ts"] + a["dur"] == pytest.approx(b["ts"], abs=1.0)
+    pins = [e for e in trace["traceEvents"]
+            if e["ph"] == "i" and e["tid"] == tid]
+    assert [p["name"] for p in pins] == ["finish"]
+    assert pins[0]["args"]["tokens"] == 8
+
+
+def test_trace_summary_cli_trace_id_filter(tmp_path):
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "req_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0,
+        spans=[("serve/step", 1.0, 0.1, None)],
+    )
+    _append_request_trace(path, "req-a", [
+        ("submit", 1.0, "r0", None), ("admit", 1.1, "r0", None),
+        ("finish", 1.9, "r0", None),
+    ])
+    _append_request_trace(path, "req-b", [
+        ("submit", 1.0, "r1", None),
+        ("continuation", 1.5, None, {"from_replica": 1}),
+        ("submit", 1.6, "r0", None), ("finish", 2.4, "r0", None),
+    ])
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "request traces: 2 request(s), 1 migration" in out.stdout
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path), "--trace-id", "req-b"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "request req-b (4 milestone(s))" in out.stdout
+    assert "continuation" in out.stdout
+    assert "req-a" not in out.stdout
+
+
 def test_perfetto_merge_rejects_headerless_files(tmp_path):
     from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
 

@@ -416,6 +416,18 @@ run_leg "pipeline schedule microbench" bench_results/pp.jsonl \
 run_leg "executor dispatch-overhead A/B (precompiled vs naive)" \
   bench_results/pp_overhead.jsonl python tools/bench_pp_overhead.py
 
+echo "== monitoring-plane overhead leg (exporter-enabled microbench + scrape)"
+# the 2% exporter budget, measured ON CHIP: the exporter-enabled leg
+# re-runs the serving microbench with the /metrics endpoint + SLO
+# monitor up and captures one scrape per leg into bench_results/ —
+# exporter_overhead_frac in the summary is the strict chip number
+# (tier-1 gates the same leg on CPU with a collapse floor only)
+: > bench_results/serve_exporter.json
+run_leg "serving exporter overhead" bench_results/serve_exporter_leg.txt \
+  env D9D_SCRAPE_OUT=bench_results/metrics_scrape.txt \
+  python tools/bench_compare.py --run-micro \
+    --write-current bench_results/serve_exporter.json || true
+
 echo "== perf-regression compare vs BENCH_BASELINE.json (report-only)"
 # the committed baseline gates the CPU microbench in tier-1; for the
 # chip legs this emits the comparison so BASELINE.md updates start from

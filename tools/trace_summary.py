@@ -171,15 +171,21 @@ def _fmt_bytes(v) -> str:
     return f"{v:.1f}GiB"  # pragma: no cover — loop always returns
 
 
-def summarize_telemetry(files, *, top: int, perfetto=None) -> None:
+def summarize_telemetry(
+    files, *, top: int, perfetto=None, trace_id=None
+) -> None:
     """Telemetry-mode report: span aggregate, per-executable inventory,
-    final flush counters; optional merged Perfetto export. Reads
-    leniently — a crashed process's truncated log must still report."""
+    per-request trace summary (schema v3 ``request_trace``), final flush
+    counters; optional merged Perfetto export. ``trace_id`` filters the
+    request-trace section to one request's full milestone sequence.
+    Reads leniently — a crashed process's truncated log must still
+    report."""
     from d9d_tpu.telemetry.trace_export import _read_events_lenient
 
     spans = collections.defaultdict(lambda: [0.0, 0])  # name → [Σs, n]
     executables = []
     last_flush = {}
+    requests = collections.defaultdict(list)  # trace_id → [events]
     for path in files:
         for ev in _read_events_lenient(path):
             if ev["kind"] == "span":
@@ -190,8 +196,42 @@ def summarize_telemetry(files, *, top: int, perfetto=None) -> None:
                 executables.append((path, ev))
             elif ev["kind"] == "flush":
                 last_flush[path] = ev
+            elif ev["kind"] == "request_trace":
+                requests[ev["trace_id"]].append(ev)
 
     print(f"telemetry logs: {[str(f) for f in files]}")
+    if trace_id is not None:
+        evs = sorted(requests.get(trace_id, []), key=lambda e: e["t"])
+        if not evs:
+            print(f"\nno request_trace events for trace id {trace_id!r} "
+                  f"({len(requests)} trace id(s) in the logs)")
+        else:
+            t0 = evs[0]["t"]
+            print(f"\nrequest {trace_id} ({len(evs)} milestone(s)):")
+            print(f"{'+ms':>10}  {'replica':>8}  {'rid':>5}  event")
+            for ev in evs:
+                meta = ev.get("meta")
+                print(
+                    f"{(ev['t'] - t0) * 1e3:>10.3f}  "
+                    f"{str(ev.get('replica', '-')):>8}  "
+                    f"{str(ev.get('rid', '-')):>5}  {ev['event']}"
+                    + (f"  {meta}" if meta else "")
+                )
+    elif requests:
+        migrations = sum(
+            1 for evs in requests.values() for e in evs
+            if e["event"] in ("migrate", "continuation")
+        )
+        by_replica = collections.Counter(
+            e.get("replica", "-") for evs in requests.values() for e in evs
+            if e["event"] == "submit"
+        )
+        print(
+            f"\nrequest traces: {len(requests)} request(s), "
+            f"{migrations} migration/continuation event(s); "
+            f"submits by replica: {dict(sorted(by_replica.items()))} "
+            "(--trace-id ID for one request's milestones)"
+        )
     if spans:
         print(f"\nspans (Σ over {len(files)} process log(s)):")
         print(f"{'s':>10}  {'calls':>6}  {'ms/call':>9}  name")
@@ -221,6 +261,24 @@ def summarize_telemetry(files, *, top: int, perfetto=None) -> None:
             f"{len(executables)} executables, {recompiles} recompile(s) "
             "(R rows)"
         )
+
+    # per-replica serve rollup (the serve/{label}/* namespacing — the
+    # fleet assigns r{i}, embedders may use any path-free label):
+    # final-flush counters side by side, one row per replica
+    for path, ev in last_flush.items():
+        per_replica = collections.defaultdict(dict)
+        for k, v in ev.get("counters", {}).items():
+            m = re.match(r"^serve/([^/]+)/(.+)$", k)
+            if m:
+                per_replica[m.group(1)][m.group(2)] = v
+        if per_replica:
+            keys = sorted({k for d in per_replica.values() for k in d})
+            print(f"\nper-replica serve counters [{path.name}]:")
+            print(f"{'replica':>8}  " + "  ".join(f"{k:>20}" for k in keys))
+            for r in sorted(per_replica):
+                print(f"{r:>8}  " + "  ".join(
+                    f"{per_replica[r].get(k, 0):>20.6g}" for k in keys
+                ))
 
     for path, ev in last_flush.items():
         interesting = {
@@ -261,12 +319,18 @@ def main():
         help="telemetry mode: merge all input JSONL logs into one "
         "clock-aligned Chrome-trace/Perfetto file",
     )
+    ap.add_argument(
+        "--trace-id", default=None,
+        help="telemetry mode: print the full request_trace milestone "
+        "sequence for one per-request trace id (schema v3)",
+    )
     args = ap.parse_args()
 
     telemetry_files = collect_telemetry_files(args.logdir)
     if telemetry_files:
         summarize_telemetry(
-            telemetry_files, top=args.top, perfetto=args.perfetto
+            telemetry_files, top=args.top, perfetto=args.perfetto,
+            trace_id=args.trace_id,
         )
         return
     if args.perfetto:
