@@ -72,6 +72,26 @@ serves live Prometheus ``/metrics`` + ``/healthz`` + ``/readyz`` from
 a background thread — all pure host work, zero added readbacks (gated
 by ``tools/bench_compare.py``'s exporter leg).
 
+Paged KV cache + prefix cache (docs/design/generation.md): with
+``page_size`` set, the sequence caches become device-resident page
+POOLS (``[num_pages, ..., page_size, ...]``) indexed through a
+static-shape per-row ``[B, max_pages]`` page table — HBM per request
+is proportional to its actual length instead of ``decode_max_length``,
+admission is bounded by free pages rather than batch rows, and a
+content-hashed prefix cache maps a shared prompt's pages
+copy-on-write into later requests so it prefills once per replica.
+All policy (free lists, refcounts, hashing, LRU eviction —
+``loop/kv_paging.py``) runs on the host at the SAME chunk boundaries
+admission already owns; the page table is a traced cache leaf like
+``cache_index``, so the host-interaction contract above and the
+``tracked_jit`` fingerprints are untouched (``tools/bench_compare.py``
+gates the paged leg's dispatch/readback/compile counts against the
+contiguous leg's). The flash-decode kernel generalizes its kv-block
+index map to gather page ids (``ops/attention/pallas_decode.py``);
+the eager path gathers a contiguous per-row view and remains the
+bitwise exactness reference — greedy paged serving is token-identical
+to the contiguous layout, prefix hit or cold.
+
 Live weight publish (docs/design/elasticity.md): the jitted executables
 take the parameter tree as a *traced argument* — never a trace-time
 closure constant — so :meth:`ContinuousBatcher.install_weights` can
@@ -157,6 +177,15 @@ class _Request:
     max_new_tokens: int
     deadline_t: float | None = None
     trace_id: str | None = None
+
+    @property
+    def total_tokens(self) -> int:
+        """Cache slots this request writes over its lifetime: every
+        prompt token plus every generated token except the final
+        sample (emitted but never fed back). THE footprint every page
+        computation keys on — submit validation, the queue-full
+        capacity credit and allocation must never disagree by a page."""
+        return len(self.prompt) + self.max_new_tokens - 1
 
 
 @dataclasses.dataclass
@@ -292,6 +321,21 @@ def _pin_cache_index(cache, live: Array):
     return map_cache_index(cache, lambda idx: jnp.where(live, idx, 0))
 
 
+def _pin_page_table(cache, live: Array):
+    """Paged companion of :func:`_pin_cache_index`: pin dead/idle rows'
+    page-table rows to the reserved garbage page (0). A row that dies
+    mid-chunk keeps executing static-shape steps — with its write index
+    pinned to 0 its writes land at logical slot 0, and WITHOUT this pin
+    that is ``page_table[b, 0]``, which may be a freed page or (worse) a
+    SHARED prefix page. With it, dead rows scribble harmlessly into the
+    garbage page until the host reuses the slot."""
+    from d9d_tpu.nn.decode_flags import map_page_table
+
+    return map_page_table(
+        cache, lambda pt: jnp.where(live[:, None], pt, 0)
+    )
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a KV-cache decode model.
 
@@ -327,6 +371,9 @@ class ContinuousBatcher:
         stall_timeout_s: Optional[float] = None,
         replica_label: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
     ):
         """Degraded-mode knobs (docs/design/resilience.md): ``max_queue``
         bounds the admission queue — ``submit()`` past it raises
@@ -347,7 +394,24 @@ class ContinuousBatcher:
         :class:`~d9d_tpu.telemetry.MetricsServer` for this batcher —
         ``/metrics`` in Prometheus text, ``/readyz`` not-ready until the
         first readback has round-tripped; call :meth:`close` (or use the
-        fleet's endpoint instead) to shut it down."""
+        fleet's endpoint instead) to shut it down.
+
+        Paged KV knobs (docs/design/generation.md "Paged KV cache"):
+        ``page_size`` switches the sequence caches to a device-resident
+        page pool + per-row page tables — HBM per request becomes
+        proportional to its ACTUAL length, admission is bounded by free
+        pages (head-of-line waits, never rejects, when pages run
+        short), and a content-hashed prefix cache lets a shared system
+        prompt prefill once and be mapped copy-on-write into later
+        requests. ``num_pages`` sizes the pool (default: enough for
+        every slot at full ``decode_max_length`` + the reserved garbage
+        page — no savings until you shrink it). ``prefix_cache`` —
+        None (default) auto-enables when every sequence cache is
+        pageable and disables for models with unpageable per-row
+        recurrent state (GDN/conv tails: their state summarizes the
+        whole prefix and cannot be restored from KV pages); True forces
+        (raising if unsound), False disables. Greedy decoding is
+        token-identical to the contiguous layout either way."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
         if chunk_size is not None and chunk_size < 1:
@@ -373,6 +437,30 @@ class ContinuousBatcher:
         self._dml = int(getattr(model, "decode_max_length", 0))
         if self._dml <= 0:
             raise ValueError("model must be built with decode_max_length > 0")
+
+        # paged KV mode (docs/design/generation.md): fixed-size page
+        # pools + per-row page tables instead of contiguous per-row
+        # cache leaves; allocation/refcounting/prefix caching is host
+        # work at the existing chunk boundaries (loop/kv_paging.py)
+        self._paged = page_size is not None
+        self._kv = None
+        if self._paged:
+            if page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            self._page_size = int(page_size)
+            self._pages_per_row = -(-self._dml // self._page_size)
+            self._num_pages = (
+                int(num_pages) if num_pages is not None
+                # default: every slot can hold a full-length request
+                # (+ the reserved garbage page) — paging then changes
+                # accounting but strands nothing; shrink it to actually
+                # overcommit HBM
+                else batch_size * self._pages_per_row + 1
+            )
+        elif num_pages is not None or prefix_cache is not None:
+            raise ValueError(
+                "num_pages/prefix_cache need paged mode (set page_size)"
+            )
 
         self._slots = [_Slot() for _ in range(batch_size)]
         self._queue: collections.deque[_Request] = collections.deque()
@@ -444,10 +532,56 @@ class ContinuousBatcher:
         # and each distinct fused K compiles its own scan
         self._step = None
         self._fused: dict[tuple[int, bool], object] = {}  # (k, with_admit)
-        self._reset = tracked_jit(
-            _zero_row, name="serve/reset_row", donate_argnums=0
-        )
+        if self._paged:
+            from d9d_tpu.nn.decode_flags import (
+                map_cache_index,
+                zero_rows_skip_paged,
+            )
+
+            def _reset_rows_paged(cache, row_mask, admit_pos):
+                # page pools are shared (never row-zeroed — stale page
+                # bytes are unreachable behind the slot mask) and table
+                # rows come from the host mirror; per-row leaves reset,
+                # write indices jump to the first un-cached position
+                cache = zero_rows_skip_paged(cache, row_mask)
+                return map_cache_index(
+                    cache,
+                    lambda idx: jnp.where(row_mask, admit_pos, idx),
+                )
+
+            self._reset = tracked_jit(
+                _reset_rows_paged, name="serve/reset_row_paged",
+                donate_argnums=0,
+            )
+        else:
+            self._reset = tracked_jit(
+                _zero_row, name="serve/reset_row", donate_argnums=0
+            )
         self._cache = self._init_cache()
+        # KV residency accounting (serve/kv_* gauges + the bench's
+        # hbm_bytes_per_request): peaks over the measurement window
+        self._peak_running = 0
+        if self._paged:
+            from d9d_tpu.loop.kv_paging import PagedKVAllocator
+
+            if prefix_cache and self._unpageable_leaves:
+                raise ValueError(
+                    "prefix_cache=True is unsound for this model: cache "
+                    f"leaves {self._unpageable_leaves} hold per-row "
+                    "recurrent state that summarizes the whole prefix "
+                    "and cannot be restored from KV pages"
+                )
+            self._kv = PagedKVAllocator(
+                num_pages=self._num_pages,
+                page_size=self._page_size,
+                rows=batch_size,
+                max_pages_per_row=self._pages_per_row,
+                enable_prefix_cache=(
+                    prefix_cache if prefix_cache is not None
+                    else not self._unpageable_leaves
+                ),
+            )
+            self._kv_table_dirty = False  # seeded leaves match the mirror
 
         # live weight publish (docs/design/elasticity.md): staged tree
         # swapped in at the next dispatch boundary, generation-stamped
@@ -582,22 +716,67 @@ class ContinuousBatcher:
         self._tele.record_request_trace(rec)
 
     def _init_cache(self):
+        import math
+
+        from flax.traverse_util import flatten_dict, unflatten_dict
+
+        from d9d_tpu.nn.decode_flags import (
+            PAGE_TABLE_LEAF,
+            PAGED_CACHE_LEAVES,
+        )
+
         z = jnp.zeros((self._b, 1), jnp.int32)
         # eval_shape: cache SHAPES only — model.init would materialize
         # (and immediately discard) a full second copy of the parameters
         shapes = jax.eval_shape(
             self._model.init, jax.random.PRNGKey(0), z, z, z
         )
-        cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"]
+        flat = flatten_dict(shapes["cache"])
+        # dense-layout byte total of the sequence caches: the paged
+        # mode's savings denominator, and the contiguous mode's (static)
+        # KV residency for the hbm-bytes-per-request accounting
+        self._kv_bytes_static = sum(
+            math.prod(s.shape) * jnp.dtype(s.dtype).itemsize
+            for p, s in flat.items() if p[-1] in PAGED_CACHE_LEAVES
         )
-        # per-row write indices: seed [B] zeros in place of the scalar —
-        # the decode modules accept either rank (nn/attention.py)
-        from d9d_tpu.nn.decode_flags import map_cache_index
-
-        return map_cache_index(
-            cache, lambda _idx: jnp.zeros((self._b,), jnp.int32)
-        )
+        # per-row cache leaves that are NOT pageable (GDN recurrent
+        # state, conv tails, toy memories): paging leaves them per-row;
+        # their presence auto-disables the prefix cache (their state
+        # can't be rebuilt from shared KV pages)
+        self._unpageable_leaves = sorted({
+            p[-1] for p in flat
+            if p[-1] not in PAGED_CACHE_LEAVES and p[-1] != "cache_index"
+        })
+        self._page_bytes = 0
+        out = {}
+        for p, s in flat.items():
+            if p[-1] == "cache_index":
+                # per-row write indices: seed [B] zeros in place of the
+                # scalar — the decode modules accept either rank
+                out[p] = jnp.zeros((self._b,), jnp.int32)
+            elif self._paged and p[-1] in PAGED_CACHE_LEAVES:
+                axis = PAGED_CACHE_LEAVES[p[-1]]
+                if s.shape[axis] != self._dml:
+                    raise ValueError(
+                        f"cache leaf {'/'.join(p)} slot axis {axis} is "
+                        f"{s.shape[axis]}, expected decode_max_length="
+                        f"{self._dml}"
+                    )
+                pool = jnp.zeros(
+                    (self._num_pages,) + s.shape[1:axis]
+                    + (self._page_size,) + s.shape[axis + 1:],
+                    s.dtype,
+                )
+                out[p] = pool
+                # one table per module scope (identical contents; a few
+                # ints per layer) so the module reads its own sibling
+                out[p[:-1] + (PAGE_TABLE_LEAF,)] = jnp.zeros(
+                    (self._b, self._pages_per_row), jnp.int32
+                )
+                self._page_bytes += pool.nbytes // self._num_pages
+            else:
+                out[p] = jnp.zeros(s.shape, s.dtype)
+        return unflatten_dict(out)
 
     # ------------------------------------------------------------------
     # jitted executables
@@ -627,13 +806,18 @@ class ContinuousBatcher:
         ).astype(jnp.int32)
 
     def _build_step(self):
+        paged = self._paged
+
         def step_fn(params, cache, tok, pos, key, live):
             cache, row_logits = self._model_step(params, cache, tok, pos)
             nxt = self._sample(row_logits, key)
             # idle rows ride through the static-shape step; pin their
             # write index so an arbitrarily long idle stretch can't
             # overflow capacity or defeat the flash block skip
-            return _pin_cache_index(cache, live), nxt
+            cache = _pin_cache_index(cache, live)
+            if paged:
+                cache = _pin_page_table(cache, live)
+            return cache, nxt
 
         # donate the cache: XLA aliases input buffers to outputs, so the
         # per-step update is in place — no second cache residency or
@@ -648,17 +832,38 @@ class ContinuousBatcher:
         every follow-up chunk, all speculative chunks) skips them — the
         masked zero is a full-capacity read+write of every cache leaf,
         exactly the O(s_max) traffic class the fused loop exists to
-        avoid paying per chunk."""
+        avoid paying per chunk.
+
+        Paged mode differences, same dispatch structure: admitted rows
+        reset only their PER-ROW leaves (pools are shared; stale page
+        bytes sit behind the slot mask) and jump their write index /
+        position to ``admit_pos`` — the first token past their prefix-
+        cache hit; each step additionally pins dead/idle rows' page
+        tables to the garbage page (see :func:`_pin_page_table`)."""
         eos = self._eos
+        paged = self._paged
+        if paged:
+            from d9d_tpu.nn.decode_flags import (
+                map_cache_index,
+                zero_rows_skip_paged,
+            )
 
         def fused_fn(params, cache, tok, pos, live, rem, key,
                      forced_t, n_forced, emit_from,
-                     admit_mask=None, admit_budget=None):
+                     admit_mask=None, admit_budget=None, admit_pos=None):
             if with_admit:
                 # boundary work, fused into the same dispatch: zero
                 # admitted rows' cache and reset their carries
-                cache = _zero_row(cache, admit_mask)
-                pos = jnp.where(admit_mask, 0, pos)
+                if paged:
+                    cache = zero_rows_skip_paged(cache, admit_mask)
+                    cache = map_cache_index(
+                        cache,
+                        lambda idx: jnp.where(admit_mask, admit_pos, idx),
+                    )
+                    pos = jnp.where(admit_mask, admit_pos, pos)
+                else:
+                    cache = _zero_row(cache, admit_mask)
+                    pos = jnp.where(admit_mask, 0, pos)
                 live = jnp.where(admit_mask, True, live)
                 rem = jnp.where(admit_mask, admit_budget, rem)
             keys = jax.random.split(key, k)
@@ -688,6 +893,8 @@ class ContinuousBatcher:
                 tok = jnp.where(live, nxt, tok)
                 pos = jnp.where(live, pos + 1, pos)
                 cache = _pin_cache_index(cache, live)
+                if paged:
+                    cache = _pin_page_table(cache, live)
                 return (cache, tok, pos, live, rem), out
 
             (cache, tok, pos, live, rem), toks = jax.lax.scan(
@@ -700,7 +907,10 @@ class ContinuousBatcher:
 
         return tracked_jit(
             fused_fn,
-            name=f"serve/fused_k{k}" + ("_admit" if with_admit else ""),
+            name=(
+                f"serve/fused_k{k}" + ("_paged" if paged else "")
+                + ("_admit" if with_admit else "")
+            ),
             donate_argnums=(1, 2, 3, 4, 5),
         )
 
@@ -744,6 +954,13 @@ class ContinuousBatcher:
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens}"
                 f" - 1 = {need} exceeds decode_max_length={self._dml}"
             )
+        if self._paged and not self._kv.fits_ever(need):
+            raise ValueError(
+                f"request needs {self._kv.pages_needed(need)} pages but "
+                f"the pool holds {self._num_pages - 1} allocatable "
+                f"(num_pages={self._num_pages}, page_size="
+                f"{self._page_size}); it could never be admitted"
+            )
         now = time.perf_counter()
         minted_here = trace_id is None
         if minted_here:
@@ -753,19 +970,39 @@ class ContinuousBatcher:
             # passed must not hold queue capacity against new traffic
             self._expire_queued(now)
             if len(self._queue) >= self._max_queue:
-                self.stats.rejected += 1
-                self._count("serve/rejected")
-                if minted_here:
-                    # terminal only for a front-door submit: a fleet
-                    # placement attempt (external trace id) that this
-                    # replica rejects may still land on a survivor —
-                    # the fleet emits the terminal event if ALL reject
-                    self._trace(trace_id, "rejected", now,
-                                queued=len(self._queue))
-                raise QueueFullError(
-                    f"admission queue full ({len(self._queue)} >= "
-                    f"max_queue={self._max_queue}); retry after drain"
-                )
+                # running-side mirror of the PR 5 queued-side fix: a
+                # deadline-expired RUNNING row frees a slot this
+                # boundary, which the queue head is guaranteed to admit
+                # into — count those frees as capacity before rejecting
+                freed = int(self._expire_running(now).sum())
+                if freed and self._paged:
+                    # paged admission is PAGE-bounded, not slot-bounded:
+                    # the freed slot is only real capacity if the queue
+                    # head can map onto pages by the next admit boundary
+                    # — which flushes deferred frees first, so count
+                    # those too (conservative beyond that: prefix hits
+                    # and LRU eviction could only help)
+                    head = self._queue[0]
+                    if (
+                        self._kv.pages_needed(head.total_tokens)
+                        > self._kv.pages_free_after_flush()
+                    ):
+                        freed = 0
+                if len(self._queue) - freed >= self._max_queue:
+                    self.stats.rejected += 1
+                    self._count("serve/rejected")
+                    if minted_here:
+                        # terminal only for a front-door submit: a fleet
+                        # placement attempt (external trace id) that
+                        # this replica rejects may still land on a
+                        # survivor — the fleet emits the terminal event
+                        # if ALL reject
+                        self._trace(trace_id, "rejected", now,
+                                    queued=len(self._queue))
+                    raise QueueFullError(
+                        f"admission queue full ({len(self._queue)} >= "
+                        f"max_queue={self._max_queue}); retry after drain"
+                    )
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(
@@ -808,6 +1045,14 @@ class ContinuousBatcher:
         self.outputs.clear()
         self.done.clear()
         self.failed.clear()
+        # KV residency window accounting; the prefix cache itself stays
+        # warm deliberately (like compile warmth / _first_readback_t)
+        self._peak_running = 0
+        if self._paged:
+            self._kv.peak_pages_in_use = self._kv.pages_in_use
+            self._kv.prefix_hits = 0
+            self._kv.prefix_misses = 0
+            self._kv.prefix_hit_tokens = 0
         now = time.perf_counter()
         self._rate_win_t0 = now
         self._rate_win_tokens = 0
@@ -871,6 +1116,17 @@ class ContinuousBatcher:
         self._pending_weights = None
         self._params = params
         self.weights_version = int(version)
+        if self._paged and self._kv.prefix_cache_enabled:
+            # cached prefix KV was computed under the OLD weights: a
+            # post-publish hit would silently attend stale pages and
+            # break the token-identity contract — drop every entry (the
+            # next cold fill re-caches under the new generation).
+            # In-flight rows are untouched; like the contiguous path,
+            # they finish on the cache they built.
+            dropped = self._kv.invalidate_prefix_cache()
+            if dropped:
+                self._count("serve/prefix_cache_invalidated", dropped)
+            self._note_pages()
         self._count("serve/weight_publish")
         self._observe(
             "serve/weight_publish_s", time.perf_counter() - t0
@@ -891,6 +1147,8 @@ class ContinuousBatcher:
         out = []
         while self._queue:
             req = self._queue.popleft()
+            if self._paged:
+                self._kv.forget(req.rid)  # drop any admission memo
             out.append(
                 (req.rid, list(req.prompt), req.max_new_tokens,
                  req.deadline_t)
@@ -906,6 +1164,98 @@ class ContinuousBatcher:
         if rid in self.done:
             return
         self._fail(rid, reason, time.perf_counter())
+
+    # ------------------------------------------------------------------
+    # paged KV bookkeeping (loop/kv_paging.py): all host work, all at
+    # the existing chunk boundaries — the dispatch/readback contract and
+    # the tracked_jit fingerprints are untouched
+
+    def _try_alloc(self, row: int, req: _Request):
+        """Map the queue head onto pages (prefix-cache walk + free-list
+        allocation); None leaves it queued — admission is bounded by
+        free pages, not rows."""
+        alloc = self._kv.admit(row, req.rid, req.prompt, req.total_tokens)
+        if alloc is None:
+            return None
+        self._kv_table_dirty = True
+        if self._kv.prefix_cache_enabled:
+            if alloc.hit_tokens:
+                self._count("serve/prefix_cache_hits")
+                self._count(
+                    "serve/prefix_cache_hit_tokens", alloc.hit_tokens
+                )
+            else:
+                self._count("serve/prefix_cache_misses")
+        return alloc
+
+    def _push_page_table(self) -> None:
+        """Sync the device page tables from the host mirror (a tiny
+        host→device transfer between dispatches — NOT a tracked
+        dispatch). Only ever called at clean boundaries (no chunks in
+        flight), so a zeroed row reroutes any still-live zombie row's
+        writes to the garbage page before its next chunk."""
+        if not self._kv_table_dirty:
+            return
+        self._kv_table_dirty = False
+        from d9d_tpu.nn.decode_flags import map_page_table
+
+        table = self._kv.table
+        # one fresh buffer PER leaf: the cache is donated into the
+        # fused dispatch, and donating one shared buffer through N
+        # layer scopes trips XLA's double-donation check
+        self._cache = map_page_table(
+            self._cache, lambda _pt: jnp.asarray(table)
+        )
+
+    def _release_row_pages(self, row: int, *, device_dead: bool) -> None:
+        """Drop a retired row's page references. ``device_dead`` rows
+        (finished in-device: their writes are already pinned to the
+        garbage page) free immediately; host-side kills with chunks in
+        flight DEFER — the device twin may still be live and writing
+        into these pages, so they stay held until the zeroed table row
+        has been pushed at a clean boundary (``flush_deferred``)."""
+        if device_dead or not self._pending:
+            self._kv.release(row)
+        else:
+            self._kv.defer_release(row)
+        self._kv_table_dirty = True
+        self._note_pages()
+
+    def _note_pages(self) -> None:
+        """Refresh the page-pool gauges (and the peak-concurrency
+        accounting both modes share) — pure host arithmetic."""
+        running = sum(1 for s in self._slots if s.rid >= 0)
+        self._peak_running = max(self._peak_running, running)
+        if not self._paged:
+            return
+        in_use = self._kv.pages_in_use
+        self._gauge_set("serve/kv_pages_in_use", in_use)
+        self._gauge_set("serve/kv_pages_free", self._kv.pages_free)
+        self._gauge_set(
+            "serve/kv_hbm_bytes_per_request",
+            in_use * self._page_bytes / max(1, running),
+        )
+
+    def hbm_bytes_per_request(self) -> float:
+        """Peak resident KV bytes over peak concurrent running requests
+        for the current measurement window — deterministic given the
+        schedule, so the bench gate can pin it exactly. Contiguous mode
+        charges the full static allocation (every row's
+        decode_max_length is resident whether used or not); paged mode
+        charges pages actually mapped."""
+        if self._paged:
+            resident = self._kv.peak_pages_in_use * self._page_bytes
+        else:
+            resident = self._kv_bytes_static
+        return resident / max(1, self._peak_running)
+
+    def prefix_hit_rate(self) -> float:
+        """Admissions served (partly) from the prefix cache over all
+        admissions in the window; 0.0 when disabled or idle."""
+        if self._kv is None:
+            return 0.0
+        total = self._kv.prefix_hits + self._kv.prefix_misses
+        return self._kv.prefix_hits / total if total else 0.0
 
     # ------------------------------------------------------------------
     # request latency telemetry (host clock only; see RequestTelemetry)
@@ -962,6 +1312,10 @@ class ContinuousBatcher:
     def _fail(self, rid: int, reason: str, now: float) -> None:
         self.failed[rid] = reason
         self.done.add(rid)
+        if self._paged:
+            # a request that failed mid-prompt-fill must not leave its
+            # half-written pages hit-eligible in the prefix cache
+            self._kv.abort_filling(rid)
         # accounting keyed on the reason: "expired" means deadline
         # expiry and nothing else (the degraded-mode signal operators
         # alert on); other retirements (fleet shrink) count separately
@@ -1014,6 +1368,10 @@ class ContinuousBatcher:
             self._slots[i] = _Slot()
             self._tokens[i] = 0
             evict[i] = True
+            if self._paged:
+                # the device twin may still be live: defer the free
+                # when chunks are in flight (see _release_row_pages)
+                self._release_row_pages(i, device_dead=False)
         return evict
 
     # rolling-window span for the live throughput gauge: long enough to
@@ -1053,26 +1411,46 @@ class ContinuousBatcher:
             now = time.perf_counter()
             self._expire_queued(now)
             reset_mask = self._expire_running(now)
+            admit_pos = np.zeros((self._b,), np.int32)
+            if self._paged and self._kv.flush_deferred():
+                self._kv_table_dirty = True  # legacy: always clean
             for i, slot in enumerate(self._slots):
                 if slot.rid >= 0 or not self._queue:
                     continue
-                req = self._queue.popleft()
+                req = self._queue[0]
+                start_pos = 0
+                if self._paged:
+                    alloc = self._try_alloc(i, req)
+                    if alloc is None:
+                        break  # head-of-line waits for pages to free
+                    start_pos = alloc.start_pos
+                self._queue.popleft()
                 self._slots[i] = _Slot(
                     rid=req.rid,
-                    pending=list(req.prompt[1:]),
-                    pos=0,
+                    pending=list(req.prompt[start_pos + 1:]),
+                    pos=start_pos,
                     emitted=0,
                     budget=req.max_new_tokens,
                     deadline_t=req.deadline_t,
                 )
-                self._tokens[i] = req.prompt[0]
+                self._tokens[i] = req.prompt[start_pos]
                 reset_mask[i] = True
+                admit_pos[i] = start_pos
                 self._note_admit(req.rid)
             if reset_mask.any():
-                self._cache = self._reset(
-                    self._cache, jnp.asarray(reset_mask)
-                )
+                if self._paged:
+                    self._cache = self._reset(
+                        self._cache, jnp.asarray(reset_mask),
+                        jnp.asarray(admit_pos),
+                    )
+                else:
+                    self._cache = self._reset(
+                        self._cache, jnp.asarray(reset_mask)
+                    )
                 self.stats.host_dispatches += 1
+            if self._paged:
+                self._push_page_table()
+            self._note_pages()
 
     def _step_legacy(self) -> dict[int, int]:
         self._apply_pending_weights()
@@ -1108,6 +1486,10 @@ class ContinuousBatcher:
             if slot.rid < 0:
                 continue
             slot.pos += 1
+            if self._paged and not slot.pending:
+                # the whole prompt has been dispatched: this rid's
+                # prefix-cache entries become hit-eligible (idempotent)
+                self._kv.mark_filled(slot.rid)
             if slot.pending:  # still consuming the prompt
                 self._tokens[i] = slot.pending.pop(0)
                 continue
@@ -1126,6 +1508,10 @@ class ContinuousBatcher:
                 self._slots[i] = _Slot()
                 self._tokens[i] = 0
                 evict_mask[i] = True
+                if self._paged:
+                    # legacy rows only step under a host live mask, so
+                    # a cleared slot can never write again: free now
+                    self._release_row_pages(i, device_dead=True)
             else:
                 self._tokens[i] = tok
         self._note_throughput(len(emitted), now)
@@ -1134,9 +1520,15 @@ class ContinuousBatcher:
             # cache contents can't leak into a same-rid-free diagnostic
             # view; the overflow/block-skip concern itself is handled by
             # the in-step cache_index pin
-            self._cache = self._reset(
-                self._cache, jnp.asarray(evict_mask)
-            )
+            if self._paged:
+                self._cache = self._reset(
+                    self._cache, jnp.asarray(evict_mask),
+                    jnp.zeros((self._b,), jnp.int32),
+                )
+            else:
+                self._cache = self._reset(
+                    self._cache, jnp.asarray(evict_mask)
+                )
             self.stats.host_dispatches += 1
         return emitted
 
@@ -1155,25 +1547,44 @@ class ContinuousBatcher:
         self._apply_pending_weights()
         admit_mask = np.zeros((self._b,), bool)
         admit_budget = np.zeros((self._b,), np.int32)
+        admit_pos = np.zeros((self._b,), np.int32)
         if admit:
             with annotate("serve.admit"):
                 now = time.perf_counter()
                 self._expire_queued(now)
                 self._expire_running(now)
+                if self._paged and self._kv.flush_deferred():
+                    # admit=True ⇒ no chunks in flight: deferred zombie
+                    # pages free now; the zeroed table rows push below,
+                    # BEFORE this dispatch
+                    self._kv_table_dirty = True
                 for i, slot in enumerate(self._slots):
                     if slot.rid >= 0 or not self._queue:
                         continue
-                    req = self._queue.popleft()
+                    req = self._queue[0]
+                    start_pos = 0
+                    if self._paged:
+                        alloc = self._try_alloc(i, req)
+                        if alloc is None:
+                            break  # head-of-line waits for pages
+                        start_pos = alloc.start_pos
+                    self._queue.popleft()
                     self._slots[i] = _Slot(
                         rid=req.rid,
-                        feed=list(req.prompt),
+                        # a prefix-cache hit skips the cached tokens:
+                        # feeding resumes at the first un-cached one
+                        feed=list(req.prompt[start_pos:]),
                         emitted=0,
                         budget=req.max_new_tokens,
                         deadline_t=req.deadline_t,
                     )
                     admit_mask[i] = True
                     admit_budget[i] = req.max_new_tokens
+                    admit_pos[i] = start_pos
                     self._note_admit(req.rid)
+                if self._paged:
+                    self._push_page_table()
+                self._note_pages()
 
         forced = np.zeros((self._b, k), np.int32)
         n_forced = np.zeros((self._b,), np.int32)
@@ -1198,10 +1609,11 @@ class ContinuousBatcher:
             fused = self._fused[(k, with_admit)] = self._build_fused(
                 k, with_admit
             )
-        admit_args = (
-            (jnp.asarray(admit_mask), jnp.asarray(admit_budget))
-            if with_admit else ()
-        )
+        admit_args = ()
+        if with_admit:
+            admit_args = (jnp.asarray(admit_mask), jnp.asarray(admit_budget))
+            if self._paged:
+                admit_args += (jnp.asarray(admit_pos),)
         with annotate("serve.dispatch"):
             (self._cache, self._tok_d, self._pos_d, self._live_d,
              self._rem_d, toks) = fused(
@@ -1212,6 +1624,14 @@ class ContinuousBatcher:
                 jnp.asarray(emit_from),
                 *admit_args,
             )
+        if self._paged:
+            for slot in self._slots:
+                if slot.rid >= 0 and not slot.feed:
+                    # the whole prompt is now DISPATCHED: this rid's
+                    # prefix-cache entries become hit-eligible — later
+                    # admits dispatch after, so their reads see the
+                    # writes (idempotent across chunks)
+                    self._kv.mark_filled(slot.rid)
         self._pending.append(
             (toks,
              _ChunkPlan(k=k, rids=rids, emit_from=emit_from.tolist(),
@@ -1260,6 +1680,13 @@ class ContinuousBatcher:
                     self.done.add(rid)
                     self._slots[i] = _Slot()
                     busy_steps = j + 1
+                    if self._paged:
+                        # the device row died IN-DEVICE at this same
+                        # step (its later writes are pinned to the
+                        # garbage page), so the pages free immediately;
+                        # reuse waits for the next admit boundary,
+                        # which pushes the zeroed table row first
+                        self._release_row_pages(i, device_dead=True)
                     break
             self.stats.slot_steps_busy += busy_steps
             chunk_busy += busy_steps

@@ -68,8 +68,55 @@ def _decode_cache_index(module: nn.Module):
     )
 
 
+def _decode_page_table(module: nn.Module):
+    """The module's page-table cache variable if the serving loop seeded
+    one (paged KV mode, loop/serve.py), else None. Presence of the leaf
+    IS the mode flag: the serving loop converts this module's sequence
+    caches into page pools in the same pass that seeds the table, so
+    the two can't disagree."""
+    from d9d_tpu.nn.decode_flags import PAGE_TABLE_LEAF
+
+    if not module.has_variable("cache", PAGE_TABLE_LEAF):
+        return None
+    return module.variable("cache", PAGE_TABLE_LEAF, lambda: None).value
+
+
+def _paged_write_checks(start, t: int, mask) -> None:
+    """The paged cache is a serving-loop construct: the loop feeds one
+    token per row per step (prompts are teacher-forced), never passes a
+    slot mask, and seeds per-row write indices. Anything else reaching a
+    paged module is a caller bug — fail loudly, not approximately."""
+    if t != 1:
+        raise NotImplementedError(
+            "paged decode caches serve single-token steps only (the "
+            "serving loop teacher-forces prompts token-by-token); got "
+            f"t={t}"
+        )
+    if mask is not None:
+        raise NotImplementedError(
+            "paged decode does not take a slot mask (paged rows are "
+            "never left-padded)"
+        )
+    if jnp.ndim(start) == 0:
+        raise ValueError(
+            "paged decode needs per-row [B] write indices (the serving "
+            "loop seeds them); got a scalar cache_index"
+        )
+
+
+def _paged_slot(page_table, start, page_size: int):
+    """Row-wise (page, offset) for logical slot ``start [B]``: the page
+    id gathered from the table, the offset within it. Dead/idle rows
+    (serve.py pins their ``start`` to 0 and their table row to 0) land
+    on the reserved garbage page."""
+    page = jnp.take_along_axis(
+        page_table, (start // page_size)[:, None], axis=1
+    )[:, 0]
+    return page, start % page_size
+
+
 def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
-                         start):
+                         start, page_table=None):
     """Append ``value [B, T, ...]`` at cache slot ``start`` (scalar, or
     per-row ``[B]`` for continuous batching).
 
@@ -79,10 +126,25 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
     past the end ``dynamic_update_slice`` clamps and outputs silently
     degrade (loop/generate.py enforces the bound statically up front).
     Returns the full cache buffer.
+
+    With ``page_table [B, n_pages]`` the buffer is a page POOL
+    ``[P, page_size, ...]`` (seeded by the serving loop); the one new
+    token scatters to ``(page_table[b, start // ps], start % ps)`` and
+    the CONTIGUOUS PER-ROW VIEW ``[B, n_pages·ps, ...]`` is returned —
+    gathered once per step, the same traffic class as attending the
+    cache at all (MLA's decode paths consume the full buffer anyway).
     """
     from jax import lax
 
     b = value.shape[0]
+    if page_table is not None:
+        ref = module.variable("cache", name, lambda: None)
+        pool = ref.value  # [P, ps, ...]
+        ps = pool.shape[1]
+        page, off = _paged_slot(page_table, start, ps)
+        ref.value = pool.at[page, off].set(value[:, 0])
+        g = ref.value[page_table]  # [B, n_pages, ps, ...]
+        return g.reshape((b, -1) + g.shape[3:])
     ref = module.variable(
         "cache", name,
         lambda: jnp.zeros((b, s_max) + value.shape[2:], value.dtype),
@@ -101,7 +163,7 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
 
 
 def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
-                                     s_max: int, start):
+                                     s_max: int, start, page_table=None):
     """Append ``value [B, T, H, D]`` at cache slot ``start`` of a
     HEADS-MAJOR cache buffer ``[B, H, s_max, D]``.
 
@@ -111,10 +173,25 @@ def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
     new tokens (T = 1 on decode steps), while a read-side transpose
     would copy all ``s_max`` slots every step. Same capacity contract
     as :func:`_decode_cache_append`.
+
+    With ``page_table [B, n_pages]`` the buffer is a heads-major page
+    POOL ``[P, H, page_size, D]``; the one new token scatters to its
+    row's (page, offset) and the POOL is returned — the flash-decode
+    kernel streams it directly through the gathering block index map
+    (no per-step relayout, exactly like the dense layout), and the
+    eager fallback gathers a contiguous view via
+    :func:`_gather_pages_heads_major`.
     """
     from jax import lax
 
     b, _, h, d = value.shape
+    if page_table is not None:
+        ref = module.variable("cache", name, lambda: None)
+        pool = ref.value  # [P, H, ps, D]
+        ps = pool.shape[2]
+        page, off = _paged_slot(page_table, start, ps)
+        ref.value = pool.at[page, :, off, :].set(value[:, 0])
+        return ref.value
     ref = module.variable(
         "cache", name,
         lambda: jnp.zeros((b, h, s_max, d), value.dtype),
@@ -129,6 +206,17 @@ def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
             lambda c, v, s: lax.dynamic_update_slice(c, v, (0, s, 0))
         )(ref.value, vt, start)
     return ref.value
+
+
+def _gather_pages_heads_major(pool, page_table):
+    """Contiguous per-row view of a heads-major page pool:
+    ``[P, H, ps, D]`` gathered through ``[B, n]`` →
+    ``[B, H, n·ps, D]`` — the eager fallback's (and the parity
+    oracle's) bridge back to the dense layout. Slot order is preserved,
+    so outputs are bitwise what the dense cache would produce."""
+    g = pool[page_table]  # [B, n, H, ps, D]
+    b, n, h, ps, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, n * ps, d)
 
 
 def _check_slot_mask(mask, s_max: int):
@@ -403,6 +491,49 @@ class GroupedQueryAttention(nn.Module):
         idx = _decode_cache_index(self)
         start = idx.value
         _decode_contract_checks(start, t, s_max)
+        page_table = _decode_page_table(self)
+        if page_table is not None:
+            # paged serving mode (loop/serve.py): one token per row per
+            # step through page pools; the flash path streams the pool
+            # through the gathering block index map, the eager oracle
+            # gathers a contiguous per-row view
+            _paged_write_checks(start, t, mask)
+            k_pool = _decode_cache_append_heads_major(
+                self, k.astype(self.dtype), "cached_key", s_max, start,
+                page_table=page_table,
+            )
+            v_pool = _decode_cache_append_heads_major(
+                self, v.astype(self.dtype), "cached_value", s_max, start,
+                page_table=page_table,
+            )
+            idx.value = start + t
+            rows = (self.num_heads // self.num_kv_heads) * t
+            if (
+                decode_attention_backend() == "pallas"
+                and rows <= MAX_DECODE_ROWS
+            ):
+                return flash_decode_attention(
+                    q, k_pool, v_pool,
+                    start=start,
+                    softmax_scale=self.softmax_scale,
+                    window_size=self.window_size,
+                    sinks=sinks,
+                    page_table=page_table,
+                )
+            keys = _gather_pages_heads_major(k_pool, page_table)
+            values = _gather_pages_heads_major(v_pool, page_table)
+            s_virt = keys.shape[2]
+            return eager_sdpa(
+                q,
+                jnp.transpose(keys, (0, 2, 1, 3)),
+                jnp.transpose(values, (0, 2, 1, 3)),
+                causal=False,
+                softmax_scale=self.softmax_scale,
+                sinks=sinks,
+                mask=_decode_slot_mask(
+                    start, t, s_virt, self.window_size, None
+                ),
+            )
         # heads-major [B, Hkv, s_max, D]: the flash-decode kernel's
         # streaming layout, written in place (no per-step cache relayout)
         keys = _decode_cache_append_heads_major(
@@ -632,18 +763,29 @@ class MultiHeadLatentAttention(nn.Module):
             idx = _decode_cache_index(self)
             start = idx.value
             _decode_contract_checks(start, t, s_max)
+            page_table = _decode_page_table(self)
+            if page_table is not None:
+                # paged serving mode: the latent/rope-key pools scatter
+                # the one new token and hand back the gathered per-row
+                # view — both decode paths below consume the full
+                # buffer anyway, so they run unchanged on it (masks are
+                # built over the gathered length)
+                _paged_write_checks(start, t, mask)
             cached_c = _decode_cache_append(
-                self, c_kv.astype(self.dtype), "cached_latent", s_max, start
+                self, c_kv.astype(self.dtype), "cached_latent", s_max,
+                start, page_table=page_table,
             )
             cached_r = _decode_cache_append(
                 self, k_rope.astype(self.dtype), "cached_rope_key", s_max,
-                start,
+                start, page_table=page_table,
             )
             idx.value = start + t
             from d9d_tpu.nn.decode_flags import in_continuation_chunk
 
             if t == 1 or in_continuation_chunk():
-                dec_mask = _decode_slot_mask(start, t, s_max, None, mask)
+                dec_mask = _decode_slot_mask(
+                    start, t, cached_c.shape[1], None, mask
+                )
                 if t == 1 and self.decode_absorbed:
                     # ABSORBED form (DeepSeek-V2 decode trick): fold
                     # W_up^K into the query and W_up^V into the output —

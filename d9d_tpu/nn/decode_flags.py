@@ -53,3 +53,56 @@ def map_cache_index(cache, fn):
         if path[-1] == "cache_index":
             flat[path] = fn(flat[path])
     return unflatten_dict(flat)
+
+
+# -- paged KV cache plumbing (docs/design/generation.md) ----------------
+
+# cache leaves that hold per-token sequence content and can be paged:
+# leaf name → axis that indexes cache slots in the DENSE layout. The
+# serving loop's paged mode converts exactly these into page pools
+# ([num_pages, ..., page_size, ...] with the slot axis shrunk to
+# page_size and a leading page axis) and seeds a sibling ``page_table``
+# leaf; attention modules detect that sibling and indirect through it.
+PAGED_CACHE_LEAVES = {
+    "cached_key": 2,       # GQA heads-major [B, Hkv, S, D]
+    "cached_value": 2,
+    "cached_latent": 1,    # MLA [B, S, r]
+    "cached_rope_key": 1,  # MLA [B, S, d_rope]
+}
+
+PAGE_TABLE_LEAF = "page_table"
+
+
+def map_page_table(cache, fn):
+    """Apply ``fn`` to every ``page_table`` leaf of a cache pytree (the
+    paged counterpart of :func:`map_cache_index`; the serving loop uses
+    it to push the host allocator's table mirror and to pin dead rows'
+    tables to the garbage page in-device). No-op on unpaged caches."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(cache)
+    hit = False
+    for path in list(flat):
+        if path[-1] == PAGE_TABLE_LEAF:
+            flat[path] = fn(flat[path])
+            hit = True
+    return unflatten_dict(flat) if hit else cache
+
+
+def zero_rows_skip_paged(cache, row_mask):
+    """Zero ``row_mask``-selected batch rows of every PER-ROW cache leaf,
+    skipping page pools and page tables (which have no batch-leading
+    dim — pools are shared across rows, and admitted rows' table rows
+    are written by the host allocator, not zeroed). The paged-mode
+    sibling of ``loop/serve.py``'s ``_zero_row``; trace-safe."""
+    import jax.numpy as jnp
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    skip = set(PAGED_CACHE_LEAVES) | {PAGE_TABLE_LEAF}
+    flat = flatten_dict(cache)
+    for path, x in list(flat.items()):
+        if path[-1] in skip:
+            continue
+        m = row_mask.reshape((-1,) + (1,) * (x.ndim - 1))
+        flat[path] = jnp.where(m, jnp.zeros_like(x), x)
+    return unflatten_dict(flat)

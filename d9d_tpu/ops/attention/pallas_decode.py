@@ -171,6 +171,69 @@ def _pad_to(n: int, m: int) -> int:
     return (-n) % m
 
 
+def _paged_decode_kernel(offs_ref, pt_ref, *refs, cfg: _DecodeConfig):
+    """Paged variant: identical math to :func:`_decode_kernel` — the
+    page table is consumed entirely by the kv BlockSpec index maps
+    (scalar-prefetch gather), so the kernel body only needs the write
+    offsets. Grid step ``ki`` is the row's LOGICAL block ki; its bytes
+    stream from pool page ``pt[bi, ki]``."""
+    del pt_ref  # consumed by the index maps
+    _decode_kernel(offs_ref, *refs, cfg=cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
+                       offsets, page_table):
+    """``q_rows [B, Hkv, rows_pad, D]`` vs page pools
+    ``k/v [P, Hkv, page_size, D]`` gathered through
+    ``page_table [B, n_pages]`` → same outputs as :func:`_decode_call`
+    on the contiguous equivalent. The kv-block index map generalizes
+    from ``block = ki`` to ``block = page_table[bi, ki]`` — the paging
+    claim in one line: the kernel needs a different INDEX, not a
+    different algorithm. ``block_kv == page_size`` by construction."""
+    b, hkv, rp, d = q_rows.shape
+    n_pages = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # offsets, page_table
+        grid=(b, hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, rp, d),
+                         lambda bi, hi, ki, offs, pt: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0)),
+            pl.BlockSpec((1, 1, cfg.block_kv, d),
+                         lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, rp, d),
+                         lambda bi, hi, ki, offs, pt: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, rp, 1),
+                         lambda bi, hi, ki, offs, pt: (bi, hi, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rp, LANES), jnp.float32),
+            pltpu.VMEM((rp, LANES), jnp.float32),
+            pltpu.VMEM((rp, d), jnp.float32),
+        ],
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, cfg=cfg),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, rp, d), q_rows.dtype),
+            jax.ShapeDtypeStruct((b, hkv, rp, 1), jnp.float32),
+        ],
+        compiler_params=(
+            None if cfg.interpret else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        ),
+        interpret=cfg.interpret,
+    )(offsets, page_table, q_rows, k_pool, v_pool)
+    return o, lse[..., 0]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg",),
@@ -236,6 +299,7 @@ def flash_decode_attention(
     window_size: int | None = None,
     sinks: Array | None = None,
     kv_valid: Array | None = None,
+    page_table: Array | None = None,
     block_kv: int = 512,
     interpret: bool | None = None,
 ) -> Array:
@@ -260,6 +324,16 @@ def flash_decode_attention(
     sentinel yields a uniform mean-of-V. Module callers never hit this
     case (a row's just-written key is always valid), but public callers
     passing custom validity get the guarded-softmax behavior.
+
+    PAGED mode (``page_table [B, n_pages]`` set): ``k/v`` are page
+    POOLS ``[P, Hkv, page_size, D]`` and row ``b``'s logical kv block
+    ``ki`` streams from pool page ``page_table[b, ki]`` — the block
+    index map gathers page ids instead of assuming ``page == ki``
+    (``block_kv`` is forced to the page size). Everything else —
+    per-row ``start``, whole-block skip, windows, sinks, the online
+    softmax — is unchanged, which is exactly why paging is an indexing
+    generalization of this kernel rather than a new one. ``kv_valid``
+    does not compose with paging (the serving loop never passes it).
     """
     b, t, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
@@ -271,39 +345,68 @@ def flash_decode_attention(
 
     rows = g * t
     rp = rows + _pad_to(rows, 8)
-    bkv = min(block_kv, s + _pad_to(s, LANES))
-    s_pad = s + _pad_to(s, bkv)
 
-    cfg = _DecodeConfig(
-        scale=softmax_scale if softmax_scale is not None else d**-0.5,
-        window=window_size,
-        t=t,
-        rows=rows,
-        rows_pad=rp,
-        s_len=s,
-        block_kv=bkv,
-        has_valid=kv_valid is not None,
-        interpret=interpret,
-    )
-
-    # [B,T,Hq,D] → [B,Hkv,g·T,D], row r = ig·T + i
+    # [B,T,Hq,D] → [B,Hkv,g·T,D], row r = ig·T + i (shared by both the
+    # contiguous and paged calls, as are the epilogue slices, the sink
+    # fold and the output reshape below — the two paths differ ONLY in
+    # how kv blocks are indexed)
     q_rows = (
         q.transpose(0, 2, 1, 3)
         .reshape(b, hkv, g * t, d)
     )
     if rp != rows:
         q_rows = jnp.pad(q_rows, ((0, 0), (0, 0), (0, rp - rows), (0, 0)))
-    pad_s = s_pad - s
-    kp = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else k_cache
-    vp = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else v_cache
-    validp = None
-    if kv_valid is not None:
-        validp = jnp.pad(kv_valid, ((0, 0), (0, pad_s))) if pad_s else kv_valid
-
     offsets = jnp.broadcast_to(
         jnp.asarray(start, jnp.int32).reshape(-1), (b,)
     )
-    o, lse = _decode_call(cfg, q_rows, kp, vp, validp, offsets)
+
+    if page_table is not None:
+        if kv_valid is not None:
+            raise NotImplementedError(
+                "paged decode does not take kv_valid (the serving loop's "
+                "paged rows are never left-padded)"
+            )
+        page_size = k_cache.shape[2]
+        n_pages = page_table.shape[1]
+        cfg = _DecodeConfig(
+            scale=softmax_scale if softmax_scale is not None else d**-0.5,
+            window=window_size,
+            t=t,
+            rows=rows,
+            rows_pad=rp,
+            s_len=n_pages * page_size,  # every gathered slot addressable
+            block_kv=page_size,
+            has_valid=False,
+            interpret=interpret,
+        )
+        o, lse = _paged_decode_call(
+            cfg, q_rows, k_cache, v_cache, offsets,
+            page_table.astype(jnp.int32),
+        )
+    else:
+        bkv = min(block_kv, s + _pad_to(s, LANES))
+        s_pad = s + _pad_to(s, bkv)
+
+        cfg = _DecodeConfig(
+            scale=softmax_scale if softmax_scale is not None else d**-0.5,
+            window=window_size,
+            t=t,
+            rows=rows,
+            rows_pad=rp,
+            s_len=s,
+            block_kv=bkv,
+            has_valid=kv_valid is not None,
+            interpret=interpret,
+        )
+
+        pad_s = s_pad - s
+        kp = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else k_cache
+        vp = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad_s), (0, 0))) if pad_s else v_cache
+        validp = None
+        if kv_valid is not None:
+            validp = jnp.pad(kv_valid, ((0, 0), (0, pad_s))) if pad_s else kv_valid
+
+        o, lse = _decode_call(cfg, q_rows, kp, vp, validp, offsets)
 
     o = o[:, :, :rows]
     lse = lse[:, :, :rows]

@@ -44,8 +44,13 @@ item 4):
   requests are resubmitted to survivors as *continuation prompts*
   (original prompt + tokens already emitted), which the serving loop's
   teacher-forced prompt consumption replays bit-identically to an
-  uninterrupted decode under greedy sampling. ``grow`` cold-starts a
-  replacement replica from the latest published weights.
+  uninterrupted decode under greedy sampling. A PAGED replica
+  (``page_size`` set — docs/design/generation.md) needs nothing extra:
+  a continuation is an ordinary fresh submit on the survivor, so it
+  allocates pages like any request and may even prefix-hit the
+  original prompt's cached pages there; the dead replica's pool dies
+  with its device state. ``grow`` cold-starts a replacement replica
+  from the latest published weights.
 
 Import note: like :mod:`~d9d_tpu.resilience.chaos`, anything that
 touches the loop/serve surface is imported lazily — the module itself
@@ -493,6 +498,14 @@ class ServingFleet:
             "serve/fleet_tokens_per_s":
                 lambda: f._fleet_rate() if (f := fleet_ref()) is not None
                 else float("nan"),
+            # paged-KV rollups (docs/design/generation.md): fleet-wide
+            # page-pool headroom; NaN while no live replica is paged
+            "serve/fleet_kv_pages_free":
+                lambda: f._kv_pages("pages_free")
+                if (f := fleet_ref()) is not None else float("nan"),
+            "serve/fleet_kv_pages_in_use":
+                lambda: f._kv_pages("pages_in_use")
+                if (f := fleet_ref()) is not None else float("nan"),
         }
         for name, fn in self._gauge_fns.items():
             self._tele.gauge_fn(name, fn)
@@ -544,6 +557,18 @@ class ServingFleet:
         return float(sum(
             self._replicas[i]._live_rate() for i in self._live
         ))
+
+    def _kv_pages(self, attr: str) -> float:
+        """Sum a paged-KV pool counter over live PAGED replicas (a
+        mixed or unpaged fleet reports NaN rather than a misleading 0
+        — absence of paging is not an empty pool)."""
+        total, any_paged = 0.0, False
+        for i in self._live:
+            kv = getattr(self._replicas[i], "_kv", None)
+            if kv is not None:
+                any_paged = True
+                total += float(getattr(kv, attr))
+        return total if any_paged else float("nan")
 
     @property
     def ready(self) -> bool:

@@ -1,0 +1,179 @@
+"""Paged-KV host bookkeeping (loop/kv_paging.py): free-list/refcount
+correctness under interleaved admit/retire, prefix-cache hit semantics
+(readiness gating, the ≥1-fed-token cap, chain hashing), deferred
+zombie release, and LRU eviction order — all pure host logic, no model,
+no device. The serving-loop integration is pinned by
+tests/loop/test_serve_paged.py; the invariants here are the ones that
+integration relies on."""
+
+import pytest
+
+from d9d_tpu.loop.kv_paging import PagedKVAllocator
+
+
+def _alloc(**kw):
+    kw.setdefault("num_pages", 9)       # 8 allocatable + garbage
+    kw.setdefault("page_size", 4)
+    kw.setdefault("rows", 2)
+    kw.setdefault("max_pages_per_row", 4)
+    return PagedKVAllocator(**kw)
+
+
+def test_admit_release_roundtrip_and_invariants():
+    kv = _alloc()
+    a = kv.admit(0, 0, [1, 2, 3, 4, 5], 10)  # 3 pages
+    assert a is not None and a.start_pos == 0 and a.n_shared == 0
+    assert kv.pages_in_use == 3 and kv.pages_free == 5
+    # table mirror holds exactly the run; page 0 never appears
+    assert [int(x) for x in kv.table[0] if x] == list(a.pages)
+    kv.check_invariants()
+    b = kv.admit(1, 1, [9, 9], 8)  # 2 pages
+    assert b is not None
+    kv.check_invariants()
+    kv.release(0)
+    # row 0's prompt has only ONE full page (len 5 // ps 4) and it was
+    # registered as a (not yet ready) prefix entry: its page stays held
+    assert kv.pages_in_use == 2 + 1
+    kv.release(1)
+    kv.check_invariants()
+    assert (kv.table == 0).all()
+
+
+def test_prefix_hit_requires_ready_and_caps_last_token():
+    kv = _alloc(rows=3, max_pages_per_row=4)
+    prompt = list(range(9))  # 2 full pages + 1 tail token
+    a = kv.admit(0, 0, prompt, 12)
+    assert a.hit_tokens == 0
+    # not ready yet (owner still filling): a same-prompt admit misses
+    b = kv.admit(1, 1, prompt, 12)
+    assert b.hit_tokens == 0 and kv.prefix_misses == 2
+    kv.release(1)
+    kv.mark_filled(0)
+    c = kv.admit(1, 2, prompt, 12)
+    assert c.hit_tokens == 8 and c.n_shared == 2
+    # shared pages are row 0's own first two pages, mapped COW
+    assert c.pages[:2] == a.pages[:2] and c.pages[2] not in a.pages
+    assert kv.prefix_hits == 1 and kv.prefix_hit_tokens == 8
+    kv.check_invariants()
+    # page-aligned prompt: the cap keeps the LAST token out of the hit
+    # (its logits are needed to sample the first output token)
+    kv2 = _alloc()
+    aligned = list(range(8))  # exactly 2 pages
+    a2 = kv2.admit(0, 0, aligned, 10)
+    kv2.mark_filled(0)
+    kv2.release(0)
+    b2 = kv2.admit(1, 1, aligned, 10)
+    assert b2.hit_tokens == 4  # one page, not two
+    kv2.check_invariants()
+
+
+def test_prefix_divergence_misses_past_shared_blocks():
+    kv = _alloc(num_pages=17, rows=2, max_pages_per_row=6)
+    base = list(range(12))  # 3 full pages
+    kv.admit(0, 0, base + [99], 16)
+    kv.mark_filled(0)
+    kv.release(0)
+    # same first 2 blocks, diverges in the 3rd
+    fork = base[:8] + [7, 7, 7, 7, 50]
+    b = kv.admit(1, 1, fork, 16)
+    assert b.hit_tokens == 8  # shares exactly the common prefix pages
+    kv.check_invariants()
+
+
+def test_abort_filling_drops_unready_entries():
+    kv = _alloc()
+    a = kv.admit(0, 0, list(range(8)), 10)
+    kv.abort_filling(0)  # failed mid-prompt: entries must not survive
+    kv.release(0)
+    assert kv.pages_in_use == 0
+    b = kv.admit(1, 1, list(range(8)), 10)
+    assert b.hit_tokens == 0  # nothing cached from the aborted fill
+    kv.check_invariants()
+    del a, b
+
+
+def test_admission_bounded_by_free_pages_then_lru_evicts():
+    kv = _alloc(num_pages=7, rows=2, max_pages_per_row=6)  # 6 allocatable
+    a = kv.admit(0, 0, list(range(8)), 16)  # 4 pages, 2 registered
+    kv.mark_filled(0)
+    b = kv.admit(1, 1, [5], 12)             # 3 pages > 2 free
+    assert b is None, "admission must wait for pages, not overcommit"
+    kv.release(0)  # row refs drop; 2 pages still pinned by the cache
+    assert kv.pages_free == 4
+    # now the allocator must LRU-evict cached prefix pages to make room
+    c = kv.admit(1, 1, [5] * 9, 24)         # needs 6 pages
+    assert c is not None and kv.pages_free == 0
+    assert kv.prefix_hits == 0  # the [5]*9 prompt shares nothing
+    kv.check_invariants()
+
+
+def test_lru_eviction_prefers_oldest_and_deepest():
+    kv = _alloc(num_pages=9, rows=4, max_pages_per_row=6)
+    # two cached chains: A (2 pages, older), B (2 pages, newer)
+    kv.admit(0, 0, list(range(8)) + [1], 9)
+    kv.mark_filled(0)
+    kv.release(0)
+    kv.admit(1, 1, [30, 31, 32, 33, 34, 35, 36, 37, 1], 9)
+    kv.mark_filled(1)
+    kv.release(1)
+    assert kv.pages_in_use == 4 and kv.pages_free == 4
+    # need 6 pages → evict 2; chain A is LRU, its deepest entry first
+    kv.admit(2, 2, [40] * 9, 24)
+    kv.check_invariants()
+    kv.mark_filled(2)
+    kv.release(2)
+    # chain B survived; a B-prefix admit still hits
+    hit = kv.admit(3, 3, [30, 31, 32, 33, 34, 35, 36, 37, 2], 9)
+    assert hit is not None and hit.hit_tokens == 8
+    kv.check_invariants()
+
+
+def test_deferred_release_holds_pages_until_flush():
+    kv = _alloc(enable_prefix_cache=False)
+    a = kv.admit(0, 0, [1, 2, 3], 8)  # 2 pages
+    kv.defer_release(0)
+    # table row zeroed immediately, pages still held for the zombie row
+    assert (kv.table[0] == 0).all() and kv.pages_in_use == 2
+    kv.check_invariants()
+    assert kv.flush_deferred() is True
+    assert kv.pages_in_use == 0
+    assert kv.flush_deferred() is False
+    kv.check_invariants()
+    del a
+
+
+def test_interleaved_retire_admit_refcounts_stay_exact():
+    """The satellite pin: a churny interleaving of admits, hits,
+    retires, deferred frees and evictions never drifts a refcount."""
+    kv = _alloc(num_pages=13, rows=3, max_pages_per_row=4)
+    shared = list(range(8))
+    rid = 0
+    for round_idx in range(12):
+        for row in range(3):
+            prompt = shared + [round_idx % 3, row]
+            a = kv.admit(row, rid, prompt, 12)
+            if a is None:
+                continue
+            kv.mark_filled(rid)
+            rid += 1
+            kv.check_invariants()
+        # retire in a rotating pattern, one deferred
+        kv.defer_release(round_idx % 3)
+        kv.release((round_idx + 1) % 3)
+        kv.release((round_idx + 2) % 3)
+        kv.check_invariants()
+        kv.flush_deferred()
+        kv.check_invariants()
+    # steady state: the shared prefix is cached and hit every round
+    assert kv.prefix_hits > 0
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="num_pages"):
+        _alloc(num_pages=1)
+    with pytest.raises(ValueError, match="page_size"):
+        _alloc(page_size=0)
+    kv = _alloc()
+    assert kv.fits_ever(32) and not kv.fits_ever(33)
+    with pytest.raises(ValueError, match="max_pages_per_row"):
+        kv.admit(0, 0, [1], 32)  # 8 pages > 4 per row

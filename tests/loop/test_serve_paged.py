@@ -1,0 +1,304 @@
+"""Paged KV cache + prefix cache in the serving loop
+(loop/serve.py page_size mode, loop/kv_paging.py, docs/design/
+generation.md): greedy paged serving must be TOKEN-IDENTICAL to the
+contiguous layout across K — including mid-chunk finishes and
+admissions — a prefix-cache hit must decode exactly like a cold
+prefill, admission must be bounded by free pages (waiting, not
+rejecting), deadline evictions must recycle pages safely, and the
+pool/hit telemetry must be live."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.e2e  # whole-model serving loops (slow tier)
+
+from tests.loop.test_serve import _dense, _oracle, _params, _prompts
+
+from d9d_tpu.loop.serve import ContinuousBatcher
+
+PAGE = 8  # decode_max_length=24 → 3 pages per row
+
+
+def _batcher(model, params, *, paged, chunk=4, batch_size=2, **kw):
+    if paged:
+        kw.setdefault("page_size", PAGE)
+    return ContinuousBatcher(
+        model, params, batch_size=batch_size, chunk_size=chunk, **kw
+    )
+
+
+def _staggered_run(model, params, prompts, *, n, paged, chunk, **kw):
+    """Admissions landing between chunk boundaries + budgets that end
+    mid-chunk: the shapes the token-identity pin must survive."""
+    b = _batcher(model, params, paged=paged, chunk=chunk, **kw)
+    rids = [b.submit(prompts[0], max_new_tokens=n)]
+    if chunk is None:
+        b.step()
+    else:
+        b.step_chunk()
+    rids += [b.submit(p, max_new_tokens=n) for p in prompts[1:]]
+    outputs = b.drain()
+    if paged:
+        b._kv.check_invariants()
+        assert b._kv.pages_in_use == (
+            len(b._kv._entries)  # only cached prefix pages stay mapped
+        )
+    return [outputs[r] for r in rids], b
+
+
+@pytest.mark.parametrize(
+    "k",
+    [
+        pytest.param(1, marks=pytest.mark.slow),
+        4,
+        pytest.param(16, marks=pytest.mark.slow),
+    ],
+)
+def test_paged_token_identical_to_contiguous(k):
+    """The tentpole pin: paged vs contiguous, K ∈ {1, 4, 16}, n=6 (not
+    a K multiple → finishes land mid-chunk), staggered admission."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(10, 4)
+    want, _ = _staggered_run(model, params, prompts, n=6, paged=False,
+                             chunk=k)
+    got, pb = _staggered_run(model, params, prompts, n=6, paged=True,
+                             chunk=k)
+    assert got == want
+    for out, prompt in zip(got, prompts):
+        assert out == _oracle(model, params, prompt, 6)
+    del pb
+
+
+@pytest.mark.slow  # second compile of the legacy per-token step
+def test_paged_legacy_path_token_identical():
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(11, 3)
+    want, _ = _staggered_run(model, params, prompts, n=5, paged=False,
+                             chunk=None)
+    got, _ = _staggered_run(model, params, prompts, n=5, paged=True,
+                            chunk=None)
+    assert got == want
+
+
+def test_prefix_hit_token_identical_and_counted():
+    """A shared prompt's second serving must hit the prefix cache
+    (skipping its full pages) and still emit EXACTLY the cold-prefill
+    tokens; the hit/miss counters and page-sharing refcounts agree."""
+    model = _dense()
+    params = _params(model)
+    prompt = _prompts(42, 1, lo=18, hi=19)[0]  # 2 full pages + tail
+    oracle = _oracle(model, params, prompt, 5)
+    b = _batcher(model, params, paged=True, num_pages=9)
+    r1 = b.submit(prompt, max_new_tokens=5)
+    cold = b.drain()[r1]
+    assert cold == oracle
+    assert b._kv.prefix_hits == 0 and b._kv.prefix_misses == 1
+    # second serving: 2 pages (16 tokens) come from the cache
+    r2 = b.submit(prompt, max_new_tokens=5)
+    hit = b.drain()[r2]
+    assert hit == oracle
+    assert b._kv.prefix_hits == 1 and b._kv.prefix_hit_tokens == 2 * PAGE
+    assert b.prefix_hit_rate() == 0.5
+    b._kv.check_invariants()
+    # BOTH rows sharing at once: two fresh hits decode concurrently
+    r3 = b.submit(prompt, max_new_tokens=5)
+    r4 = b.submit(prompt, max_new_tokens=5)
+    out = b.drain()
+    assert out[r3] == oracle and out[r4] == oracle
+    assert b._kv.prefix_hits == 3
+    b._kv.check_invariants()
+
+
+def test_paged_admission_bounded_by_free_pages():
+    """A pool smaller than the slots' worst case: admission waits for
+    pages (head-of-line, no rejection, no corruption) and both
+    requests still decode exactly."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(12, 2, lo=4, hi=6)
+    # each request needs ceil((len(p)+8-1)/8) = 2 pages; pool holds 2
+    # allocatable → strictly one request resident at a time
+    b = _batcher(model, params, paged=True, num_pages=3,
+                 prefix_cache=False)
+    r1 = b.submit(prompts[0], max_new_tokens=8)
+    r2 = b.submit(prompts[1], max_new_tokens=8)
+    b.step_chunk()
+    # only one row could be mapped: the other is still queued
+    assert sum(1 for s in b._slots if s.rid >= 0) == 1
+    assert b._kv.pages_free == 0
+    out = b.drain()
+    assert out[r1] == _oracle(model, params, prompts[0], 8)
+    assert out[r2] == _oracle(model, params, prompts[1], 8)
+    b._kv.check_invariants()
+    # a request that could NEVER fit fails fast at submit
+    with pytest.raises(ValueError, match="could never be admitted"):
+        b.submit(list(range(10)), max_new_tokens=12)
+
+
+def test_paged_deadline_eviction_recycles_pages_exactly():
+    """A running row expiring at a boundary frees its pages; the next
+    request reuses them and decodes exactly (the zeroed table row was
+    pushed before its first chunk, so the zombie never scribbles on
+    the new owner)."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(13, 2, lo=3, hi=5)
+    b = _batcher(model, params, paged=True, batch_size=1,
+                 prefix_cache=False)
+    doomed = b.submit(prompts[0], max_new_tokens=18, deadline_s=0.05)
+    b.step_chunk()
+    time.sleep(0.1)
+    b.step_chunk()  # boundary: expire + release
+    assert b.failed[doomed] == "deadline"
+    assert b._kv.pages_in_use == 0
+    b._kv.check_invariants()
+    fresh = b.submit(prompts[1], max_new_tokens=6)
+    assert b.drain()[fresh] == _oracle(model, params, prompts[1], 6)
+    b._kv.check_invariants()
+
+
+def test_paged_pallas_backend_matches_eager(monkeypatch):
+    """The gathering block-index-map kernel (interpret mode on CPU)
+    must serve the same tokens as the eager gathered-view path."""
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(14, 3)
+
+    def run():
+        b = _batcher(model, params, paged=True)
+        rids = [b.submit(p, max_new_tokens=5) for p in prompts]
+        return [b.drain()[r] for r in rids]
+
+    monkeypatch.setenv("D9D_TPU_DECODE_ATTN", "eager")
+    want = run()
+    monkeypatch.setenv("D9D_TPU_DECODE_ATTN", "pallas")
+    got = run()
+    assert got == want
+
+
+def test_paged_gauges_and_structural_counts():
+    """The page-pool gauges are live at boundaries, the HBM accounting
+    shows paged < contiguous-static, and paging adds ZERO dispatches/
+    readbacks vs the contiguous batcher on the same schedule (the
+    bench-gate contract, pinned in-tree)."""
+    from d9d_tpu.telemetry import Telemetry
+
+    model = _dense()
+    params = _params(model)
+    prompts = _prompts(15, 3)
+    tele = Telemetry()
+    contig = ContinuousBatcher(model, params, batch_size=2, chunk_size=4)
+    paged = ContinuousBatcher(
+        model, params, batch_size=2, chunk_size=4, page_size=PAGE,
+        prefix_cache=False, telemetry=tele,
+    )
+    for b in (contig, paged):
+        for p in prompts:
+            b.submit(p, max_new_tokens=6)
+        b.drain()
+    assert paged.outputs == contig.outputs
+    assert paged.stats.host_dispatches == contig.stats.host_dispatches
+    assert paged.stats.readbacks == contig.stats.readbacks
+    # deterministic accounting: fewer resident KV bytes per request
+    assert paged.hbm_bytes_per_request() < contig.hbm_bytes_per_request()
+    # gauges landed in the injected hub (drain left the pool empty)
+    assert tele.registry.gauge("serve/kv_pages_in_use").value == 0
+    assert (
+        tele.registry.gauge("serve/kv_pages_free").value
+        == paged._kv.num_pages - 1
+    )
+
+
+@pytest.mark.slow  # MoE hybrid compiles are the heaviest in this file
+def test_paged_hybrid_gdn_token_identical_and_prefix_auto_disabled():
+    """A hybrid model (GDN recurrent state + conv tail) pages its
+    attention KV while the unpageable per-row state stays per-row; the
+    prefix cache auto-disables (that state summarizes the whole prefix)
+    and serving stays token-identical to the contiguous layout."""
+    from d9d_tpu.models.qwen3 import Qwen3MoeCausalLM, Qwen3MoeConfig
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+
+    cfg = Qwen3MoeConfig(
+        vocab_ranges=(("default", 64),), hidden_size=32, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=8, moe_intermediate_size=32,
+        num_experts=4, num_experts_per_tok=2, remat=False,
+        linear_attention_layers=(0,),
+    )
+    model = Qwen3MoeCausalLM(
+        config=cfg, sdpa=eager_sdpa, dtype=jnp.float32,
+        decode_max_length=24,
+    )
+    z = jnp.zeros((2, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+    params = model.clone(decode_max_length=0).init(
+        jax.random.PRNGKey(0), z, pos, z
+    )["params"]
+    prompts = _prompts(3, 3)
+    want, _ = _staggered_run(model, params, prompts, n=5, paged=False,
+                             chunk=4)
+    got, pb = _staggered_run(model, params, prompts, n=5, paged=True,
+                             chunk=4)
+    assert got == want
+    assert pb._kv.prefix_cache_enabled is False
+    assert pb._unpageable_leaves == ["conv_tail", "delta_state"]
+    with pytest.raises(ValueError, match="unsound"):
+        ContinuousBatcher(model, params, batch_size=2, chunk_size=4,
+                          page_size=PAGE, prefix_cache=True)
+
+
+def test_weight_publish_invalidates_prefix_cache():
+    """Cached prefix KV is weights-dependent: after install_weights a
+    same-prompt request must MISS (re-prefill under the new weights)
+    and emit exactly the new weights' oracle tokens — a stale hit
+    would silently decode the prefix under the old generation."""
+    model = _dense()
+    params = _params(model)
+    params2 = jax.tree.map(lambda x: x * 1.03, params)
+    prompt = _prompts(44, 1, lo=18, hi=19)[0]  # 2 full pages + tail
+    b = _batcher(model, params, paged=True)
+    r1 = b.submit(prompt, max_new_tokens=5)
+    assert b.drain()[r1] == _oracle(model, params, prompt, 5)
+    assert b._kv._entries  # the prefix is cached (old weights)
+    b.install_weights(params2)
+    r2 = b.submit(prompt, max_new_tokens=5)
+    out = b.drain()[r2]
+    assert b._kv.prefix_hits == 0  # invalidated: no stale hit
+    assert out == _oracle(model, params2, prompt, 5)
+    b._kv.check_invariants()
+    # and the prompt re-cached under the new generation: now it hits
+    r3 = b.submit(prompt, max_new_tokens=5)
+    assert b.drain()[r3] == out
+    assert b._kv.prefix_hits == 1
+
+
+def test_paged_deferred_release_flushes_at_next_boundary():
+    """White-box: a host-side expiry while a chunk is IN FLIGHT defers
+    the page free (the device twin may still write); the next clean
+    admit boundary flushes it and pushes the zeroed table."""
+    from tests.resilience.conftest import ToyDecodeLM, toy_expected
+
+    model = ToyDecodeLM()
+    z = jnp.zeros((2, 1), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), z, z, z).get("params", {})
+    b = ContinuousBatcher(model, params, batch_size=2, chunk_size=4,
+                          page_size=4, num_pages=9)
+    doomed = b.submit([3], max_new_tokens=12, deadline_s=0.01)
+    b.step_chunk()
+    b._dispatch_chunk(b._k, admit=False)  # leave one chunk in flight
+    time.sleep(0.05)
+    b._expire_running(time.perf_counter())
+    assert b.failed[doomed] == "deadline"
+    assert b._kv._deferred and b._kv.pages_in_use > 0  # held for zombie
+    b._kv.check_invariants()
+    b.drain()  # harvests the in-flight chunk
+    fresh = b.submit([7], max_new_tokens=3)  # admit boundary: flush
+    out = b.drain()
+    assert out[fresh] == toy_expected([7], 3)
+    assert not b._kv._deferred and b._kv.pages_in_use == 0
+    b._kv.check_invariants()

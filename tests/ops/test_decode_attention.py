@@ -122,6 +122,78 @@ def test_fully_masked_rows_emit_zeros():
     )
 
 
+def _paginate(k, v, page_size, seed=0):
+    """Scatter a contiguous [B, Hkv, S, D] cache into a permuted page
+    pool + page table whose gathered view equals the original — the
+    paged call must then match the contiguous call exactly."""
+    rng = np.random.RandomState(seed)
+    b, hkv, s, d = k.shape
+    n_pages = s // page_size
+    pool_n = b * n_pages + 1  # page 0 = reserved garbage
+    pool_k = np.zeros((pool_n, hkv, page_size, d), np.float32)
+    pool_v = np.zeros((pool_n, hkv, page_size, d), np.float32)
+    perm = rng.permutation(np.arange(1, pool_n))
+    pt = np.zeros((b, n_pages), np.int32)
+    i = 0
+    for bi in range(b):
+        for pi in range(n_pages):
+            page = perm[i]
+            i += 1
+            pt[bi, pi] = page
+            sl = slice(pi * page_size, (pi + 1) * page_size)
+            pool_k[page] = np.asarray(k)[bi, :, sl]
+            pool_v[page] = np.asarray(v)[bi, :, sl]
+    return jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(pt)
+
+
+@pytest.mark.parametrize("window", [None, 7])
+def test_paged_parity_per_row_start(window):
+    """The paged block-index gather (scalar-prefetch index map) must
+    reproduce the contiguous kernel bit-for-bit in semantics: same
+    per-row starts, same windows, pages deliberately scattered through
+    the pool in permuted order."""
+    b, t, hq, hkv, d, s, ps = 3, 1, 4, 2, 16, 64, 16
+    q, k, v = _mk(b, t, hq, hkv, d, s, seed=21)
+    starts = jnp.asarray([0, 17, 63], jnp.int32)
+    want = flash_decode_attention(
+        q, k, v, start=starts, window_size=window, interpret=True,
+        block_kv=ps,
+    )
+    pool_k, pool_v, pt = _paginate(k, v, ps, seed=4)
+    got = flash_decode_attention(
+        q, pool_k, pool_v, start=starts, window_size=window,
+        page_table=pt, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_parity_sinks_and_gqa():
+    b, t, hq, hkv, d, s, ps = 2, 1, 8, 2, 32, 96, 16  # g=4
+    q, k, v = _mk(b, t, hq, hkv, d, s, seed=23)
+    rng = np.random.RandomState(5)
+    sinks = jnp.asarray(rng.randn(hq), jnp.float32)
+    starts = jnp.asarray([40, 95], jnp.int32)
+    want = flash_decode_attention(
+        q, k, v, start=starts, sinks=sinks, interpret=True, block_kv=ps
+    )
+    pool_k, pool_v, pt = _paginate(k, v, ps, seed=6)
+    got = flash_decode_attention(
+        q, pool_k, pool_v, start=starts, sinks=sinks, page_table=pt,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    with pytest.raises(NotImplementedError, match="kv_valid"):
+        flash_decode_attention(
+            q, pool_k, pool_v, start=starts, page_table=pt,
+            kv_valid=jnp.ones((b, pt.shape[1] * ps), jnp.int32),
+            interpret=True,
+        )
+
+
 def test_parity_under_jit_traced_start():
     """start is traced in real decode loops (lax.scan carry)."""
     b, t, hq, hkv, d, s = 1, 1, 4, 4, 16, 64

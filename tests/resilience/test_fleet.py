@@ -125,6 +125,28 @@ def test_kill_mid_drain_recovers_unfinished_as_continuations():
     assert not fleet._reqs and not fleet._by_replica
 
 
+def test_kill_mid_drain_recovers_paged_requests_token_identically():
+    """The chaos leg for paged KV (ISSUE 11): replicas running the
+    paged cache + page allocator, one killed mid-drain — its requests
+    resume on the survivor as continuation prompts, token-identical,
+    and the survivor's page bookkeeping stays exact."""
+    fleet = _fleet(2, page_size=4, num_pages=17)
+    prompts = [[3], [7], [12], [1]]
+    frids = [fleet.submit(p, max_new_tokens=10) for p in prompts]
+    fleet.step()
+    shrink_at_step(fleet, 0, step=2)
+    kill_replica_mid_drain(fleet, 0, after_chunks=1)
+    out = fleet.drain()
+    assert 0 in fleet.dead
+    for frid, p in zip(frids, prompts):
+        assert out[frid] == toy_expected(p, 10), frid
+    survivor = fleet._replicas[1]
+    survivor._kv.check_invariants()
+    assert survivor._kv.pages_in_use == 0  # everything retired cleanly
+    # the fleet-level page rollup reflects the one live paged replica
+    assert fleet._kv_pages("pages_free") == survivor._kv.pages_free
+
+
 def test_submit_validation_error_leaves_no_ghost():
     """A replica-side validation error must not strand an unplaceable
     fleet request that wedges every later drain()."""

@@ -31,6 +31,61 @@ def test_bounded_queue_rejects_with_backpressure(toy_batcher_factory):
     assert not b.failed
 
 
+def test_full_queue_expires_running_rows_before_rejecting(
+    toy_batcher_factory,
+):
+    """ISSUE 11 satellite fix (running-side mirror of the PR 5
+    queued-side fix): with the queue at max_queue, a deadline-expired
+    RUNNING row frees a slot this boundary — the queue head will admit
+    into it, so the submit must be accepted, not rejected."""
+    b = toy_batcher_factory(max_queue=1, batch_size=1)
+    doomed = b.submit([3], max_new_tokens=30, deadline_s=0.05)
+    b.step_chunk()  # admitted into the only slot
+    queued = b.submit([5], max_new_tokens=3)  # queue now at max_queue
+    time.sleep(0.1)
+    late = b.submit([9], max_new_tokens=3)  # pre-fix: QueueFullError
+    assert b.failed[doomed] == "deadline"
+    assert b.stats.rejected == 0
+    out = b.drain()
+    assert out[queued] == toy_expected([5], 3)
+    assert out[late] == toy_expected([9], 3)
+    # with nothing expirable the bounded-queue contract is unchanged
+    r = b.submit([4], max_new_tokens=30)
+    b.step_chunk()
+    b.submit([6], max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        b.submit([8], max_new_tokens=2)
+    assert b.stats.rejected == 1
+    del r
+
+
+def test_full_queue_expiry_credit_is_page_bounded_when_paged(
+    toy_batcher_factory,
+):
+    """Paged admission is bounded by pages, not slots: a freed slot
+    only counts as capacity for the full-queue check if the queue head
+    can actually map onto free pages — otherwise the bounded-queue
+    contract would be violated with the head still blocked."""
+    b = toy_batcher_factory(
+        max_queue=1, batch_size=2, page_size=4, num_pages=7,
+    )
+    # a long-lived row pinning 3 pages + a doomed row holding 3 more
+    alive = b.submit([3], max_new_tokens=12)
+    b.step_chunk()
+    doomed = b.submit([4], max_new_tokens=12, deadline_s=0.05)
+    b.step_chunk()
+    # head of queue needs 4 pages; the expiry can only ever free 3
+    head = b.submit([5], max_new_tokens=16)
+    time.sleep(0.1)
+    with pytest.raises(QueueFullError):
+        b.submit([9], max_new_tokens=2)
+    assert b.failed[doomed] == "deadline"  # the expiry itself happened
+    out = b.drain()
+    assert out[alive] == toy_expected([3], 12)
+    assert out[head] == toy_expected([5], 16)
+    b._kv.check_invariants()
+
+
 def test_queued_request_past_deadline_expires_cleanly(toy_batcher_factory):
     b = toy_batcher_factory()
     ra = b.submit([3], max_new_tokens=30)

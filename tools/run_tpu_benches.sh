@@ -339,6 +339,17 @@ import bench
 print(json.dumps(bench.run_bench_serving()))
 PYEOF
 
+# r11: paged-KV + prefix-cache serving leg ON CHIP — the tools/bench_serve
+# paged section under the real (non-tiny) geometry and the non-interpret
+# pallas paged kernel: many short requests sharing one system prefix,
+# contiguous vs paged with exactness + added-dispatch + hbm-bytes-per-
+# request + prefix-hit-rate recorded (the CPU tier gates the same
+# accounting; this leg confirms the gathering block index map compiles
+# clean under Mosaic and prices the on-chip tok/s delta)
+run_leg "serving paged KV + prefix cache (shared-prefix workload)" \
+  bench_results/serve_paged.jsonl \
+  python tools/bench_serve.py --batch-size 4 --ks 8
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
