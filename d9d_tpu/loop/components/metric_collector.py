@@ -17,6 +17,7 @@ import numpy as np
 
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.telemetry import tracked_jit
 
 TASK_STAT_PREFIX = "task/"
 
@@ -42,8 +43,11 @@ class MetricCollector:
         self.task = task
         self.metrics = task.metrics()
         self._carry: PyTree | None = None
-        self._add = jax.jit(
-            lambda a, b: jax.tree.map(lambda x, y: x + y, a, b)
+        # runs every step (device-side accumulate): tracked so its
+        # compile/recompiles are visible like the rest of the step path
+        self._add = tracked_jit(
+            lambda a, b: jax.tree.map(lambda x, y: x + y, a, b),
+            name="metric/accumulate",
         )
 
     def collect(self, step_metrics: dict) -> None:

@@ -30,6 +30,7 @@ from d9d_tpu.loop.control.providers import DatasetProvider, ModelProvider
 from d9d_tpu.loop.event import EventBus
 from d9d_tpu.loop.model_factory import init_sharded_params
 from d9d_tpu.pipelining import PipelineStageInfo
+from d9d_tpu.telemetry import tracked_jit
 
 logger = logging.getLogger("d9d_tpu.inference")
 
@@ -199,7 +200,9 @@ class Inference:
                 )
                 return outs  # leading dims [n_mb, mb, ...]
 
-            self._forward = jax.jit(forward)
+            # tracked (telemetry/introspect.py): the per-batch forward is
+            # the inference hot path — compiles/HBM claim must be visible
+            self._forward = tracked_jit(forward, name="infer/forward")
             self._stage = make_batch_stager(
                 ctx,
                 num_microbatches=self.num_microbatches,

@@ -36,6 +36,7 @@ def init_sharded_from_fn(
     abstract = jax.eval_shape(init_fn)
     logical_spec = nn.get_partition_spec(abstract)
     shardings = logical_to_mesh_sharding(logical_spec, mesh, plan.rules)
+    # d9d-lint: disable=D9D001 — one-shot sharded init at model build
     boxed = jax.jit(init_fn, out_shardings=shardings)()
     params = nn.unbox(boxed)
     return params, jax.tree.map(lambda x: x.sharding, params)

@@ -1468,6 +1468,7 @@ class ContinuousBatcher:
                 jnp.asarray(pos), sub, jnp.asarray(live),
             )
         with annotate("serve.readback"):
+            # d9d-lint: disable=D9D003 — the one [B] readback per legacy token step
             nxt = np.asarray(nxt)
         now = time.perf_counter()
         self._progress_t = now
@@ -1647,7 +1648,8 @@ class ContinuousBatcher:
         device's emission/stop logic on it to commit host state."""
         toks_d, plan = self._pending.popleft()
         with annotate("serve.readback"):
-            toks = np.asarray(toks_d)  # the single [B, K] readback
+            # d9d-lint: disable=D9D003 — the single [B, K] readback per chunk
+            toks = np.asarray(toks_d)
         now = time.perf_counter()
         self._progress_t = now
         if self._first_readback_t is None:

@@ -132,9 +132,9 @@ def speculative_generate(
     # covers the first round too (nothing reads them in between)
     n = np.full((b,), p, np.int32)
 
-    def draft_step(cache, tok, pos):
+    def draft_step(prm, cache, tok, pos):
         logits, state = draft_model.apply(
-            {"params": draft_params, "cache": cache},
+            {"params": prm, "cache": cache},
             tok[:, None], pos[:, None],
             method=draft_model.logits_last, mutable=["cache"],
         )
@@ -143,19 +143,23 @@ def speculative_generate(
             jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
         )
 
-    def round_fn(t_cache, d_cache, pending, n_eff):
+    def round_fn(t_cache, d_cache, pending, n_eff, t_params, d_params):
         """One full speculation round as a single XLA program: rewind both
         caches to the committed length, draft ``k`` greedy tokens with a
         ``lax.scan`` (plus the extra key-write for the fully-accepted
         case), then verify ``pending + proposals`` in one target call —
         the host dispatches ONCE and reads back once per round instead of
-        re-entering Python for every draft token."""
+        re-entering Python for every draft token.
+
+        Both param trees are TRACED ARGUMENTS, never closure captures: a
+        captured tree is baked into the executable as a constant (the
+        install_weights publish-recompile class — D9D002)."""
         t_cache = _set_indices(t_cache, n_eff)
         d_cache = _set_indices(d_cache, n_eff)
 
         def body(carry, i):
             cache, tok = carry
-            cache, nxt = draft_step(cache, tok, n_eff + i)
+            cache, nxt = draft_step(d_params, cache, tok, n_eff + i)
             return (cache, nxt), nxt
 
         (d_cache, last), props = jax.lax.scan(
@@ -168,14 +172,14 @@ def speculative_generate(
         # cache would carry a permanently visible unwritten slot —
         # silently degrading every later proposal's conditioning (and
         # with it the acceptance rate)
-        d_cache, _ = draft_step(d_cache, last, n_eff + k)
+        d_cache, _ = draft_step(d_params, d_cache, last, n_eff + k)
         toks = jnp.concatenate([pending[:, None], proposals], axis=1)
         pos = n_eff[:, None] + jnp.arange(1 + k, dtype=jnp.int32)[None]
         # trace-time flag: the verify chunk attends the warm slot cache
         # (valid at any index), not the empty-cache prefill fast path
         with continuation_chunk():
             logits, state = model.apply(
-                {"params": params, "cache": t_cache},
+                {"params": t_params, "cache": t_cache},
                 toks, pos, method=model.logits, mutable=["cache"],
             )
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, 1+k]
@@ -205,8 +209,10 @@ def speculative_generate(
         # the extra key write + the verify call, all inside spec_round;
         # ONE readback fetches proposals and the target's greedy tokens
         t_cache, d_cache, proposals_d, greedy_d = spec_round(
-            t_cache, d_cache, jnp.asarray(pending), jnp.asarray(n_eff)
+            t_cache, d_cache, jnp.asarray(pending), jnp.asarray(n_eff),
+            params, draft_params,
         )
+        # d9d-lint: disable=D9D003 — the one accounted readback per round
         proposals, greedy = jax.device_get((proposals_d, greedy_d))
         # greedy[:, i] = target tok after toks[:, :i+1]
 

@@ -158,6 +158,7 @@ class Trainer:
             # that conflicts with the mesh-placed params at the first
             # post-restore step (core/tree_sharding.replicate_uncommitted)
             self.opt_state = replicate_uncommitted(
+                # d9d-lint: disable=D9D001 — one-shot optimizer-state init
                 jax.jit(self.optimizer.init)(self.params), ctx.mesh
             )
             self.zero = None
@@ -944,6 +945,7 @@ class Trainer:
         if self.peft_method is None:
             return self.params
         if self._merge_fn is None:
+            # d9d-lint: disable=D9D001 — one-shot export-time PEFT merge
             self._merge_fn = jax.jit(self.peft_method.merge)
         return self._merge_fn(self.base_params, self.params)
 

@@ -181,6 +181,7 @@ def _paged_decode_kernel(offs_ref, pt_ref, *refs, cfg: _DecodeConfig):
     _decode_kernel(offs_ref, *refs, cfg=cfg)
 
 
+# d9d-lint: disable=D9D001 — standalone-use decorator; serving traces this inside the tracked serve/step program (a TrackedJit cannot be called under a trace)
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
                        offsets, page_table):
@@ -234,6 +235,7 @@ def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
     return o, lse[..., 0]
 
 
+# d9d-lint: disable=D9D001 — standalone-use decorator; serving traces this inside the tracked serve/step program (a TrackedJit cannot be called under a trace)
 @functools.partial(
     jax.jit,
     static_argnames=("cfg",),

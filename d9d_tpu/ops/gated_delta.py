@@ -90,6 +90,7 @@ def gated_delta_rule_recurrent(
     return o.transpose(1, 0, 2, 3), s_final
 
 
+# d9d-lint: disable=D9D001 — standalone-use decorator; the train/serve paths trace this inside their tracked step programs
 @functools.partial(jax.jit, static_argnames=("use_qk_l2norm", "chunk_size"))
 def gated_delta_rule_chunked(
     q: Array,

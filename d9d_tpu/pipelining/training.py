@@ -27,6 +27,7 @@ from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.runtime.transfer import put_compat
+from d9d_tpu.telemetry import tracked_jit
 
 __all__ = ["PipelinedOptimizer"]
 
@@ -95,23 +96,44 @@ class PipelinedOptimizer:
                 }
                 return norm, factor, ok, new_guard, metrics
 
-        self._sq_norm = jax.jit(sq_norm)
-        self._combine = jax.jit(
-            functools.partial(combine, max_norm=self.max_grad_norm)
+        # tracked_jit (telemetry/introspect.py): these run EVERY step —
+        # per-stage sq_norm/update plus the anchor-stage combine — so
+        # their compiles/recompiles must be visible to the guard like
+        # the rest of the step path. Per-stage executables get per-stage
+        # names (pp_opt/s{S}/...) because the hbm/{name}/* gauges are
+        # set per compile: one shared name across stages of different
+        # sizes would last-write-wins blend their claims (the PR 9
+        # gauge-conflation class). The combine runs only on the anchor
+        # stage, so one name suffices.
+        self._sq_norm_impl = sq_norm
+        self._sq_norm_fns: dict[int, Any] = {}
+        self._combine = tracked_jit(
+            functools.partial(combine, max_norm=self.max_grad_norm),
+            name="pp_opt/combine",
         )
-        self._combine_guarded = jax.jit(
-            functools.partial(combine_guarded, max_norm=self.max_grad_norm)
+        self._combine_guarded = tracked_jit(
+            functools.partial(combine_guarded, max_norm=self.max_grad_norm),
+            name="pp_opt/combine_guarded",
         )
-        # default jitted updates over the plain optimizer; zero-enabled
-        # stages get their own pair in init() (per-stage sharding tables)
-        self._default_fns = self._build_update_fns(self.optimizer)
+        # per-stage jitted update pairs, built lazily on first use;
+        # zero-enabled stages get theirs swapped in by init() (per-stage
+        # sharding tables baked into the traced program)
         self._stage_fns: dict[int, tuple] = {}
         self.zero_shardings: dict[int, Any] = {}
 
-    def _build_update_fns(self, opt) -> tuple:
+    def _stage_sq_norm(self, stage: int):
+        fn = self._sq_norm_fns.get(stage)
+        if fn is None:
+            fn = self._sq_norm_fns[stage] = tracked_jit(
+                self._sq_norm_impl, name=f"pp_opt/s{stage}/sq_norm"
+            )
+        return fn
+
+    def _build_update_fns(self, opt, scope: str) -> tuple:
         """(update, update_guarded) jits closed over ``opt`` — one pair
-        per distinct optimizer instance (the ZeRO wrapper bakes its
-        sharding tables into the traced program)."""
+        per stage (the ZeRO wrapper bakes its per-stage sharding tables
+        into the traced program). ``scope`` (``pp_opt/s{S}``) keys the
+        tracked names so each stage's ``hbm/*`` gauges stay distinct."""
         accepts_fp32 = getattr(opt, "accepts_fp32_grads", False)
         apply_updates = getattr(opt, "apply_updates", optax.apply_updates)
         freeze = self.anomaly_freeze
@@ -143,12 +165,22 @@ class PipelinedOptimizer:
                 return new_params, new_state
 
         return (
-            jax.jit(update, donate_argnums=(0, 1, 2)),
-            jax.jit(update_guarded, donate_argnums=(0, 1, 2)),
+            tracked_jit(
+                update, name=f"{scope}/update", donate_argnums=(0, 1, 2)
+            ),
+            tracked_jit(
+                update_guarded, name=f"{scope}/update_guarded",
+                donate_argnums=(0, 1, 2),
+            ),
         )
 
     def _stage_update_fns(self, stage: int) -> tuple:
-        return self._stage_fns.get(stage, self._default_fns)
+        fns = self._stage_fns.get(stage)
+        if fns is None:
+            fns = self._stage_fns[stage] = self._build_update_fns(
+                self.optimizer, scope=f"pp_opt/s{stage}"
+            )
+        return fns
 
     def _scoped(self, stage: int):
         return compat.set_mesh(self.scalar_shardings[stage].mesh)
@@ -163,6 +195,7 @@ class PipelinedOptimizer:
                 # the stage submesh so their placement survives a
                 # checkpoint round-trip (see trainer init note)
                 out[s] = replicate_uncommitted(
+                    # d9d-lint: disable=D9D001 — one-shot per-stage init, not steady-state
                     jax.jit(self.optimizer.init)(p),
                     self.scalar_shardings[s].mesh,
                 )
@@ -192,7 +225,8 @@ class PipelinedOptimizer:
         )
         self.zero_shardings[stage] = zero
         self._stage_fns[stage] = self._build_update_fns(
-            ZeroShardedOptimizer(self.optimizer, zero)
+            ZeroShardedOptimizer(self.optimizer, zero),
+            scope=f"pp_opt/s{stage}",
         )
         return place_tree(state, zero.state_shardings)
 
@@ -210,7 +244,7 @@ class PipelinedOptimizer:
             sq_local = []
             for s in sorted(stage_grads):
                 with self._scoped(s):
-                    sq_local.append(self._sq_norm(stage_grads[s]))
+                    sq_local.append(self._stage_sq_norm(s)(stage_grads[s]))
             # batched hop: all per-stage scalars move to the anchor stage
             # from one call site (VERDICT r3 item 3)
             sq_norms = put_compat(sq_local, anchor)
@@ -263,7 +297,7 @@ class PipelinedOptimizer:
             sq_local = []
             for s in sorted(stage_grads):
                 with self._scoped(s):
-                    sq_local.append(self._sq_norm(stage_grads[s]))
+                    sq_local.append(self._stage_sq_norm(s)(stage_grads[s]))
             sq_norms = put_compat(sq_local, anchor)
         with annotate("pp_opt.combine"), self._scoped(last):
             norm, factor, ok, guard_state, guard_metrics = (

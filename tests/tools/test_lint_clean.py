@@ -1,0 +1,66 @@
+"""The tier-1 lint gate: ``d9d-lint`` over the live repo must be clean.
+
+Same shape as ``tools/bench_compare``'s live gate — run the real tool
+in-process against the committed ``tools/lint/baseline.json`` and fail
+on any NEW finding. Every future PR that bakes params into a jit,
+sneaks a host sync into a hot loop, bare-jits a hot path, or registers
+an undocumented metric name fails here, with the finding text naming
+the file and the contract it broke (docs/design/static_analysis.md).
+
+Budget-pinned: the linter is stdlib-only (no jax import) and must stay
+a few-seconds tool so the gate costs tier-1 nothing.
+"""
+
+import pathlib
+import time
+
+from tools.lint import baseline as baseline_mod
+from tools.lint.cli import DEFAULT_BASELINE, DEFAULT_TARGETS, REPO_ROOT
+from tools.lint.engine import lint_paths
+from tools.lint.rules import ALL_RULES
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def test_repo_is_lint_clean_and_fast():
+    t0 = time.perf_counter()
+    errors = []
+    findings = lint_paths(
+        REPO_ROOT,
+        [REPO_ROOT / t for t in DEFAULT_TARGETS],
+        list(ALL_RULES),
+        on_error=lambda e: errors.append(str(e)),
+    )
+    diff = baseline_mod.diff_against_baseline(
+        findings, baseline_mod.load(DEFAULT_BASELINE), REPO_ROOT
+    )
+    wall = time.perf_counter() - t0
+
+    assert not errors, f"unparseable files: {errors}"
+    assert diff.ok, (
+        "NEW d9d-lint findings (fix, suppress inline with a reason, or — "
+        "last resort — refresh tools/lint/baseline.json):\n"
+        + "\n".join(f.render() for f in diff.new)
+    )
+    assert not diff.stale, (
+        "stale baseline entries (the debt was paid — refresh with "
+        "`d9d-lint --write-baseline` so the file shrinks):\n"
+        + "\n".join(str(e) for e in diff.stale)
+    )
+    # budget pin: stdlib-only AST pass over ~250 files; 30s is ~10x
+    # headroom on the 2-core CPU rig
+    assert wall < 30.0, f"d9d-lint took {wall:.1f}s — budget blown"
+
+
+def test_gate_paths_are_the_committed_ones():
+    """The gate must scan the real package surfaces against the real
+    committed baseline — a drifted default would hollow out the gate."""
+    assert REPO_ROOT == ROOT
+    assert set(DEFAULT_TARGETS) == {"d9d_tpu", "tools"}
+    assert DEFAULT_BASELINE == ROOT / "tools/lint/baseline.json"
+    assert DEFAULT_BASELINE.exists()
+
+
+def test_console_entry_declared():
+    pyproject = (ROOT / "pyproject.toml").read_text(encoding="utf-8")
+    assert 'd9d-lint = "tools.lint.cli:main"' in pyproject

@@ -1,0 +1,38 @@
+"""graftlint: AST-based invariant linter for dispatch, placement and
+telemetry discipline (docs/design/static_analysis.md).
+
+Ten PRs of review-hardening kept root-causing the same latent-bug
+classes: params closure-captured into jit as baked constants (the PR 8
+``install_weights`` bug), uncommitted placements from ``jit(init)``
+scalars (the PR 5 resume bug), bare ``jax.jit`` in hot paths escaping
+the ``tracked_jit`` recompile guard, host syncs creeping into the
+one-dispatch-one-readback serve/PP loops, nondeterminism inside traced
+programs, and metric names drifting from the documented namespace.
+Each is a *statically checkable* contract; this package mechanizes
+them as lint rules over the repo's own source:
+
+- **D9D000** — malformed / reason-less suppression comments (engine);
+- **D9D001** — bare ``jax.jit`` in hot-path modules (must be
+  ``tracked_jit``);
+- **D9D002** — functions handed to jit closing over param/array-valued
+  names (baked-constant → publish-recompile class);
+- **D9D003** — host syncs inside registered hot scopes (serve chunk
+  loop, train step, PP per-microbatch executor);
+- **D9D004** — persistent state initialized under jit without
+  ``replicate_uncommitted`` / explicit out-shardings;
+- **D9D005** — nondeterminism sources inside traced functions;
+- **D9D006** — telemetry names not covered by the namespace tables in
+  ``docs/design/observability.md`` (+ the path-free-label rule).
+
+Inline suppression: ``# d9d-lint: disable=D9D001 — reason`` on the
+finding's line or the line above; the reason is mandatory. Findings
+diff against the committed ``tools/lint/baseline.json`` — the gate
+fails only on NEW findings (``--write-baseline`` refreshes), the same
+committed-baseline shape as ``tools/bench_compare.py``.
+
+Console entry: ``d9d-lint`` (also ``python -m tools.lint``).
+"""
+
+from tools.lint.engine import Finding, lint_paths  # noqa: F401
+
+__all__ = ["Finding", "lint_paths"]

@@ -1,0 +1,6 @@
+"""``python -m tools.lint`` → the d9d-lint CLI."""
+
+from tools.lint.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""Committed-baseline gate semantics (the ``bench_compare`` shape).
+
+``tools/lint/baseline.json`` holds fingerprints of findings that were
+consciously accepted when a rule landed; the gate fails only on NEW
+findings, so adding a rule never blocks the tree while its historical
+debt is triaged. ``d9d-lint --write-baseline`` refreshes the file;
+stale entries (baselined findings that no longer fire) are reported so
+the file shrinks as debt is paid, and a refresh drops them.
+
+Fingerprints hash rule + path + the violating line's normalized
+content + an occurrence index — stable across unrelated line drift,
+invalidated when the flagged code itself changes (see
+``Finding.fingerprint``).
+"""
+
+import dataclasses
+import json
+import pathlib
+from typing import Optional
+
+from tools.lint.engine import Finding
+
+__all__ = ["BaselineDiff", "diff_against_baseline", "load", "write"]
+
+
+@dataclasses.dataclass
+class BaselineDiff:
+    new: list[Finding]
+    baselined: list[Finding]
+    stale: list[dict]  # baseline entries that no longer fire
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def _fingerprints(findings: list[Finding], root: pathlib.Path) -> list[str]:
+    """Fingerprint each finding, disambiguating identical lines by
+    per-(rule, path, line-text) occurrence order."""
+    counts: dict[tuple, int] = {}
+    prints = []
+    line_cache: dict[str, list[str]] = {}
+    for f in findings:
+        lines = line_cache.get(f.path)
+        if lines is None:
+            lines = line_cache[f.path] = (
+                (root / f.path).read_text(encoding="utf-8").splitlines()
+            )
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, " ".join(text.split()))
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        prints.append(f.fingerprint(text, n))
+    return prints
+
+
+def load(path: pathlib.Path) -> dict:
+    if not path.exists():
+        return {"version": 1, "entries": []}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a d9d-lint baseline file")
+    return data
+
+
+def write(
+    path: pathlib.Path, findings: list[Finding], root: pathlib.Path
+) -> dict:
+    entries = [
+        {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+        }
+        for f, fp in zip(findings, _fingerprints(findings, root))
+    ]
+    data = {"version": 1, "entries": entries}
+    path.write_text(
+        json.dumps(data, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    return data
+
+
+def diff_against_baseline(
+    findings: list[Finding],
+    baseline: Optional[dict],
+    root: pathlib.Path,
+) -> BaselineDiff:
+    entries = (baseline or {}).get("entries", [])
+    known = {e["fingerprint"] for e in entries}
+    prints = _fingerprints(findings, root)
+    new, old = [], []
+    seen = set()
+    for f, fp in zip(findings, prints):
+        seen.add(fp)
+        (old if fp in known else new).append(f)
+    stale = [e for e in entries if e["fingerprint"] not in seen]
+    return BaselineDiff(new=new, baselined=old, stale=stale)

@@ -1,0 +1,219 @@
+"""``d9d-lint`` console entry (also ``python -m tools.lint``).
+
+Runs the rule set over the given targets (default: ``d9d_tpu/`` +
+``tools/``), diffs against the committed ``tools/lint/baseline.json``
+and exits nonzero on NEW findings — the same committed-baseline gate
+shape as ``tools/bench_compare.py``. ``--write-baseline`` refreshes
+the file after an intentional acceptance; ``--json`` emits the full
+machine-readable report for harnesses.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+from tools.lint import baseline as baseline_mod  # noqa: E402
+from tools.lint.engine import LintError, lint_paths  # noqa: E402
+from tools.lint.rules import ALL_RULES, RULES_BY_ID  # noqa: E402
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TARGETS = ("d9d_tpu", "tools")
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="d9d-lint",
+        description=(
+            "AST-based invariant linter for dispatch, placement and "
+            "telemetry discipline (docs/design/static_analysis.md)"
+        ),
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=None,
+        help=f"files/directories to lint (default: {DEFAULT_TARGETS})",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root for relative paths + the doc cross-check "
+             "(default: the root this tool lives in)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE.name} next to "
+             "the tool)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: ANY finding fails",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule set and exit",
+    )
+    return parser
+
+
+def _finding_dict(f) -> dict:
+    return {
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "col": f.col,
+        "message": f.message,
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        print("D9D000 suppression-comment discipline (engine)")
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id} {rule.summary}")
+        return 0
+
+    root = pathlib.Path(args.root).resolve() if args.root else REPO_ROOT
+    targets = [
+        (root / t) if not pathlib.Path(t).is_absolute() else pathlib.Path(t)
+        for t in (args.targets or DEFAULT_TARGETS)
+    ]
+    selected_ids = None
+    if args.select:
+        wanted = [r.strip() for r in args.select.split(",") if r.strip()]
+        # D9D000 is the engine's own suppression-discipline rule: it has
+        # no rule class but is selectable (and deselectable) like any other
+        unknown = [
+            r for r in wanted if r != "D9D000" and r not in RULES_BY_ID
+        ]
+        if unknown:
+            print(f"d9d-lint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[r] for r in wanted if r in RULES_BY_ID]
+        selected_ids = set(wanted)
+        if args.write_baseline:
+            # a partial run must never rewrite the committed baseline:
+            # it would silently drop every un-run rule's entries
+            print(
+                "d9d-lint: --write-baseline refuses to run with "
+                "--select (a partial run would erase the other rules' "
+                "baseline entries)", file=sys.stderr,
+            )
+            return 2
+    else:
+        rules = list(ALL_RULES)
+
+    from tools.lint import config as lint_config
+    doc = root / lint_config.OBSERVABILITY_DOC
+    if any(r.rule_id == "D9D006" for r in rules) and not doc.exists():
+        print(
+            f"d9d-lint: {doc} not found — D9D006 cross-checks names "
+            "against it (pass the owning --root, or --select the other "
+            "rules)", file=sys.stderr,
+        )
+        return 2
+
+    errors: list[str] = []
+    try:
+        findings = lint_paths(
+            root, targets, rules,
+            on_error=lambda e: errors.append(str(e)),
+        )
+    except LintError as e:  # unreachable with on_error, kept for safety
+        print(f"d9d-lint: {e}", file=sys.stderr)
+        return 2
+    # per-file analyses can surface the same root cause many times
+    # (e.g. an unreadable shared input): report each message once
+    errors = list(dict.fromkeys(errors))
+    if selected_ids is not None:
+        # engine-level D9D000 findings fire on every run; a --select of
+        # other rules must not fail on a rule the user didn't ask for
+        findings = [f for f in findings if f.rule in selected_ids]
+
+    baseline_path = (
+        pathlib.Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+    )
+    if args.write_baseline:
+        if errors:
+            # a refresh over a partial scan would silently drop the
+            # unscanned files' entries — refuse, like --select does
+            for e in errors:
+                print(f"d9d-lint: error: {e}", file=sys.stderr)
+            print(
+                "d9d-lint: --write-baseline refuses to run with "
+                "analysis errors (the refresh would erase entries for "
+                "files it could not scan)", file=sys.stderr,
+            )
+            return 2
+        data = baseline_mod.write(baseline_path, findings, root)
+        print(
+            f"d9d-lint: wrote {len(data['entries'])} finding(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    if args.no_baseline:
+        diff = baseline_mod.BaselineDiff(
+            new=findings, baselined=[], stale=[]
+        )
+    else:
+        diff = baseline_mod.diff_against_baseline(
+            findings, baseline_mod.load(baseline_path), root
+        )
+        if selected_ids is not None:
+            # entries for rules that did not run are unknown, not stale
+            diff.stale = [
+                e for e in diff.stale if e.get("rule") in selected_ids
+            ]
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [_finding_dict(f) for f in findings],
+            "new": [_finding_dict(f) for f in diff.new],
+            "baselined": [_finding_dict(f) for f in diff.baselined],
+            "stale": diff.stale,
+            "errors": errors,
+            "ok": diff.ok and not errors,
+        }, indent=2))
+    else:
+        for f in diff.new:
+            print(f.render())
+        if diff.baselined:
+            print(
+                f"d9d-lint: {len(diff.baselined)} baselined finding(s) "
+                f"suppressed by {baseline_path}"
+            )
+        if diff.stale:
+            print(
+                f"d9d-lint: {len(diff.stale)} stale baseline entr"
+                f"{'y' if len(diff.stale) == 1 else 'ies'} no longer "
+                "fire(s) — refresh with --write-baseline"
+            )
+        for e in errors:
+            print(f"d9d-lint: error: {e}", file=sys.stderr)
+        if diff.new:
+            print(
+                f"d9d-lint: {len(diff.new)} NEW finding(s) — fix, "
+                "suppress inline with a reason, or (last resort) "
+                "--write-baseline"
+            )
+        elif not errors:
+            print("d9d-lint: clean")
+
+    return 0 if diff.ok and not errors else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,118 @@
+"""Extract the documented metric/span namespace from
+``docs/design/observability.md`` for the D9D006 cross-check.
+
+The doc's tables (and surrounding prose) name every instrument in
+backticked code spans — ``serve/ttft_s``, ``pp/s{S}/busy_s``,
+``hbm/{name}/peak_bytes``, ``serve/r{i}/*``. This module turns those
+into matchers:
+
+- a literal name matches itself;
+- ``{placeholder}`` segments match one path segment (``[^/]+``);
+- ``*`` / ``...`` / ``…`` tails match any suffix.
+
+Code-side f-string names are probed by substituting ``r0`` for each
+interpolated field (``f"slo/{p.name}/burn"`` → ``slo/r0/burn``), which
+the placeholder regexes accept — see D9D006's docstring for the
+limits of that trick.
+"""
+
+import functools
+import pathlib
+import re
+from typing import Iterable
+
+__all__ = ["DocNamespace", "load_doc_namespace"]
+
+_CODE_SPAN_RE = re.compile(r"`([^`]+)`")
+# a metric-ish token: slash-separated path of word/placeholder segments
+_NAME_RE = re.compile(
+    r"^[A-Za-z0-9_{}.*…]+(?:/[A-Za-z0-9_{}.*…]+)+$"
+)
+
+
+def _template_to_regex(template: str) -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(template):
+        ch = template[i]
+        if ch == "{":
+            j = template.find("}", i)
+            if j == -1:
+                out.append(re.escape(template[i:]))
+                break
+            # {name} = one path segment; {name…}/{name...} = may span
+            # segments (tracked-executable names contain slashes)
+            inner = template[i + 1:j]
+            out.append(
+                r".+" if inner.endswith(("…", "...")) else r"[^/]+"
+            )
+            i = j + 1
+        elif ch == "*":
+            out.append(r".*")
+            i += 1
+        elif template.startswith("...", i):
+            out.append(r".*")
+            i += 3
+        elif ch == "…":
+            out.append(r".*")
+            i += 1
+        else:
+            out.append(re.escape(ch))
+            i += 1
+    return re.compile("^" + "".join(out) + "$")
+
+
+class DocNamespace:
+    """The documented names, queryable as exact strings or templates."""
+
+    def __init__(self, templates: Iterable[str]):
+        self.templates = sorted(set(templates))
+        self.exact = {t for t in self.templates if not re.search(r"[{*…]|\.\.\.", t)}
+        self._regexes = [
+            _template_to_regex(t)
+            for t in self.templates
+            if t not in self.exact
+        ]
+
+    def covers(self, name: str) -> bool:
+        if name in self.exact:
+            return True
+        return any(rx.match(name) for rx in self._regexes)
+
+    def __len__(self) -> int:
+        return len(self.templates)
+
+
+# the namespace table's PREFIX column (`serve/*`, `train/*`, ...):
+# ownership declarations, not name grants — extracting them as
+# templates would make every name under a documented prefix pass and
+# the drift check vacuous
+_BARE_PREFIX_RE = re.compile(r"^[A-Za-z0-9_]+/\*$")
+
+
+def extract_names(markdown: str) -> list[str]:
+    names = []
+    for span in _CODE_SPAN_RE.findall(markdown):
+        # one span may carry several names ("`serve/a` / `serve/b`" is
+        # two spans, but "`serve/a, serve/b`" is one) — split on
+        # whitespace/commas and keep the metric-shaped tokens
+        for token in re.split(r"[\s,;|]+", span):
+            token = token.strip("`'\"()[]")
+            if _NAME_RE.match(token) and not _BARE_PREFIX_RE.match(token):
+                names.append(token)
+    return names
+
+
+@functools.lru_cache(maxsize=4)
+def load_doc_namespace(doc_path: str) -> DocNamespace:
+    try:
+        text = pathlib.Path(doc_path).read_text(encoding="utf-8")
+    except OSError as e:
+        from tools.lint.engine import LintError
+
+        raise LintError(
+            f"{doc_path}: unreadable — the D9D006 cross-check needs the "
+            "namespace doc (pass --root at the repo that owns it, or "
+            "--select the other rules)"
+        ) from e
+    return DocNamespace(extract_names(text))

@@ -1,0 +1,629 @@
+"""Shared AST engine for the d9d lint rules.
+
+One parse per file, then a handful of cheap shared analyses every rule
+consumes (see docs/design/static_analysis.md):
+
+- **import/alias resolution** — ``import jax.numpy as jnp`` makes
+  ``jnp.asarray`` resolve to the canonical ``jax.numpy.asarray``; call
+  sites are matched on canonical dotted names, never on surface text;
+- **scope tracking** — every function/lambda gets a qualname and a
+  link to its lexical parent, with local ``def``/``lambda`` bindings
+  resolvable innermost-out (how ``jit(step_fn)`` finds ``step_fn``);
+- **traced-function set** — functions handed to jit/scan/cond/grad/
+  pallas_call/... seeds, closed under lexical nesting and direct
+  same-module calls (the "lightweight intra-module dataflow");
+- **closure analysis** — free variables via :mod:`symtable` (exact
+  CPython semantics: module globals are not free, closure cells are);
+- **suppressions** — ``# d9d-lint: disable=RULE[,RULE] — reason`` on
+  the finding's line or the line above. The reason is mandatory;
+  a reason-less suppression still applies but files a D9D000 finding
+  so the gate keeps the discipline honest.
+
+The engine is stdlib-only (ast + symtable + tokenize): linting must
+never import jax or the package under analysis.
+"""
+
+import ast
+import dataclasses
+import hashlib
+import io
+import pathlib
+import re
+import symtable
+import tokenize
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "LintError",
+    "lint_file",
+    "lint_paths",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*d9d-lint:\s*disable=([A-Z0-9, ]+?)"
+    r"(?:\s*(?:—|--|-|:)\s*(?P<reason>\S.*))?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self, line_text: str, occurrence: int) -> str:
+        """Line-drift-stable identity for the baseline: rule + path +
+        the violating line's *content* (whitespace-normalized) + an
+        occurrence index for identical lines — NOT the line number, so
+        unrelated edits above a baselined finding don't churn it."""
+        normalized = " ".join(line_text.split())
+        digest = hashlib.sha1(
+            f"{self.rule}|{self.path}|{normalized}|{occurrence}".encode()
+        ).hexdigest()[:16]
+        return digest
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class LintError(RuntimeError):
+    """A file the engine could not analyze (syntax error, bad encoding)."""
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: tuple[str, ...]
+    reason: Optional[str]
+    raw: str
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function/lambda scope with its lexical chain."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    qualname: str
+    parent: Optional["FunctionInfo"]  # None = module scope
+    # local name → def/lambda node bound at this scope (defs and
+    # single-target `f = lambda ...` assignments)
+    local_defs: dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    # local name → the ast value expression last assigned to it (simple
+    # single-Name targets only; the rules' lightweight dataflow)
+    assignments: dict[str, ast.expr] = dataclasses.field(default_factory=dict)
+
+
+# -- tracing entry points: canonical name (or .suffix) → fn-arg indices --
+
+TRACING_ENTRIES: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,),
+    ".tracked_jit": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.associative_scan": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.eval_shape": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+    ".defvjp": (0, 1),
+    ".defjvp": (0,),
+    ".pallas_call": (0,),
+    ".shard_map": (0,),
+}
+
+# jit-like entries only — the D9D002 closure rule cares about functions
+# that become *jitted executables* (a scan body's closure is traced into
+# its enclosing jit and re-traced with it, so captures there refresh)
+JIT_ENTRIES: tuple[str, ...] = ("jax.jit", ".tracked_jit")
+
+# host-callback escapes: their fn argument runs on the HOST, so traced-
+# function rules must not descend into it
+CALLBACK_ESCAPES: tuple[str, ...] = (
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    ".io_callback",
+    "jax.debug.callback",
+    "jax.debug.print",
+)
+
+
+def canonical_matches(canon: Optional[str], patterns: Iterable[str]) -> bool:
+    """True when ``canon`` matches one of ``patterns`` — exact dotted
+    name, ``.suffix`` (attribute-tail match), or ``prefix.`` match."""
+    if canon is None:
+        return False
+    for pat in patterns:
+        if pat.startswith("."):
+            if canon.endswith(pat) or canon == pat[1:]:
+                return True
+        elif pat.endswith("."):
+            if canon.startswith(pat):
+                return True
+        elif canon == pat:
+            return True
+    return False
+
+
+class FileContext:
+    """Everything the rules need about one parsed source file."""
+
+    def __init__(self, root: pathlib.Path, path: pathlib.Path):
+        self.root = root
+        self.abspath = path
+        try:
+            self.path = path.relative_to(root).as_posix()
+        except ValueError as e:
+            raise LintError(
+                f"{path}: outside the lint root {root} — findings and "
+                "baselines are keyed on root-relative paths"
+            ) from e
+        try:
+            self.source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            raise LintError(f"{self.path}: unreadable: {e}") from e
+        try:
+            self.tree = ast.parse(self.source, filename=str(path))
+        except SyntaxError as e:
+            raise LintError(f"{self.path}: syntax error: {e}") from e
+        self.lines = self.source.splitlines()
+        self.suppressions: dict[int, Suppression] = {}
+        self._collect_suppressions()
+        self.aliases = self._collect_aliases()
+        self.parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.functions: list[FunctionInfo] = []
+        self._fn_by_node: dict[int, FunctionInfo] = {}
+        self._collect_scopes()
+        self._traced: Optional[set[int]] = None
+        self._jit_handed: Optional[set[int]] = None
+        self._symtable_index: Optional[dict[tuple[str, int], list]] = None
+
+    # -- comments / suppressions ----------------------------------------
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m is None:
+                    continue
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                self.suppressions[tok.start[0]] = Suppression(
+                    line=tok.start[0],
+                    rules=rules,
+                    reason=m.group("reason"),
+                    raw=tok.string.strip(),
+                )
+        except tokenize.TokenError:
+            pass  # partial tokenization: keep what we saw
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A suppression covers its own line and the line below it (the
+        comment conventionally sits above a multi-line statement)."""
+        for ln in (line, line - 1):
+            sup = self.suppressions.get(ln)
+            if sup is not None and rule in sup.rules:
+                return True
+        return False
+
+    # -- imports / canonical names --------------------------------------
+
+    def _collect_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        aliases[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, through the
+        import alias map; None for anything non-dotted (calls,
+        subscripts, literals)."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                # keep the attribute tail resolvable for `.suffix`
+                # patterns even off an opaque base (self._fused.get → None,
+                # but obj.item → ".item" via the tail)
+                return None
+            return f"{base}.{node.attr}"
+        return None
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        return self.resolve(call.func)
+
+    def unwrap_partial(self, node: ast.AST) -> ast.AST:
+        """``functools.partial(f, ...)`` → ``f`` (one level)."""
+        if isinstance(node, ast.Call) and canonical_matches(
+            self.resolve_call(node), ("functools.partial", ".partial")
+        ):
+            if node.args:
+                return node.args[0]
+        return node
+
+    # -- scopes ----------------------------------------------------------
+
+    def _collect_scopes(self) -> None:
+        def visit(node: ast.AST, parent: Optional[FunctionInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    name = getattr(child, "name", "<lambda>")
+                    qual = (
+                        f"{parent.qualname}.{name}" if parent else name
+                    )
+                    info = FunctionInfo(
+                        node=child, name=name, qualname=qual, parent=parent
+                    )
+                    self.functions.append(info)
+                    self._fn_by_node[id(child)] = info
+                    if parent is not None and name != "<lambda>":
+                        parent.local_defs[name] = child
+                    elif parent is None and name != "<lambda>":
+                        self._module_defs[name] = child
+                    visit(child, info)
+                elif isinstance(child, ast.ClassDef):
+                    # methods scope under the class name but close over
+                    # the class's enclosing function scope
+                    class_parent = parent
+                    for sub in ast.iter_child_nodes(child):
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            qual_head = (
+                                f"{class_parent.qualname}."
+                                if class_parent
+                                else ""
+                            )
+                            info = FunctionInfo(
+                                node=sub,
+                                name=sub.name,
+                                qualname=f"{qual_head}{child.name}.{sub.name}",
+                                parent=class_parent,
+                            )
+                            self.functions.append(info)
+                            self._fn_by_node[id(sub)] = info
+                            visit(sub, info)
+                        else:
+                            visit(sub, class_parent)
+                else:
+                    scope = parent
+                    if isinstance(child, ast.Assign) and len(
+                        child.targets
+                    ) == 1 and isinstance(child.targets[0], ast.Name):
+                        tgt = child.targets[0].id
+                        if scope is not None:
+                            scope.assignments[tgt] = child.value
+                            if isinstance(child.value, ast.Lambda):
+                                scope.local_defs[tgt] = child.value
+                        elif isinstance(child.value, ast.Lambda):
+                            self._module_defs[tgt] = child.value
+                    visit(child, parent)
+
+        self._module_defs: dict[str, ast.AST] = {}
+        visit(self.tree, None)
+
+    def scope_of(self, node: ast.AST) -> Optional[FunctionInfo]:
+        """Innermost enclosing function scope of ``node`` (by parent
+        walk), or None at module level."""
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            info = self._fn_by_node.get(id(cur))
+            if info is not None:
+                return info
+            cur = self.parents.get(id(cur))
+        return None
+
+    def lookup_def(
+        self, name: str, scope: Optional[FunctionInfo]
+    ) -> Optional[ast.AST]:
+        """Resolve ``name`` to a function/lambda def, innermost-out."""
+        while scope is not None:
+            if name in scope.local_defs:
+                return scope.local_defs[name]
+            scope = scope.parent
+        return self._module_defs.get(name)
+
+    def lookup_assignment(
+        self, name: str, scope: Optional[FunctionInfo]
+    ) -> Optional[ast.expr]:
+        """The expression last bound to ``name``, innermost-out."""
+        while scope is not None:
+            if name in scope.assignments:
+                return scope.assignments[name]
+            scope = scope.parent
+        return None
+
+    # -- traced-function set ---------------------------------------------
+
+    def _seed_traced(self) -> tuple[set[int], set[int]]:
+        traced: set[int] = set()
+        jit_handed: set[int] = set()
+        self._host_escaped: set[int] = set()
+
+        def note(
+            fn_node: Optional[ast.AST], *, jit: bool, into: set[int] = None
+        ) -> None:
+            if fn_node is None:
+                return
+            fn_node = self.unwrap_partial(fn_node)
+            if isinstance(fn_node, ast.Name):
+                target = self.lookup_def(fn_node.id, self.scope_of(fn_node))
+                if target is None:
+                    return
+                fn_node = target
+            if isinstance(
+                fn_node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                if into is not None:
+                    into.add(id(fn_node))
+                    return
+                traced.add(id(fn_node))
+                if jit:
+                    jit_handed.add(id(fn_node))
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                canon = self.resolve_call(node)
+                if canonical_matches(canon, CALLBACK_ESCAPES):
+                    # the payload runs on the HOST: never treat it (or
+                    # its lexical children) as traced
+                    for arg in node.args:
+                        note(arg, jit=False, into=self._host_escaped)
+                    continue
+                attr_tail = (
+                    "." + node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else None
+                )
+                for pat, idxs in TRACING_ENTRIES.items():
+                    hit = canonical_matches(canon, (pat,)) or (
+                        pat.startswith(".") and attr_tail == pat
+                    )
+                    if not hit:
+                        continue
+                    is_jit = pat in JIT_ENTRIES
+                    candidates = [
+                        node.args[i] for i in idxs if i < len(node.args)
+                    ]
+                    # keyword form (scan(f=body, ...), jit(fun=step)):
+                    # note() only registers values that resolve to a
+                    # def/lambda, so sweeping every keyword is safe
+                    candidates.extend(kw.value for kw in node.keywords)
+                    for arg in candidates:
+                        if isinstance(arg, (ast.List, ast.Tuple)):
+                            for elt in arg.elts:
+                                note(elt, jit=is_jit)
+                        else:
+                            note(arg, jit=is_jit)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    # @functools.partial(jax.jit, ...) → the jax.jit
+                    # Name/Attribute; @jax.custom_vjp stays as-is
+                    target = self.unwrap_partial(dec)
+                    if isinstance(target, ast.Call):
+                        target = target.func
+                    canon = self.resolve(target)
+                    if canonical_matches(
+                        canon, tuple(TRACING_ENTRIES)
+                    ):
+                        traced.add(id(node))
+                        if canonical_matches(canon, JIT_ENTRIES):
+                            jit_handed.add(id(node))
+        return traced, jit_handed
+
+    def _close_traced(self, traced: set[int]) -> set[int]:
+        """Fixed point: lexical children of traced functions are traced;
+        so are same-module functions a traced function calls by name."""
+        escaped = getattr(self, "_host_escaped", set())
+        traced -= escaped
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions:
+                if id(info.node) in traced or id(info.node) in escaped:
+                    continue
+                if info.parent is not None and id(info.parent.node) in traced:
+                    traced.add(id(info.node))
+                    changed = True
+            for info in self.functions:
+                if id(info.node) not in traced:
+                    continue
+                for sub in self.walk_scope(info.node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if isinstance(sub.func, ast.Name):
+                        target = self.lookup_def(sub.func.id, info)
+                        if target is not None and id(target) not in traced:
+                            traced.add(id(target))
+                            changed = True
+        return traced
+
+    @property
+    def traced_functions(self) -> set[int]:
+        if self._traced is None:
+            seeds, jit_handed = self._seed_traced()
+            self._jit_handed = jit_handed
+            self._traced = self._close_traced(set(seeds))
+        return self._traced
+
+    @property
+    def jit_handed_functions(self) -> set[int]:
+        if self._jit_handed is None:
+            self.traced_functions  # computes both
+        return self._jit_handed or set()
+
+    def walk_scope(self, fn_node: ast.AST) -> Iterator[ast.AST]:
+        """Walk ``fn_node``'s body without descending into nested
+        function/lambda scopes or host-callback escape arguments."""
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call) and canonical_matches(
+                self.resolve_call(node), CALLBACK_ESCAPES
+            ):
+                yield node
+                continue  # args run on the host
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- closure analysis (symtable) -------------------------------------
+
+    def _symtable_lookup(self, fn_node: ast.AST):
+        if self._symtable_index is None:
+            index: dict[tuple[str, int], list] = {}
+
+            def walk(table) -> None:
+                for child in table.get_children():
+                    index.setdefault(
+                        (child.get_name(), child.get_lineno()), []
+                    ).append(child)
+                    walk(child)
+
+            try:
+                walk(symtable.symtable(self.source, self.path, "exec"))
+            except SyntaxError:  # already caught at parse; belt+braces
+                pass
+            self._symtable_index = index
+        name = getattr(fn_node, "name", "lambda")
+        hits = self._symtable_index.get((name, fn_node.lineno), [])
+        return hits[0] if hits else None
+
+    def free_variables(self, fn_node: ast.AST) -> set[str]:
+        """Names ``fn_node`` reads from enclosing *function* scopes
+        (closure cells). Module globals and builtins are not free —
+        exactly CPython's definition, via :mod:`symtable`."""
+        table = self._symtable_lookup(fn_node)
+        if table is None:
+            return set()
+        return {s.get_name() for s in table.get_symbols() if s.is_free()}
+
+
+# -- driver --------------------------------------------------------------
+
+
+def _engine_findings(ctx: FileContext) -> list[Finding]:
+    """D9D000: suppression-comment discipline (reason mandatory)."""
+    out = []
+    for sup in ctx.suppressions.values():
+        if not sup.reason:
+            out.append(
+                Finding(
+                    rule="D9D000",
+                    path=ctx.path,
+                    line=sup.line,
+                    col=0,
+                    message=(
+                        "suppression without a reason: write "
+                        "'# d9d-lint: disable=RULE — why this site is "
+                        "exempt'"
+                    ),
+                )
+            )
+    return out
+
+
+def lint_file(
+    root: pathlib.Path,
+    path: pathlib.Path,
+    rules: Iterable[Any],
+) -> list[Finding]:
+    """All non-suppressed findings for one file."""
+    ctx = FileContext(root, path)
+    findings = _engine_findings(ctx)
+    for rule in rules:
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(
+    root: pathlib.Path, targets: Iterable[pathlib.Path]
+) -> Iterator[pathlib.Path]:
+    seen = set()
+    for target in targets:
+        if target.is_file() and target.suffix == ".py":
+            if target not in seen:
+                seen.add(target)
+                yield target
+        elif target.is_dir():
+            for p in sorted(target.rglob("*.py")):
+                if "__pycache__" in p.parts or p in seen:
+                    continue
+                seen.add(p)
+                yield p
+
+
+def lint_paths(
+    root: pathlib.Path,
+    targets: Iterable[pathlib.Path],
+    rules: Iterable[Any],
+    on_error: Optional[Callable[[LintError], None]] = None,
+) -> list[Finding]:
+    """Lint every .py file under ``targets``; unparseable files raise
+    unless ``on_error`` swallows them."""
+    findings: list[Finding] = []
+    rules = list(rules)
+    live_targets = []
+    for target in targets:
+        # a typo'd target must NOT read as "clean": missing paths and
+        # non-Python file targets are errors, not empty scans
+        target = pathlib.Path(target)
+        err = None
+        if not target.exists():
+            err = LintError(f"{target}: no such file or directory")
+        elif target.is_file() and target.suffix != ".py":
+            err = LintError(f"{target}: not a Python file")
+        if err is not None:
+            if on_error is None:
+                raise err
+            on_error(err)
+            continue
+        live_targets.append(target)
+    for path in iter_python_files(root, live_targets):
+        try:
+            findings.extend(lint_file(root, path, rules))
+        except LintError as e:
+            if on_error is None:
+                raise
+            on_error(e)
+    return findings

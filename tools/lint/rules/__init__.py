@@ -1,0 +1,23 @@
+"""Rule registry. Each rule module exports one Rule class with a
+``rule_id``, a one-line ``summary``, and ``check(ctx) -> Iterator[
+Finding]`` over a parsed :class:`~tools.lint.engine.FileContext`."""
+
+from tools.lint.rules.d9d001_bare_jit import BareJitRule
+from tools.lint.rules.d9d002_jit_closure import JitClosureRule
+from tools.lint.rules.d9d003_host_sync import HostSyncRule
+from tools.lint.rules.d9d004_uncommitted_init import UncommittedInitRule
+from tools.lint.rules.d9d005_nondeterminism import NondeterminismRule
+from tools.lint.rules.d9d006_telemetry_names import TelemetryNamesRule
+
+ALL_RULES = (
+    BareJitRule,
+    JitClosureRule,
+    HostSyncRule,
+    UncommittedInitRule,
+    NondeterminismRule,
+    TelemetryNamesRule,
+)
+
+RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"]

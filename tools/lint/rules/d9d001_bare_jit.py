@@ -1,0 +1,58 @@
+"""D9D001: bare ``jax.jit`` in hot-path modules.
+
+Invariant: every steady-state executable in the loop/PP/ops layers is
+built through ``tracked_jit`` (telemetry/introspect.py) so it shows up
+in the compile accounting, the recompile guard, and the per-executable
+HBM inventory. A bare ``jax.jit`` there is a blind spot: its recompiles
+never trip ``compile/recompile`` and its HBM claim never reaches the
+``hbm/*`` gauges. Historical bug: the PR 6 guard only catches what it
+wraps — the PR 8 publish-recompile would have been invisible had the
+serve step stayed on bare jit.
+
+Cold init/export sites inside hot modules (one-shot ``jit(init)``,
+checkpoint/export helpers) are suppressed inline with a reason, not
+exempted wholesale — the suppression documents WHY the site may stay
+cold.
+"""
+
+import ast
+from typing import Iterator
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding, canonical_matches
+
+
+class BareJitRule:
+    rule_id = "D9D001"
+    summary = "bare jax.jit in hot-path modules (must be tracked_jit)"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        if not any(ctx.path.startswith(p) for p in config.HOT_JIT_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                if canonical_matches(ctx.resolve_call(node), ("jax.jit",)):
+                    yield cls._finding(ctx, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = ctx.unwrap_partial(dec)
+                    if isinstance(target, ast.Call):
+                        target = target.func
+                    if canonical_matches(ctx.resolve(target), ("jax.jit",)):
+                        yield cls._finding(ctx, dec)
+
+    @staticmethod
+    def _finding(ctx: FileContext, node: ast.AST) -> Finding:
+        return Finding(
+            rule=BareJitRule.rule_id,
+            path=ctx.path,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                "bare jax.jit in a hot-path module: use tracked_jit("
+                "fn, name=...) so the executable is visible to the "
+                "recompile guard and HBM inventory, or suppress with a "
+                "reason if this site is cold (init/export)"
+            ),
+        )

@@ -1,0 +1,108 @@
+"""D9D002: functions handed to jit must not close over param/array-
+valued names.
+
+Invariant: weights reach a jitted executable as *traced arguments*,
+never as closure captures. A captured tree is baked into the compiled
+program as a constant: it silently pins the weights the executable
+uses (a later ``install_weights`` either recompiles — the PR 8 bug
+class — or worse, keeps serving the stale tree), and it bloats the
+executable with embedded constants the HBM inventory attributes to
+generated code.
+
+Detection (lightweight, intra-module): for every function handed to
+``jax.jit``/``tracked_jit``, take its true closure cells (via
+:mod:`symtable` — module globals are not free variables) and flag
+
+- free names matching the param-name pattern (``params``, ``weights``,
+  ``opt_state``, ...);
+- free names whose enclosing-scope binding is an attribute whose tail
+  matches the pattern (``p = self._params``) or a call into an array
+  producer (``jax.numpy.*``, ``jax.random.*``, ``jax.device_put``);
+- attribute reads ``<free>.<param-attr>`` inside the jitted body
+  (``self._params`` with ``self`` captured) — the exact install_weights
+  shape.
+
+Scan bodies and other traced-but-not-jitted closures are exempt: they
+re-trace with their enclosing jit, so their captures refresh.
+"""
+
+import ast
+from typing import Iterator
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding
+
+
+class JitClosureRule:
+    rule_id = "D9D002"
+    summary = "jit-handed function closes over param/array-valued name"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        for info in ctx.functions:
+            if id(info.node) not in ctx.jit_handed_functions:
+                continue
+            free = ctx.free_variables(info.node)
+            if not free:
+                continue
+            flagged: set[str] = set()
+            for name in sorted(free):
+                # a free name that resolves to a def is a helper fn
+                if ctx.lookup_def(name, info.parent) is not None:
+                    continue
+                reason = cls._classify(ctx, info, name)
+                if reason:
+                    flagged.add(name)
+                    yield Finding(
+                        rule=cls.rule_id,
+                        path=ctx.path,
+                        line=info.node.lineno,
+                        col=info.node.col_offset,
+                        message=(
+                            f"function {info.qualname!r} is handed to jit "
+                            f"but closes over {name!r} ({reason}): it will "
+                            "be baked into the executable as a constant — "
+                            "pass it as a traced argument instead"
+                        ),
+                    )
+            # <free>.<param_attr> reads inside the jitted body
+            for node in ctx.walk_scope(info.node):
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in free
+                    and node.value.id not in flagged
+                    and config.PARAM_NAME_RE.search(node.attr)
+                ):
+                    continue
+                yield Finding(
+                    rule=cls.rule_id,
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"jit-handed function {info.qualname!r} reads "
+                        f"{node.value.id}.{node.attr} through a closure: "
+                        "the tree is baked into the executable as a "
+                        "constant (install/publish forces a recompile) — "
+                        "pass it as a traced argument"
+                    ),
+                )
+
+    @staticmethod
+    def _classify(ctx: FileContext, info, name: str) -> str:
+        if config.PARAM_NAME_RE.search(name):
+            return "param-valued by name"
+        bound = ctx.lookup_assignment(name, info.parent)
+        if bound is None:
+            return ""
+        if isinstance(bound, ast.Attribute) and config.PARAM_NAME_RE.search(
+            bound.attr
+        ):
+            return f"assigned from .{bound.attr}"
+        if isinstance(bound, ast.Call):
+            canon = ctx.resolve_call(bound) or ""
+            for prefix in config.ARRAY_PRODUCER_PREFIXES:
+                if canon.startswith(prefix):
+                    return f"array produced by {canon}"
+        return ""

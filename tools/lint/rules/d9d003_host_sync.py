@@ -1,0 +1,155 @@
+"""D9D003: host syncs inside registered hot scopes.
+
+Invariant: the serve chunk loop, the train-step path and the PP
+per-microbatch executor run **one dispatch + one readback** per unit
+of work; everything else stays in XLA's async stream. A stray
+``.item()`` / ``np.asarray(device_value)`` / ``device_get`` /
+``block_until_ready`` inside those scopes stalls the host against the
+device and silently serializes the pipeline — the dispatch-tax class
+the fused-K serving rewrite (PR 1) and the ZB executor fight.
+Historical anchor: serving is 9.9–18.7× cheaper in dispatches exactly
+because these loops hold that line.
+
+The *accounted* readbacks (the one ``np.asarray(toks_d)`` per chunk,
+the one ``[B]`` readback per legacy token) carry inline suppressions
+naming themselves — the rule is what keeps a second one from
+appearing.
+
+Heuristics (documented limits): ``np.asarray``/``np.array`` are only
+syncs when fed a device value, so they're flagged when their argument
+is a name the function assigned from a call (the readback shape) —
+host-list marshalling (``np.asarray([s.pos for ...])``) stays clean.
+``float()/int()/bool()`` casts are flagged only on names assigned from
+``jax.*`` calls; casts of already-host numpy scalars stay clean.
+"""
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding, canonical_matches
+
+
+def _hot_scope_patterns(path: str) -> list[re.Pattern]:
+    # (\.|$): a registered scope covers its nested local helpers too —
+    # wrapping a readback in a `def fetch()` inside the hot loop must
+    # not take it out of the rule's reach
+    return [
+        re.compile(rx + r"(\.|$)")
+        for prefix, rx in config.HOT_SYNC_SCOPES
+        if path.startswith(prefix)
+    ]
+
+
+class HostSyncRule:
+    rule_id = "D9D003"
+    summary = "host sync inside a registered hot scope"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        patterns = _hot_scope_patterns(ctx.path)
+        if not patterns:
+            return
+        for info in ctx.functions:
+            if not any(p.match(info.qualname) for p in patterns):
+                continue
+            # per-scope dataflow: names assigned from calls (possible
+            # readbacks) and names assigned from jax.* (device values)
+            from_call: set[str] = set()
+            device_valued: set[str] = set()
+            for node in ctx.walk_scope(info.node):
+                if isinstance(node, ast.Assign):
+                    cls._note_assign(ctx, node, from_call, device_valued)
+            for node in ctx.walk_scope(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                finding = cls._check_call(
+                    ctx, info, node, from_call, device_valued
+                )
+                if finding is not None:
+                    yield finding
+
+    @staticmethod
+    def _note_assign(ctx, node, from_call, device_valued) -> None:
+        targets = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, ast.Tuple):
+                targets.extend(
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                )
+        if not targets:
+            return
+        if isinstance(node.value, ast.Call):
+            canon = ctx.resolve_call(node.value) or ""
+            if canon.startswith("numpy."):
+                return  # numpy result: already host
+            from_call.update(targets)
+            if any(
+                canon.startswith(p)
+                for p in config.DEVICE_PRODUCER_PREFIXES
+            ):
+                device_valued.update(targets)
+
+    @classmethod
+    def _check_call(
+        cls, ctx, info, node, from_call, device_valued
+    ) -> Optional[Finding]:
+        canon = ctx.resolve_call(node)
+        attr_tail = (
+            "." + node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else None
+        )
+        if canonical_matches(canon, config.SYNC_CALLS) or (
+            attr_tail in config.SYNC_CALLS
+        ):
+            what = canon or attr_tail
+            return cls._finding(
+                ctx, info, node,
+                f"{what} is a host-device sync",
+            )
+        if canonical_matches(canon, config.NUMPY_MATERIALIZERS):
+            if node.args and isinstance(node.args[0], ast.Name) and (
+                node.args[0].id in from_call
+            ):
+                return cls._finding(
+                    ctx, info, node,
+                    f"{canon}({node.args[0].id}) materializes a value "
+                    "that came out of a call — a device readback here "
+                    "blocks the loop",
+                )
+            return None
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in config.CAST_NAMES
+            and node.func.id not in ctx.aliases
+            and node.args
+        ):
+            inner = node.args[0]
+            while isinstance(inner, (ast.Subscript, ast.Attribute)):
+                inner = inner.value
+            if isinstance(inner, ast.Name) and inner.id in device_valued:
+                return cls._finding(
+                    ctx, info, node,
+                    f"{node.func.id}() on device value "
+                    f"{inner.id!r} forces a blocking readback",
+                )
+        return None
+
+    @staticmethod
+    def _finding(ctx, info, node, detail: str) -> Finding:
+        return Finding(
+            rule=HostSyncRule.rule_id,
+            path=ctx.path,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"host sync in hot scope {info.qualname!r}: {detail}. "
+                "Registered hot scopes run one dispatch + one readback "
+                "per unit of work; move this off the loop or suppress "
+                "it as THE accounted readback with a reason"
+            ),
+        )

@@ -1,0 +1,98 @@
+"""D9D004: persistent state initialized under jit without committed
+placement.
+
+Invariant: a ``jax.jit(init)(...)`` result whose output shardings are
+unconstrained leaves scalar leaves (Adam's ``count``, RNG keys)
+*uncommitted* on one device. The placement round-trips through a
+checkpoint as a committed single-device placement that conflicts with
+the mesh-placed params at the first post-restore step — the PR 5
+resume bug. Every immediate ``jit(f)(...)`` call must therefore either
+
+- pass explicit ``out_shardings=`` to the jit, or
+- flow through ``replicate_uncommitted(...)`` (core/tree_sharding)
+  before being kept — directly as an argument, or via the assigned
+  name later in the same scope.
+
+``tracked_jit`` immediate calls are held to the same contract.
+"""
+
+import ast
+from typing import Iterator
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding, canonical_matches
+
+_JIT_NAMES = ("jax.jit", ".tracked_jit")
+
+
+class UncommittedInitRule:
+    rule_id = "D9D004"
+    summary = "jit(init)() result kept without replicate_uncommitted/out_shardings"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            # the immediate-invocation shape: Call(func=Call(jit, ...))
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and canonical_matches(
+                    ctx.resolve_call(node.func), _JIT_NAMES
+                )
+            ):
+                continue
+            jit_call = node.func
+            if any(kw.arg == "out_shardings" for kw in jit_call.keywords):
+                continue
+            if cls._normalized(ctx, node):
+                continue
+            yield Finding(
+                rule=cls.rule_id,
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    "state initialized under jit without committed "
+                    "placement: uncommitted scalar leaves round-trip a "
+                    "checkpoint as a conflicting single-device placement "
+                    "— wrap in replicate_uncommitted(...) or pass "
+                    "out_shardings= to the jit"
+                ),
+            )
+
+    @classmethod
+    def _normalized(cls, ctx: FileContext, node: ast.Call) -> bool:
+        # (a) directly an argument of replicate_uncommitted(...)
+        cur = node
+        parent = ctx.parents.get(id(cur))
+        while parent is not None and isinstance(
+            parent, (ast.Call, ast.Tuple, ast.List, ast.Starred, ast.keyword)
+        ):
+            if isinstance(parent, ast.Call) and canonical_matches(
+                ctx.resolve_call(parent), config.PLACEMENT_NORMALIZERS
+            ):
+                return True
+            cur = parent
+            parent = ctx.parents.get(id(cur))
+        # (b) assigned to a name that is later handed to a normalizer
+        #     in the same function scope
+        parent = ctx.parents.get(id(node))
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            target = parent.targets[0]
+            if isinstance(target, ast.Name):
+                scope = ctx.scope_of(node)
+                scope_node = scope.node if scope is not None else ctx.tree
+                for sub in ast.walk(scope_node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and canonical_matches(
+                            ctx.resolve_call(sub),
+                            config.PLACEMENT_NORMALIZERS,
+                        )
+                        and any(
+                            isinstance(a, ast.Name) and a.id == target.id
+                            for a in sub.args
+                        )
+                    ):
+                        return True
+        return False

@@ -1,0 +1,57 @@
+"""D9D005: nondeterminism sources inside traced functions.
+
+Invariant: traced programs are pure functions of their arguments —
+randomness flows through threaded ``jax.random`` keys, time through
+host-side telemetry. A ``time.time()`` / ``random.*`` / ``np.random.*``
+call inside a traced function is constant-folded at TRACE time: the
+value is frozen into the executable, every subsequent call replays it,
+and re-tracing (new shapes, resumed process) silently changes it.
+That breaks the deterministic chaos harness (docs/design/resilience.md
+— fault injection must replay bit-identically) and the token-identity
+contracts the serving tests pin.
+
+The traced set is the engine's fixed point: functions handed to
+jit/tracked_jit/scan/cond/grad/pallas_call/..., their lexical
+children, and same-module functions they call. Host-callback escapes
+(``jax.pure_callback``/``io_callback``/``debug.callback``) are pruned
+— their payload legitimately runs on the host.
+"""
+
+import ast
+from typing import Iterator
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding, canonical_matches
+
+
+class NondeterminismRule:
+    rule_id = "D9D005"
+    summary = "nondeterminism source inside a traced function"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        traced = ctx.traced_functions
+        if not traced:
+            return
+        for info in ctx.functions:
+            if id(info.node) not in traced:
+                continue
+            for node in ctx.walk_scope(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = ctx.resolve_call(node)
+                if canonical_matches(canon, config.NONDETERMINISM_CALLS):
+                    yield Finding(
+                        rule=cls.rule_id,
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"{canon} inside traced function "
+                            f"{info.qualname!r}: the value is frozen at "
+                            "trace time and replayed every call — thread "
+                            "a jax.random key / pass the value as an "
+                            "argument (deterministic chaos harness "
+                            "contract)"
+                        ),
+                    )

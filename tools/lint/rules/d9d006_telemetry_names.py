@@ -1,0 +1,126 @@
+"""D9D006: telemetry name discipline.
+
+Invariant: every counter/gauge/histogram/span name registered in code
+is covered by the namespace tables in
+``docs/design/observability.md``. The doc is the operator contract —
+dashboards, PromQL aggregations and ``tools/trace_summary.py`` are
+written against it, so a name that exists only in code is invisible to
+operations and a name that exists only in the doc is a lie (the PR 10
+``serve/kv_*`` / ``serve/prefix_cache_*`` gauges were exactly this
+drift before this rule landed).
+
+Matching: literal names must match a documented name or template
+(``{placeholder}`` = one path segment, ``*``/``...`` = any suffix).
+F-string names are probed with ``r0`` substituted for each
+interpolated field — ``f"slo/{p.name}/burn"`` probes as
+``slo/r0/burn`` against ``slo/{policy}/burn``. The probe is a static
+approximation: a runtime value containing ``/`` can still escape a
+single-segment template (that's the path-free-label rule below, and a
+runtime concern beyond it).
+
+Also enforced: the path-free-label rule from PR 9 — literal replica
+labels (``set_replica_label(...)`` / ``replica_label=``) must not
+contain ``/``, or they escape the ``serve/{label}/...`` folding in
+``/metrics`` and trace_summary's tables.
+"""
+
+import ast
+from typing import Iterator, Optional
+
+from tools.lint import config
+from tools.lint.docnames import load_doc_namespace
+from tools.lint.engine import FileContext, Finding
+
+_PROBE = "r0"
+
+
+def _name_or_probe(node: ast.expr) -> Optional[str]:
+    """The literal name, or an f-string probed with ``r0`` per field;
+    None when the argument isn't statically resolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append(_PROBE)
+        return "".join(parts)
+    return None
+
+
+class TelemetryNamesRule:
+    rule_id = "D9D006"
+    summary = "telemetry name not covered by the observability.md tables"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        doc = load_doc_namespace(str(ctx.root / config.OBSERVABILITY_DOC))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            yield from cls._check_label(ctx, node)
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in config.INSTRUMENT_CALL_ATTRS
+                and node.args
+            ):
+                continue
+            receiver = node.func.value
+            if (
+                isinstance(receiver, ast.Name)
+                and receiver.id in config.INSTRUMENT_RECEIVER_DENYLIST
+            ):
+                continue
+            raw = _name_or_probe(node.args[0])
+            if raw is None or "/" not in raw:
+                # variable-named or non-namespaced (unit-test locals):
+                # out of static reach / out of the doc's contract
+                continue
+            if doc.covers(raw) or raw in config.EXTRA_ALLOWED_METRIC_NAMES:
+                continue
+            yield Finding(
+                rule=cls.rule_id,
+                path=ctx.path,
+                line=node.args[0].lineno,
+                col=node.args[0].col_offset,
+                message=(
+                    f"telemetry name {raw!r} is not covered by the "
+                    f"namespace tables in {config.OBSERVABILITY_DOC} — "
+                    "add it to the owning row (the doc is the operator "
+                    "contract) or fix the name"
+                ),
+            )
+
+    @classmethod
+    def _check_label(cls, ctx: FileContext, node: ast.Call) -> Iterator[Finding]:
+        candidates: list[ast.expr] = []
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in config.LABEL_CALL_NAMES
+            and node.args
+        ):
+            candidates.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg in config.LABEL_KWARGS:
+                candidates.append(kw.value)
+        for arg in candidates:
+            if (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and "/" in arg.value
+            ):
+                yield Finding(
+                    rule=cls.rule_id,
+                    path=ctx.path,
+                    line=arg.lineno,
+                    col=arg.col_offset,
+                    message=(
+                        f"replica label {arg.value!r} contains '/': labels "
+                        "become one path segment of serve/{label}/... and "
+                        "a slash escapes the /metrics replica folding and "
+                        "trace_summary aggregation (path-free-label rule, "
+                        "PR 9)"
+                    ),
+                )
