@@ -128,6 +128,15 @@ from d9d_tpu.telemetry import get_telemetry, tracked_jit
 # slot-occupancy fraction per chunk/step: 20 linear bins over [0, 1]
 _UTIL_EDGES = tuple(i / 20 for i in range(21))
 
+# tokens-per-completed-request distribution: 1 .. 4096 tokens, log bins.
+# A generation-quality canary signal (docs/design/elasticity.md "SLO
+# autopilot"): a bad weight publish that stops hitting EOS shows up as
+# this distribution jumping to the budget ceiling on the canary replica
+# long before any latency SLO moves.
+_REQ_TOKENS_EDGES = tuple(
+    1.0 * (4096.0 ** (i / 24)) for i in range(25)
+)
+
 # per-request trace ids (docs/design/observability.md): pid + a process
 # counter — unique across a multi-process fleet without coordination,
 # deterministic within one process (chaos tests assert exact sequences)
@@ -177,6 +186,11 @@ class _Request:
     max_new_tokens: int
     deadline_t: float | None = None
     trace_id: str | None = None
+    # admission tier (docs/design/elasticity.md "SLO autopilot"): higher
+    # = more important. Admission itself stays FIFO (token-identity
+    # contract); priority is what burn-driven shedding orders on —
+    # lowest priority / longest deadline sheds first.
+    priority: int = 0
 
     @property
     def total_tokens(self) -> int:
@@ -266,9 +280,11 @@ class ServeStats:
     slot_steps_busy: int = 0
     slot_steps_total: int = 0
     # degraded-mode counters: submits rejected by the bounded queue,
-    # requests expired by their deadline (queued or running)
+    # requests expired by their deadline (queued or running), requests
+    # shed by the autopilot's burn-driven admission tiering
     rejected: int = 0
     expired: int = 0
+    shed: int = 0
 
     def reset(self) -> None:
         for f in dataclasses.fields(self):
@@ -922,6 +938,7 @@ class ContinuousBatcher:
         max_new_tokens: int,
         deadline_s: Optional[float] = None,
         trace_id: Optional[str] = None,
+        priority: int = 0,
     ) -> int:
         """Queue a request; returns its request id. Admission happens at
         the next step/chunk boundary with a free slot.
@@ -932,6 +949,14 @@ class ContinuousBatcher:
         lands in ``failed[rid] == "deadline"``). With ``max_queue``
         configured, a full queue rejects with :class:`QueueFullError`
         before a rid is allocated.
+
+        ``priority`` is the admission tier (higher = more important).
+        It does NOT reorder admission (FIFO — the token-identity
+        contract); it orders burn-driven shedding: while an SLO policy
+        burns, the fleet autopilot retires the lowest-priority /
+        longest-deadline queued requests first (:meth:`cancel_queued`,
+        ``failed[rid] == "shed"``) instead of failing traffic uniformly
+        at the front door.
 
         ``trace_id`` carries an existing per-request trace id (the fleet
         mints one at ITS front door and re-submits with it across
@@ -1009,6 +1034,7 @@ class ContinuousBatcher:
             rid, prompt, max_new_tokens,
             deadline_t=now + deadline_s if deadline_s is not None else None,
             trace_id=trace_id,
+            priority=int(priority),
         ))
         self.outputs[rid] = []
         self.request_stats[rid] = RequestTelemetry(
@@ -1165,6 +1191,23 @@ class ContinuousBatcher:
             return
         self._fail(rid, reason, time.perf_counter())
 
+    def cancel_queued(self, rid: int, reason: str = "shed") -> bool:
+        """Remove a still-QUEUED (never-admitted) request and retire it
+        as an explicit failure (``failed[rid] = reason``, observable
+        empty output) — the autopilot's shed surface. Returns False
+        when ``rid`` is not in the queue (already admitted, finished,
+        or unknown): an in-flight request is never yanked mid-decode;
+        the caller decides what to do instead."""
+        for req in self._queue:
+            if req.rid == rid:
+                self._queue.remove(req)
+                if self._paged:
+                    self._kv.forget(rid)  # drop any admission memo
+                self._fail(rid, reason, time.perf_counter())
+                self._gauge_set("serve/queued", len(self._queue))
+                return True
+        return False
+
     # ------------------------------------------------------------------
     # paged KV bookkeeping (loop/kv_paging.py): all host work, all at
     # the existing chunk boundaries — the dispatch/readback contract and
@@ -1286,6 +1329,9 @@ class ContinuousBatcher:
         tpot = rec.tpot_s
         if tpot is not None:
             self._observe("serve/tpot_s", tpot)
+        self._observe(
+            "serve/request_tokens", float(rec.tokens), _REQ_TOKENS_EDGES
+        )
         self._count("serve/requests_finished")
         self._trace(
             rec.trace_id, "finish", now, rid=rid,
@@ -1318,10 +1364,15 @@ class ContinuousBatcher:
             self._kv.abort_filling(rid)
         # accounting keyed on the reason: "expired" means deadline
         # expiry and nothing else (the degraded-mode signal operators
-        # alert on); other retirements (fleet shrink) count separately
+        # alert on); "shed" is the autopilot's deliberate load-shedding
+        # (its own alertable signal — shed traffic is policy, not a
+        # fault); other retirements (fleet shrink) count serve/failed
         if reason == "deadline":
             self.stats.expired += 1
             self._count("serve/expired")
+        elif reason == "shed":
+            self.stats.shed += 1
+            self._count("serve/shed")
         else:
             self._count("serve/failed")
         rec = self.request_stats.get(rid)

@@ -32,6 +32,13 @@ single-controller host (docs/design/resilience.md):
   train→serve weight publish (:class:`WeightPublisher`), and
   preemption-driven serving-fleet shrink/grow (:class:`ServingFleet`).
   The fleet/publisher import the serve surface lazily.
+- :mod:`~d9d_tpu.resilience.autopilot` — the SLO autopilot
+  (docs/design/elasticity.md "SLO autopilot"): a burn-rate-driven
+  control loop (:class:`FleetAutopilot`) connecting the monitoring
+  plane's senses to the fleet's actuators — autoscaling with
+  hysteresis, priority-tiered admission shedding under burn, and
+  canaried weight publish with automatic rollback, every action
+  decision-logged and flight-recorded.
 
 Exit-code contract (see docs/design/resilience.md):
 
@@ -49,6 +56,12 @@ from d9d_tpu.resilience.anomaly import (
     ANOMALY_POLICIES,
     AnomalyPolicy,
     HostAnomalyGuard,
+)
+from d9d_tpu.resilience.autopilot import (
+    AutopilotConfig,
+    DecisionLog,
+    FleetAutopilot,
+    read_decisions,
 )
 from d9d_tpu.resilience.elastic import (
     ServingFleet,
@@ -76,7 +89,11 @@ from d9d_tpu.resilience.preemption import (
 __all__ = [
     "ANOMALY_POLICIES",
     "AnomalyPolicy",
+    "AutopilotConfig",
+    "DecisionLog",
+    "FleetAutopilot",
     "HostAnomalyGuard",
+    "read_decisions",
     "MANIFEST_NAME",
     "CheckpointIntegrityError",
     "ManifestVersionError",

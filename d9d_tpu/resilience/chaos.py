@@ -27,6 +27,12 @@ timing races — so a chaos test asserts exact recovery behavior, not
 - :func:`kill_replica_mid_drain` — make a replica die partway through
   its shrink drain (after an exact number of grace chunks): the fleet
   must recover its unfinished requests onto survivors.
+- :func:`ramp_arrivals` — a scripted arrival-rate ramp: phases of
+  (steps, arrivals-per-step) compiled into an exact arrival schedule.
+  Arrival *times* carry zero randomness (fractional rates are spread
+  by an error accumulator), so an overload ramp reproduces the same
+  queue depths, rejections and autopilot decisions on every run; the
+  same builder shapes ``tools/bench_serve.py`` ramp workloads.
 
 Queue overflow needs no injector: submit past ``max_queue`` and assert
 :class:`~d9d_tpu.loop.serve.QueueFullError`.
@@ -246,6 +252,54 @@ def kill_replica_mid_drain(
     requests to survivors as continuation prompts (prompt + tokens
     already emitted), losing no committed work."""
     fleet._chaos_kill = (int(replica_idx), int(after_chunks))
+
+
+def ramp_arrivals(
+    schedule,
+    *,
+    vocab: int,
+    seed: int = 0,
+    prompt_lo: int = 1,
+    prompt_hi: int = 4,
+    gen_lo: int = 2,
+    gen_hi: int = 8,
+    start_step: int = 0,
+) -> list[tuple[int, list[int], int]]:
+    """Compile a scripted arrival-rate ramp into an exact workload.
+
+    ``schedule`` is a sequence of ``(steps, rate)`` phases: for
+    ``steps`` scheduling steps, ``rate`` requests arrive per step
+    (fractional rates are spread deterministically by an error
+    accumulator — rate 0.5 lands one arrival every second step, never a
+    random draw). Returns ``[(arrival_step, prompt, max_new_tokens)]``
+    in the exact tuple shape ``tools/bench_serve.py`` workloads use, so
+    one builder drives both the autopilot chaos tests and the bench
+    harness ramp legs. Prompt contents and budgets come from the
+    seeded RNG (``prompt_hi``/``gen_hi`` exclusive, matching
+    ``make_workload``); arrival *times* carry no randomness at all.
+    """
+    rng = np.random.RandomState(seed)
+    arrivals: list[tuple[int, list[int], int]] = []
+    step = int(start_step)
+    acc = 0.0
+    for steps, rate in schedule:
+        if steps < 0 or rate < 0:
+            raise ValueError(
+                f"schedule phases need steps >= 0 and rate >= 0, got "
+                f"({steps}, {rate})"
+            )
+        for s in range(int(steps)):
+            acc += float(rate)
+            while acc >= 1.0 - 1e-9:
+                acc -= 1.0
+                prompt = rng.randint(
+                    0, vocab, rng.randint(prompt_lo, prompt_hi)
+                ).tolist()
+                arrivals.append(
+                    (step + s, prompt, int(rng.randint(gen_lo, gen_hi)))
+                )
+        step += int(steps)
+    return arrivals
 
 
 def wedge_batcher(batcher, *, seconds: float = 3600.0) -> None:

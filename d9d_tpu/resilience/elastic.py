@@ -376,6 +376,18 @@ def normalize_published_params(params: PyTree) -> PyTree:
 # live train→serve weight publish
 
 
+@dataclasses.dataclass
+class _CanaryPublish:
+    """One in-flight canary generation: the candidate tree, its version
+    stamp, and the single replica it was installed on (weakref — a dead
+    canary replica must not be pinned by the pending decision)."""
+
+    params: PyTree
+    version: int
+    target: weakref.ref
+    unix_time: float
+
+
 class WeightPublisher:
     """Fan a trainer's step-boundary param snapshot out to live serving
     replicas, generation-stamped.
@@ -384,8 +396,22 @@ class WeightPublisher:
     stages the tree into every attached batcher via
     ``ContinuousBatcher.install_weights`` — each swaps at its own next
     chunk boundary (no restart, no steady-state recompile; see
-    serve.py). The publisher retains the newest published tree so a
-    grown replica (:meth:`ServingFleet.grow`) can cold-start from it.
+    serve.py). The publisher retains the newest fleet-wide published
+    tree so a grown replica (:meth:`ServingFleet.grow`) can cold-start
+    from it.
+
+    Canaried publish (docs/design/elasticity.md "SLO autopilot"):
+    :meth:`publish_canary` installs a candidate generation on exactly
+    ONE replica and leaves :attr:`latest_params` (and every other
+    replica) on the retained prior tree — grows and restarts during the
+    canary stay on known-good weights. :meth:`promote_canary` fans the
+    candidate out fleet-wide under the same generation stamp;
+    :meth:`rollback_canary` re-installs the retained prior tree on the
+    canary replica under a fresh stamp (a rollback is itself an
+    auditable generation — two trees never share a stamp). The
+    ``FleetAutopilot`` drives the promote/rollback decision from the
+    canary replica's per-replica SLO deltas; a plain :meth:`publish`
+    while a canary is pending supersedes (clears) it.
 
     Batchers are held by weakref: a retired replica must not be pinned
     (with its device cache) by the publish fan-out list.
@@ -395,30 +421,43 @@ class WeightPublisher:
         self._targets: list[weakref.ref] = []
         self._tele = telemetry if telemetry is not None else get_telemetry()
         self.version = 0
+        # version stamp of latest_params — diverges from ``version``
+        # while a canary is pending (the canary takes a stamp without
+        # becoming the fleet-wide tree until promoted)
+        self.latest_version = 0
         self.latest_params: PyTree | None = None
+        self.canary: _CanaryPublish | None = None
 
     def attach(self, batcher) -> None:
         self._targets.append(weakref.ref(batcher))
 
+    def _live_targets(self) -> list[weakref.ref]:
+        live = [ref for ref in self._targets if ref() is not None]
+        self._targets = live
+        return live
+
     def publish(self, params: PyTree, *, defer_to_idle: bool = False) -> int:
         """Install ``params`` into every live attached batcher; returns
         the new generation number. ``defer_to_idle`` asks each batcher
-        to hold the swap until its in-flight requests finish."""
+        to hold the swap until its in-flight requests finish. A pending
+        canary is superseded: the fleet converges on THIS generation
+        and the autopilot abandons the stale decision."""
         params = normalize_published_params(params)
         self.version += 1
+        self.latest_version = self.version
         self.latest_params = params
-        live = []
-        for ref in self._targets:
+        self.canary = None
+        fanned = 0
+        for ref in self._live_targets():
             b = ref()
-            if b is None:
+            if b is None:  # died between the liveness scan and here
                 continue
-            live.append(ref)
             b.install_weights(
                 params, version=self.version, defer_to_idle=defer_to_idle
             )
-        self._targets = live
-        if live:
-            self._tele.counter("serve/weight_publish_fanout").add(len(live))
+            fanned += 1
+        if fanned:
+            self._tele.counter("serve/weight_publish_fanout").add(fanned)
         return self.version
 
     def publish_from(self, trainer, **kwargs) -> int:
@@ -426,6 +465,93 @@ class WeightPublisher:
         PP stages merged) and publish it. Call between trainer steps —
         the step boundary is what makes the snapshot consistent."""
         return self.publish(trainer.merged_params(), **kwargs)
+
+    # -- canaried publish (decision loop: resilience/autopilot.py) -----
+
+    def publish_canary(self, params: PyTree, *, batcher=None) -> int:
+        """Install a candidate generation on ONE replica (``batcher``,
+        or the first live attached one) and record it as the pending
+        canary; returns its generation stamp. ``latest_params`` stays
+        on the prior retained tree until :meth:`promote_canary` — the
+        rollback target is therefore always at hand, and a concurrent
+        ``grow()`` cold-starts on known-good weights.
+
+        One canary at a time: a second ``publish_canary`` while one is
+        pending raises — silently replacing it would strand the first
+        canary replica on abandoned candidate weights with nothing left
+        to roll it back. Resolve the pending one first
+        (promote/rollback, or a fleet-wide :meth:`publish`, which
+        supersedes by converging every replica on the new tree)."""
+        if self.canary is not None:
+            raise RuntimeError(
+                f"a canary (generation {self.canary.version}) is already "
+                "pending; promote/rollback it (or publish fleet-wide) "
+                "before staging another"
+            )
+        if self.latest_params is None:
+            # nothing retained = nothing to roll back to: a "canary"
+            # with no known-good prior tree is just a publish that
+            # cannot be undone — make the caller publish one first
+            raise RuntimeError(
+                "publish_canary needs a prior fleet-wide publish: the "
+                "retained tree is the rollback target"
+            )
+        params = normalize_published_params(params)
+        if batcher is None:
+            live = self._live_targets()
+            if not live:
+                raise RuntimeError(
+                    "publish_canary needs at least one live attached "
+                    "batcher (attach one, or pass batcher=)"
+                )
+            batcher = live[0]()
+        self.version += 1
+        batcher.install_weights(params, version=self.version)
+        self.canary = _CanaryPublish(
+            params=params, version=self.version,
+            target=weakref.ref(batcher), unix_time=time.time(),
+        )
+        self._tele.counter("serve/weight_canary").add(1)
+        return self.version
+
+    def promote_canary(self) -> int:
+        """Fan the pending canary generation out to every OTHER live
+        replica (the canary replica already runs it, same stamp) and
+        make it the retained fleet-wide tree; returns its version."""
+        c = self.canary
+        if c is None:
+            raise RuntimeError("no canary publish is pending")
+        self.canary = None
+        self.latest_params = c.params
+        self.latest_version = c.version
+        canary_b = c.target()
+        fanned = 0
+        for ref in self._live_targets():
+            b = ref()
+            if b is None or b is canary_b:
+                continue
+            b.install_weights(c.params, version=c.version)
+            fanned += 1
+        if fanned:
+            self._tele.counter("serve/weight_publish_fanout").add(fanned)
+        return c.version
+
+    def rollback_canary(self) -> int:
+        """Re-install the retained prior tree on the canary replica
+        under a FRESH generation stamp (the audit trail must show the
+        rollback as its own generation, never reuse the bad stamp);
+        returns that stamp. A dead canary replica (killed mid-canary)
+        just clears the pending state — its device tree died with it."""
+        c = self.canary
+        if c is None:
+            raise RuntimeError("no canary publish is pending")
+        self.canary = None
+        b = c.target()
+        if b is None or self.latest_params is None:
+            return self.version
+        self.version += 1
+        b.install_weights(self.latest_params, version=self.version)
+        return self.version
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +577,9 @@ class _FleetRequest:
     # migration and kill-recovery continuation, so the request is ONE
     # continuous track however many replicas it crosses
     trace_id: str | None = None
+    # admission tier (higher = more important): what the autopilot's
+    # burn-driven shedding orders on — see ServingFleet.shed_queued
+    priority: int = 0
 
 
 class ServingFleet:
@@ -486,6 +615,10 @@ class ServingFleet:
         self._chaos_shrink: tuple[int, int] | None = None
         self._chaos_kill: tuple[int, int] | None = None
         self._rounds = 0
+        # bound by FleetAutopilot.attach (resilience/autopilot.py):
+        # polled once per scheduling round, BEFORE any chunk dispatches
+        # — the control loop acts only at this boundary cadence
+        self._autopilot = None
         # fleet-level rollup gauges (the per-replica gauges are
         # namespaced serve/r{i}/* — last-write-wins gauges cannot share
         # a name across replicas, so the fleet computes explicit sums);
@@ -580,7 +713,11 @@ class ServingFleet:
         )
 
     def replica_health(self) -> dict[str, Any]:
-        """Per-replica status block for the fleet /healthz endpoint."""
+        """Per-replica status block for the fleet /healthz endpoint —
+        with an autopilot bound, its control-loop state (burning
+        policies, pending canary, last decision) rides along so one
+        scrape explains both what the fleet looks like and what the
+        controller is about to do about it."""
         replicas = {}
         for idx, b in self._replicas.items():
             replicas[str(idx)] = {
@@ -590,11 +727,14 @@ class ServingFleet:
                 "ready": bool(getattr(b, "ready", False)),
                 "active": int(b.active),
             }
-        return {
+        out = {
             "replicas": replicas,
             "overflow": len(self._overflow),
             "ready": self.ready,
         }
+        if self._autopilot is not None:
+            out["autopilot"] = self._autopilot.status()
+        return out
 
     def close(self) -> None:
         """Release the fleet's host-side attachments (metrics endpoint,
@@ -641,9 +781,13 @@ class ServingFleet:
         if self._publisher is not None:
             self._publisher.attach(batcher)
             if self._publisher.latest_params is not None:
+                # latest_version, not version: while a canary is pending
+                # the version counter belongs to the canary generation —
+                # a replica added mid-canary runs the RETAINED tree and
+                # must carry that tree's stamp
                 batcher.install_weights(
                     self._publisher.latest_params,
-                    version=self._publisher.version,
+                    version=self._publisher.latest_version,
                 )
         self._tele.gauge("serve/fleet_replicas").set(len(self._live))
         return idx
@@ -675,11 +819,16 @@ class ServingFleet:
         *,
         max_new_tokens: int,
         deadline_s: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         """Queue a request on the least-loaded live replica; returns the
         fleet-level request id. Raises ``QueueFullError`` when every
         live replica's bounded queue rejects (fleet-level backpressure:
         shed or retry, exactly like the single-replica contract).
+
+        ``priority`` tiers admission for the autopilot's burn-driven
+        shedding (higher = protected longer; admission order itself
+        stays FIFO — see ``ContinuousBatcher.submit``).
 
         The fleet front door mints the request's trace id here; every
         placement (including migrations and kill-recovery continuations)
@@ -694,6 +843,7 @@ class ServingFleet:
             time.perf_counter() + deadline_s
             if deadline_s is not None else None,
             trace_id=mint_trace_id(),
+            priority=int(priority),
         )
         self._reqs[frid] = req
         try:
@@ -750,6 +900,7 @@ class ServingFleet:
                     max_new_tokens=remaining,
                     deadline_s=deadline_s,
                     trace_id=req.trace_id,
+                    priority=req.priority,
                 )
             except QueueFullError:
                 continue
@@ -758,6 +909,67 @@ class ServingFleet:
             return True
         req.replica = req.local_rid = None
         return False
+
+    def shed_queued(self, n: int) -> list[int]:
+        """Retire up to ``n`` QUEUED (never-admitted) fleet requests as
+        explicit ``failed[frid] == "shed"`` — lowest priority first,
+        longest remaining deadline first within a tier (a deadline-less
+        request is infinitely patient: it sheds before anything with a
+        contract), newest first as the final tiebreak. Running rows are
+        never shed (their committed tokens are real work); shedding
+        only empties queue positions, which is exactly what relieves a
+        burning latency SLO and what frees bounded-queue capacity so
+        high-priority traffic stops seeing ``QueueFullError`` at the
+        front door. Returns the shed fleet request ids.
+
+        This is the autopilot's actuator (burn-driven admission
+        tiering, docs/design/elasticity.md "SLO autopilot"); callers
+        may also invoke it directly as a manual load-shed."""
+        if n <= 0:
+            return []
+        queued_rids = {
+            (i, q.rid)
+            for i in self._live
+            for q in self._replicas[i]._queue
+        }
+        overflow = set(self._overflow)
+        candidates = []
+        for frid, req in self._reqs.items():
+            if frid in self.failed:
+                continue
+            if frid in overflow:
+                where = "overflow"
+            elif (
+                req.replica is not None
+                and (req.replica, req.local_rid) in queued_rids
+            ):
+                where = "replica"
+            else:
+                continue  # running (or already finishing): never shed
+            candidates.append((frid, req, where))
+        candidates.sort(key=lambda item: (
+            item[1].priority,
+            -(item[1].deadline_t if item[1].deadline_t is not None
+              else math.inf),
+            -item[0],
+        ))
+        shed: list[int] = []
+        for frid, req, where in candidates[:n]:
+            if where == "overflow":
+                self._overflow.remove(frid)
+                self._tele.counter("serve/shed").add(1)
+                self._trace(
+                    req.trace_id, "failed", reason="shed",
+                    at="fleet_overflow", priority=req.priority,
+                )
+            else:
+                b = self._replicas[req.replica]
+                if not b.cancel_queued(req.local_rid, "shed"):
+                    continue  # admitted since the scan: let it run
+                self._by_replica.pop((req.replica, req.local_rid), None)
+            self.failed[frid] = "shed"
+            shed.append(frid)
+        return shed
 
     # -- progress -------------------------------------------------------
 
@@ -826,9 +1038,14 @@ class ServingFleet:
             self.failed.pop(old, None)
 
     def step(self) -> None:
-        """One scheduling round: consume the preemption/chaos triggers,
-        retry overflow placements, advance every live replica a chunk."""
+        """One scheduling round: poll the bound autopilot (its control
+        actions happen HERE, at the clean boundary before any chunk
+        dispatches — never on an evaluation thread), consume the
+        preemption/chaos triggers, retry overflow placements, advance
+        every live replica a chunk."""
         self._rounds += 1
+        if self._autopilot is not None:
+            self._autopilot.poll()
         if self._preemption is not None:
             guard, idx = self._preemption
             if guard.triggered and idx in self._live:

@@ -30,6 +30,13 @@ readback, a ``Trainer`` past its introspection warmup steps, a
 detail rides ``/healthz``). Load balancers and schedulers gate traffic
 on this, so "compiling" never reads as "serving".
 
+Health contract (``/healthz``): liveness plus owner detail — a fleet
+reports per-replica ``{live, retired, dead, ready, active}`` and, with
+an ``FleetAutopilot`` bound, an ``autopilot`` block (burning policies,
+burn/idle ages, pending canary, last decision) — one scrape explains
+both what the fleet looks like and what the control loop is about to
+do about it (docs/design/elasticity.md "SLO autopilot").
+
 Lifecycle: opt-in via ``TrainerConfig.metrics_port``,
 ``ContinuousBatcher(metrics_port=...)`` or
 ``ServingFleet(metrics_port=...)``; ``port=0`` binds an ephemeral port

@@ -34,6 +34,17 @@ TTFT over the last minute"). This module adds the missing half:
   rate-limited warning — a sustained burn pages once per window, not
   once per scrape.
 
+Policies work over replica-labeled instruments too: a rate policy may
+name ``serve/r0/expired`` and a quantile policy ``serve/r0/ttft_s``
+(the batcher records base rollup AND ``serve/r{i}/...`` — see
+docs/design/observability.md), so per-replica objectives see only that
+replica's windowed deltas. :meth:`SloMonitor.extend` /
+:meth:`SloMonitor.remove` register/retire policies at runtime — the
+fleet autopilot's canary comparator (``resilience/autopilot.py``)
+scopes temporary per-replica policies this way for exactly one
+decision window. ``subscribers`` fire after every evaluation with the
+fresh status list: the autopilot's sense→act hook.
+
 Pure host Python, no jax anywhere: evaluation runs inside /metrics
 scrapes and telemetry flushes, neither of which may touch the device.
 """
@@ -241,44 +252,129 @@ class SloMonitor:
         digest_buckets: int = 8,
         digest_capacity: int = 256,
     ):
-        self.policies = tuple(policies)
-        names = [p.name for p in self.policies]
+        names = [p.name for p in policies]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate policy names in {names}")
+        self.policies: tuple[SloPolicy, ...] = ()
         self._clock = clock
+        self._digest_buckets = digest_buckets
+        self._digest_capacity = digest_capacity
         # one digest PER (metric, window): two policies with different
         # windows over the same metric must each see their own horizon —
         # a shared widest-window digest would let a 4-minute-old spike
-        # keep a 60s policy burning
-        self._digests: dict[tuple[str, float], StreamingQuantileDigest] = {}
+        # keep a 60s policy burning. Isolated extensions get a
+        # sequence-suffixed key instead, so a scoped decision window
+        # can never alias a standing policy's samples.
+        self._digests: dict[tuple, StreamingQuantileDigest] = {}
         self._digests_by_metric: dict[
             str, list[StreamingQuantileDigest]
         ] = {}
-        for p in self.policies:
-            if p.kind != "quantile":
-                continue
-            key = (p.metric, p.window_s)
-            if key not in self._digests:
-                d = self._digests[key] = StreamingQuantileDigest(
-                    window_s=p.window_s,
-                    buckets=digest_buckets,
-                    capacity=digest_capacity,
-                    clock=clock,
-                )
-                self._digests_by_metric.setdefault(p.metric, []).append(d)
+        self._policy_digest_key: dict[str, tuple] = {}
+        self._isolate_seq = 0
         # counter history rings for rate policies: (t, value) samples
         # appended at each evaluation; the windowed delta is current
         # minus the newest sample at/before (now - window)
         self._counter_rings: dict[str, deque[tuple[float, float]]] = {}
-        self._max_window = max(
-            (p.window_s for p in self.policies), default=60.0
-        )
+        self._max_window = 60.0
         self._last_violation: dict[str, float] = {}
+        # post-evaluation callbacks (fresh status list, called OUTSIDE
+        # the evaluation lock): the autopilot's sense→act subscription
+        self.subscribers: list[
+            Callable[[list["SloStatus"]], None]
+        ] = []
+        self._subscriber_warned_t = -float("inf")
         # evaluate() runs from scrape threads (MetricsServer) AND the
         # flush path concurrently; the once-per-window violation bump is
         # check-then-set and the counter rings mutate — serialize it
         self._eval_lock = threading.Lock()
         self._hub = None
+        self._register(tuple(policies))
+
+    def _register(
+        self, policies: tuple[SloPolicy, ...], *, isolate: bool = False
+    ) -> None:
+        self.policies = self.policies + policies
+        for p in policies:
+            if p.kind != "quantile":
+                continue
+            if isolate:
+                # never alias a standing policy's digest, even on an
+                # exact (metric, window) collision: a scoped decision
+                # window must start clean
+                self._isolate_seq += 1
+                key = (p.metric, p.window_s, self._isolate_seq)
+            else:
+                key = (p.metric, p.window_s)
+            self._policy_digest_key[p.name] = key
+            if key not in self._digests:
+                d = self._digests[key] = StreamingQuantileDigest(
+                    window_s=p.window_s,
+                    buckets=self._digest_buckets,
+                    capacity=self._digest_capacity,
+                    clock=self._clock,
+                )
+                self._digests_by_metric.setdefault(p.metric, []).append(d)
+        self._max_window = max(
+            (p.window_s for p in self.policies), default=60.0
+        )
+
+    def extend(
+        self, policies: Sequence[SloPolicy], *, isolate: bool = False
+    ) -> None:
+        """Register additional policies at runtime. Digest-backed
+        (quantile) additions only observe samples recorded AFTER the
+        extension — callers scoping a decision window (the autopilot's
+        canary comparator) rely on exactly that: the window starts
+        clean at extend time. ``isolate=True`` guarantees it even when
+        the new policy's (metric, window) exactly matches a standing
+        policy's, by giving the addition its own digest instead of
+        sharing."""
+        with self._eval_lock:
+            have = {p.name for p in self.policies}
+            fresh = [p.name for p in policies]
+            clash = have.intersection(fresh)
+            if clash or len(set(fresh)) != len(fresh):
+                raise ValueError(
+                    f"duplicate policy names in extend: "
+                    f"{sorted(clash) or fresh}"
+                )
+            self._register(tuple(policies), isolate=isolate)
+
+    def remove(self, names: Sequence[str]) -> None:
+        """Retire policies by name (unknown names are ignored). Their
+        ``slo/{name}/*`` gauges are cleared (set NaN, which snapshots
+        drop) so a retired temporary policy doesn't keep exporting its
+        last evaluation forever; digests survive only while some
+        remaining policy still reads their (metric, window) key."""
+        gone = set(names)
+        with self._eval_lock:
+            self.policies = tuple(
+                p for p in self.policies if p.name not in gone
+            )
+            live_keys = {
+                self._policy_digest_key[p.name]
+                for p in self.policies if p.kind == "quantile"
+            }
+            for key in [k for k in self._digests if k not in live_keys]:
+                d = self._digests.pop(key)
+                per_metric = self._digests_by_metric.get(key[0], [])
+                if d in per_metric:
+                    per_metric.remove(d)
+                if not per_metric:
+                    self._digests_by_metric.pop(key[0], None)
+            for n in gone:
+                self._last_violation.pop(n, None)
+                self._policy_digest_key.pop(n, None)
+            self._max_window = max(
+                (p.window_s for p in self.policies), default=60.0
+            )
+        registry = self._hub.registry if self._hub is not None else None
+        if registry is not None:
+            for n in gone:
+                for suffix in ("observed", "burn", "violating"):
+                    g = registry.gauges.get(f"slo/{n}/{suffix}")
+                    if g is not None:
+                        g.set(float("nan"))
 
     def attach(self, hub) -> "SloMonitor":
         hub.registry.value_observers.append(self._on_value)
@@ -329,14 +425,26 @@ class SloMonitor:
 
     def evaluate(self, registry=None) -> list[SloStatus]:
         """Evaluate every policy; set the ``slo/*`` gauges; bump
-        ``slo/violations`` once per window per burning policy.
-        Thread-safe: scrapes and flushes may evaluate concurrently."""
+        ``slo/violations`` once per window per burning policy; hand the
+        fresh status list to every subscriber (outside the lock — a
+        subscriber may re-enter monitor APIs). Thread-safe: scrapes and
+        flushes may evaluate concurrently."""
         if registry is None:
             if self._hub is None:
                 return []
             registry = self._hub.registry
         with self._eval_lock:
-            return self._evaluate_locked(registry)
+            statuses = self._evaluate_locked(registry)
+        for cb in list(self.subscribers):
+            try:
+                cb(statuses)
+            except Exception:  # noqa: BLE001 — a bad subscriber must not
+                # kill the flush/scrape that evaluated; rate-limited log
+                now = self._clock()
+                if now - self._subscriber_warned_t >= 60.0:
+                    self._subscriber_warned_t = now
+                    logger.exception("SLO evaluation subscriber failed")
+        return statuses
 
     def _evaluate_locked(self, registry) -> list[SloStatus]:
         now = self._clock()
@@ -344,7 +452,7 @@ class SloMonitor:
         burning = 0
         for p in self.policies:
             if p.kind == "quantile":
-                digest = self._digests[(p.metric, p.window_s)]
+                digest = self._digests[self._policy_digest_key[p.name]]
                 samples = digest.count()
                 observed = (
                     digest.quantile(p.quantile)
@@ -358,7 +466,12 @@ class SloMonitor:
                     for g in p.good
                 )
                 samples = int(den)
-                observed = bad / den if den >= p.min_samples else float("nan")
+                # den > 0 guards a min_samples=0 policy (the autopilot's
+                # promote-unless-observably-bad canary twins) from 0/0
+                observed = (
+                    bad / den if den >= p.min_samples and den > 0
+                    else float("nan")
+                )
                 burn = observed / p.target if math.isfinite(observed) else 0.0
             violating = burn >= p.burn_rate
             # NaN clears the gauge from snapshots (the registry filters

@@ -1,0 +1,858 @@
+"""SLO autopilot (resilience/autopilot.py, docs/design/elasticity.md
+"SLO autopilot"): burn-driven autoscaling with hysteresis (no flapping
+under an oscillating load), shed-by-priority ordering, canary promote
+vs rollback pinned token- and weights_version-exact, decision-log JSONL
+schema round-trip, and the end-to-end chaos acceptance leg — a scripted
+load ramp + replica kill + bad-weight canary that ends with every SLO
+policy non-burning, the bad generation rolled back, >=1 grow and >=1
+shrink taken, and the decision log + flight recorder explaining every
+action — fully deterministic (shared fake clock, scripted arrivals),
+no human input."""
+
+import json
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from tests.resilience.conftest import ToyDecodeLM, toy_expected
+
+from d9d_tpu.loop.serve import ContinuousBatcher, QueueFullError
+from d9d_tpu.resilience import (
+    AutopilotConfig,
+    DecisionLog,
+    FleetAutopilot,
+    ServingFleet,
+    WeightPublisher,
+    read_decisions,
+)
+from d9d_tpu.resilience.chaos import (
+    kill_replica_mid_drain,
+    ramp_arrivals,
+    shrink_at_step,
+)
+from d9d_tpu.telemetry import (
+    SloMonitor,
+    SloPolicy,
+    Telemetry,
+    get_telemetry,
+    set_telemetry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    old = get_telemetry()
+    hub = set_telemetry(Telemetry())
+    yield hub
+    set_telemetry(old)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+SHIFT_VOCAB = 32
+SHIFT_EOS = 6
+
+
+class ToyShiftLM(nn.Module):
+    """ToyDecodeLM whose next token DEPENDS ON THE WEIGHTS: ``(tok +
+    shift) % vocab`` with ``shift`` a real param leaf — a canary
+    publish of a different shift observably changes what the replica
+    emits, which is what the promote/rollback legs need."""
+
+    vocab: int = SHIFT_VOCAB
+    decode_max_length: int = 32
+
+    @nn.compact
+    def __call__(self, tokens, positions, labels=None, mask=None):
+        b = tokens.shape[0]
+        shift = self.param("shift", lambda k: jnp.ones((), jnp.int32))
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        mem = self.variable(
+            "cache", "mem",
+            lambda: jnp.zeros((b, self.decode_max_length), jnp.int32),
+        )
+        i = jnp.broadcast_to(idx.value, (b,))
+        mem.value = mem.value.at[
+            jnp.arange(b), jnp.clip(i, 0, self.decode_max_length - 1)
+        ].set(tokens[:, 0])
+        idx.value = idx.value + 1
+        return jax.nn.one_hot(
+            (tokens + shift) % self.vocab, self.vocab
+        ) * 20.0
+
+    def logits(self, tokens, positions, mask=None):
+        return self(tokens, positions)
+
+
+GOOD = {"shift": jnp.array(1, jnp.int32)}
+# shift 2 from an ODD token stays odd forever: it can never emit the
+# even EOS, so every request runs to its full budget — the
+# serve/request_tokens distribution jumps to the ceiling on the canary
+BAD = {"shift": jnp.array(2, jnp.int32)}
+
+
+def shift_expected(prompt, n, shift=1):
+    toks = []
+    t = prompt[-1]
+    for _ in range(n):
+        t = (t + shift) % SHIFT_VOCAB
+        toks.append(t)
+        if t == SHIFT_EOS:
+            break
+    return toks
+
+
+def make_shift_batcher(params, **kw):
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("chunk_size", 4)
+    return ContinuousBatcher(ToyShiftLM(), params, eos_id=SHIFT_EOS, **kw)
+
+
+def make_toy_batcher(params=None, **kw):
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("chunk_size", 4)
+    return ContinuousBatcher(ToyDecodeLM(), params or {}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# burn-driven autoscaling: hysteresis both directions
+
+
+def test_grow_on_burn_with_hysteresis_no_flapping():
+    """An oscillating burn shorter than ``grow_after_s`` never grows;
+    a sustained burn grows exactly once per cooldown window; sustained
+    idle then shrinks back to ``min_replicas`` — no flapping."""
+    hub = get_telemetry()
+    clock = FakeClock()
+    pub = WeightPublisher()
+    pub.publish({})
+    fleet = ServingFleet(publisher=pub)
+    fleet.add_replica(make_toy_batcher())
+    monitor = SloMonitor(
+        [SloPolicy(name="err", kind="rate", bad="serve/rejected",
+                   good=("serve/requests_finished",), target=0.05,
+                   window_s=2.0)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        replica_factory=lambda p: make_toy_batcher(params=p),
+        config=AutopilotConfig(
+            grow_after_s=4.0, cooldown_s=10.0, min_replicas=1,
+            max_replicas=3, idle_after_s=6.0, idle_queue_depth=0,
+            idle_slot_utilization=0.5, eval_interval_s=1.0,
+        ),
+        clock=clock,
+    ).attach()
+
+    def tick(burning: bool, rounds: int):
+        for _ in range(rounds):
+            if burning:
+                hub.counter("serve/rejected").add(1)
+            fleet.step()
+            clock.advance(1.0)
+
+    # oscillation: 1s burning bursts, 5s recovery — a burst keeps the
+    # windowed rate violating for ~window_s after it passes, still well
+    # under grow_after_s, so the fleet must not flap
+    for _ in range(3):
+        tick(True, 1)
+        tick(False, 5)
+    assert len(fleet.live_replicas) == 1
+
+    # sustained burn: one grow at grow_after_s, the next only after
+    # cooldown — never one per evaluation
+    tick(True, 5)
+    assert len(fleet.live_replicas) == 2
+    tick(True, 4)  # still inside cooldown
+    assert len(fleet.live_replicas) == 2
+    tick(True, 7)  # cooldown passed, burn sustained
+    assert len(fleet.live_replicas) == 3
+    tick(True, 20)  # at max_replicas: never beyond
+    assert len(fleet.live_replicas) == 3
+
+    # recovery: the window ages out, then sustained idle shrinks back
+    # to min_replicas one cooldown apart
+    tick(False, 40)
+    assert len(fleet.live_replicas) == 1
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["autopilot/grows"] == 2
+    assert snap["counters"]["autopilot/shrinks"] == 2
+    assert snap["counters"]["serve/fleet_grows"] == 2
+
+
+def test_grow_blocked_without_factory_is_one_logged_decision(tmp_path):
+    hub = get_telemetry()
+    clock = FakeClock()
+    fleet = ServingFleet()
+    fleet.add_replica(make_toy_batcher())
+    monitor = SloMonitor(
+        [SloPolicy(name="err", kind="rate", bad="serve/rejected",
+                   target=0.05, window_s=5.0)],
+        clock=clock,
+    ).attach(hub)
+    log = tmp_path / "decisions.jsonl"
+    ap = FleetAutopilot(
+        fleet, monitor, replica_factory=None,
+        config=AutopilotConfig(grow_after_s=2.0, cooldown_s=1.0),
+        decision_log=log, clock=clock,
+    ).attach()
+    for _ in range(8):
+        hub.counter("serve/rejected").add(1)
+        fleet.step()
+        clock.advance(1.0)
+    assert fleet.live_replicas == (0,)
+    blocked = [
+        d for d in read_decisions(log) if d["action"] == "grow_blocked"
+    ]
+    assert len(blocked) == 1  # logged once, not per evaluation
+
+
+# ---------------------------------------------------------------------------
+# admission tiering: shed lowest-priority / longest-deadline first
+
+
+def test_shed_by_priority_and_deadline_ordering():
+    """Burn + queue over the shed target: the autopilot sheds lowest
+    priority first, then (within a tier) the deadline-less before the
+    tight-deadline request — and the highest-priority request is the
+    one left queued."""
+    hub = get_telemetry()
+    clock = FakeClock()
+    fleet = ServingFleet()
+    b = make_toy_batcher(batch_size=1)
+    fleet.add_replica(b)
+    monitor = SloMonitor(
+        [SloPolicy(name="err", kind="rate", bad="serve/rejected",
+                   target=0.05, window_s=5.0)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        config=AutopilotConfig(
+            grow_after_s=1e9, shed_queue_depth=1, eval_interval_s=1.0,
+        ),
+        clock=clock,
+    ).attach()
+    running = fleet.submit([3], max_new_tokens=8, priority=0)
+    b.step_chunk()  # admitted into the single slot
+    fleet.step()  # baseline rate-policy sample (cold-start delta is 0)
+    clock.advance(1.0)
+    low = fleet.submit([4], max_new_tokens=4, priority=-1, deadline_s=5.0)
+    patient = fleet.submit([5], max_new_tokens=4, priority=0)
+    tight = fleet.submit([6], max_new_tokens=4, priority=0,
+                         deadline_s=1e3)
+    vip = fleet.submit([7], max_new_tokens=4, priority=3)
+    assert fleet._queue_depth() == 4
+    # burn: the next poll sheds down to shed_queue_depth=1
+    hub.counter("serve/rejected").add(5)
+    fleet.step()
+    clock.advance(1.0)
+    # victims: low (priority -1), then patient (no deadline sheds
+    # before any contract), then tight; vip survives
+    assert fleet.failed.get(low) == "shed"
+    assert fleet.failed.get(patient) == "shed"
+    assert fleet.failed.get(tight) == "shed"
+    assert vip not in fleet.failed
+    out = fleet.drain()
+    assert out[running] == toy_expected([3], 8)
+    assert out[vip] == toy_expected([7], 4)
+    assert out[low] == []  # shed: observable, empty, never served
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["serve/shed"] == 3
+    assert snap["counters"]["autopilot/shed_requests"] == 3
+    assert b.stats.shed == 3
+    # shed is its own signal: not an expiry, not a generic failure
+    assert "serve/expired" not in snap["counters"]
+    assert "serve/failed" not in snap["counters"]
+
+
+def test_running_requests_are_never_shed():
+    hub = get_telemetry()
+    clock = FakeClock()
+    fleet = ServingFleet()
+    b = make_toy_batcher(batch_size=2)
+    fleet.add_replica(b)
+    r1 = fleet.submit([3], max_new_tokens=6, priority=-5)
+    r2 = fleet.submit([9], max_new_tokens=6, priority=-5)
+    b.step_chunk()  # both admitted: nothing left to shed
+    assert fleet.shed_queued(5) == []
+    out = fleet.drain()
+    assert out[r1] == toy_expected([3], 6)
+    assert out[r2] == toy_expected([9], 6)
+
+
+# ---------------------------------------------------------------------------
+# canaried weight publish: promote vs rollback, token/version-exact
+
+
+def _canary_rig(clock, *, tmp_path=None, n_replicas=2):
+    hub = get_telemetry()
+    pub = WeightPublisher()
+    pub.publish(GOOD)  # generation 1, fleet-wide known-good tree
+    fleet = ServingFleet(publisher=pub)
+    for _ in range(n_replicas):
+        fleet.add_replica(make_shift_batcher(GOOD))
+    monitor = SloMonitor(
+        [SloPolicy(name="gen_len_p50", metric="serve/request_tokens",
+                   quantile=0.5, target=6.0, window_s=30.0,
+                   burn_rate=1e18)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        replica_factory=lambda p: make_shift_batcher(p),
+        config=AutopilotConfig(
+            scale_policies=(), canary_policies=("gen_len_p50",),
+            canary_window_s=10.0, canary_tolerance=1.25,
+            canary_min_samples=2, canary_max_wait_s=30.0,
+            eval_interval_s=1.0,
+        ),
+        decision_log=(
+            tmp_path / "decisions.jsonl" if tmp_path is not None else None
+        ),
+        clock=clock,
+    ).attach()
+    return hub, pub, fleet, monitor, ap
+
+
+def _serve_rounds(fleet, clock, prompts, budget=10):
+    frids = []
+    for p in prompts:
+        frids.append(fleet.submit(p, max_new_tokens=budget))
+        fleet.step()
+        clock.advance(1.0)
+    fleet.drain()
+    for _ in range(3):
+        fleet.step()
+        clock.advance(1.0)
+    return frids
+
+
+def test_canary_rollback_is_token_and_version_exact(tmp_path):
+    """A bad canary generation (never emits EOS) is detected from the
+    canary replica's per-replica serve/r{i}/* deltas vs the fleet
+    rollup and rolled back: the canary generation got stamp 2, the
+    rollback re-installs the RETAINED tree under stamp 3, and the
+    replica serves good-generation tokens again — while requests that
+    finished DURING the canary carry the bad stamp in their audit
+    trail."""
+    hub = get_telemetry()
+    hub.configure_flight_recorder(tmp_path / "flight")
+    clock = FakeClock()
+    hub2, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    v = ap.publish_canary(BAD)
+    assert v == 2 and pub.canary is not None
+    assert pub.latest_version == 1  # the retained tree is still gen 1
+    canary_b = fleet._replicas[max(fleet.live_replicas)]
+    _serve_rounds(fleet, clock, [[3], [5], [1]] * 4)
+    # decided: rolled back under a FRESH stamp (never reuse the bad one)
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert [d["action"] for d in decs] == ["canary_start",
+                                          "canary_rollback"]
+    verdicts = decs[-1]["detail"]["verdicts"]["gen_len_p50"]
+    assert verdicts["bad"] is True and verdicts["canary"] == 10.0
+    assert pub.canary is None
+    assert canary_b.weights_version == 3
+    assert pub.latest_version == 1  # retained tree unchanged by rollback
+    # requests the canary served are stamped with the bad generation
+    bad_stamps = [
+        rec.weights_version for rec in canary_b.request_stats.values()
+        if rec.finish_t is not None and rec.weights_version == 2
+    ]
+    assert bad_stamps, "the canary must have served stamped traffic"
+    # token-exact after rollback: the bad generation is gone everywhere
+    f = fleet.submit([3], max_new_tokens=10)
+    out = fleet.drain()
+    assert out[f] == shift_expected([3], 10, shift=1) == [4, 5, 6]
+    # destructive action → flight record
+    assert (tmp_path / "flight"
+            / "flight_recorder_autopilot_rollback.json").exists()
+    # temp canary twins removed, their gauges cleared from snapshots
+    assert all(
+        not p.name.startswith("canary_") for p in monitor.policies
+    )
+    gauges = hub.registry.snapshot()["gauges"]
+    assert not any(k.startswith("slo/canary_") for k in gauges)
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["autopilot/canary_rollbacks"] == 1
+    assert snap["counters"]["serve/weight_canary"] == 1
+
+
+def test_canary_promote_is_token_and_version_exact(tmp_path):
+    """A healthy canary promotes: every replica converges on the canary
+    generation under the SAME stamp, and the publisher retains the
+    canary tree for future grows."""
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    v = ap.publish_canary(GOOD)  # same behavior as the live tree
+    assert v == 2
+    _serve_rounds(fleet, clock, [[3], [5], [1]] * 4)
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert [d["action"] for d in decs] == ["canary_start",
+                                          "canary_promote"]
+    assert pub.canary is None and pub.latest_version == 2
+    assert all(
+        fleet._replicas[i].weights_version == 2
+        for i in fleet.live_replicas
+    )
+    f = fleet.submit([1], max_new_tokens=10)
+    out = fleet.drain()
+    assert out[f] == shift_expected([1], 10) == [2, 3, 4, 5, 6]
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["autopilot/canary_promotes"] == 1
+    assert "autopilot/canary_rollbacks" not in snap["counters"]
+
+
+def test_unobserved_canary_rolls_back_never_promotes_blind(tmp_path):
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    ap.publish_canary(BAD)
+    for _ in range(40):  # no traffic at all: past canary_max_wait_s
+        fleet.step()
+        clock.advance(1.0)
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert decs[-1]["action"] == "canary_rollback"
+    assert "no traffic" in decs[-1]["reason"]
+    assert pub.canary is None
+    f = fleet.submit([3], max_new_tokens=10)
+    assert fleet.drain()[f] == [4, 5, 6]
+
+
+def test_fleet_publish_supersedes_pending_canary(tmp_path):
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    ap.publish_canary(BAD)
+    fleet.step()
+    clock.advance(1.0)
+    pub.publish(GOOD)  # a trainer publish lands mid-canary
+    fleet.step()
+    clock.advance(1.0)
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert decs[-1]["action"] == "canary_superseded"
+    assert all(
+        not p.name.startswith("canary_") for p in monitor.policies
+    )
+    f = fleet.submit([3], max_new_tokens=10)
+    assert fleet.drain()[f] == [4, 5, 6]
+
+
+def test_second_canary_while_pending_raises(tmp_path):
+    """Silently replacing a pending canary would strand the first
+    canary replica on abandoned candidate weights with nothing left to
+    roll it back — the publisher refuses instead."""
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    ap.publish_canary(BAD)
+    with pytest.raises(RuntimeError, match="already[\\s\\S]*pending"):
+        ap.publish_canary(GOOD)
+    # a fleet-wide publish is the sanctioned supersede: it converges
+    # EVERY replica (the canary one included) on the new tree
+    pub.publish(GOOD)
+    assert pub.canary is None
+    ap.publish_canary(GOOD)  # resolvable again
+
+
+def test_removed_policy_stops_driving_decisions():
+    """A policy retired via monitor.remove() while violating must drop
+    out of the autopilot's cached statuses — a stale violating status
+    would keep shedding/growing forever with nothing live behind it."""
+    hub = get_telemetry()
+    clock = FakeClock()
+    pub = WeightPublisher()
+    pub.publish({})
+    fleet = ServingFleet(publisher=pub)
+    fleet.add_replica(make_toy_batcher())
+    monitor = SloMonitor(
+        [SloPolicy(name="err", kind="rate", bad="serve/rejected",
+                   target=0.05, window_s=60.0)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        replica_factory=lambda p: make_toy_batcher(params=p),
+        config=AutopilotConfig(grow_after_s=3.0, cooldown_s=1.0,
+                               max_replicas=4, eval_interval_s=1.0),
+        clock=clock,
+    ).attach()
+    fleet.step()
+    clock.advance(1.0)
+    hub.counter("serve/rejected").add(5)  # burn, sustained by window
+    fleet.step()
+    clock.advance(1.0)
+    assert ap.status()["burning"] == ["err"]
+    monitor.remove(["err"])
+    for _ in range(10):
+        fleet.step()
+        clock.advance(1.0)
+    assert ap.status()["burning"] == []
+    assert fleet.live_replicas == (0,), "no grow without a live policy"
+
+
+def test_canary_skips_already_replica_scoped_policies(tmp_path):
+    """A per-replica objective (metric already serve/r{i}/...) is not a
+    fleet baseline: the comparator must not rewrite it into fabricated
+    serve/{canary}/{label}/... names that nothing records (which would
+    read as an unobserved canary and roll back healthy weights)."""
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(clock, tmp_path=tmp_path)
+    monitor.extend([
+        SloPolicy(name="r0_miss", kind="rate", bad="serve/r0/expired",
+                  good=("serve/r0/requests_finished",), target=0.1,
+                  window_s=10.0),
+        SloPolicy(name="r0_len", metric="serve/r0/request_tokens",
+                  quantile=0.5, target=6.0, window_s=10.0,
+                  burn_rate=1e18),
+    ])
+    ap2 = FleetAutopilot(
+        fleet, monitor,
+        config=AutopilotConfig(canary_window_s=1.0), clock=clock,
+    )
+    watched = {p.name for p in ap2._canary_watched()}
+    assert "gen_len_p50" in watched
+    assert "r0_miss" not in watched and "r0_len" not in watched
+
+
+def test_bad_canary_on_sole_replica_still_rolls_back(tmp_path):
+    """On a 1-replica fleet the rollup baseline IS the canary's own
+    traffic, so canary > rollup x tolerance is unsatisfiable — the
+    verdict must fall back to the absolute policy target, or a bad
+    canary would always promote at exactly the fleet size idle shrink
+    converges to."""
+    clock = FakeClock()
+    hub, pub, fleet, monitor, ap = _canary_rig(
+        clock, tmp_path=tmp_path, n_replicas=1
+    )
+    v = ap.publish_canary(BAD)
+    _serve_rounds(fleet, clock, [[3], [5], [1]] * 4)
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert decs[-1]["action"] == "canary_rollback"
+    assert "no independent fleet baseline" in decs[-1]["reason"]
+    assert pub.canary is None
+    f = fleet.submit([3], max_new_tokens=10)
+    assert fleet.drain()[f] == [4, 5, 6]
+    assert fleet._replicas[0].weights_version != v
+
+
+def test_canary_without_prior_publish_refuses():
+    """A canary with no retained prior tree has no rollback target —
+    the publisher refuses instead of silently making the 'canary'
+    an undoable publish (a bad one would stay installed while the
+    autopilot logged a rollback that re-installed nothing)."""
+    pub = WeightPublisher()
+    b = make_shift_batcher(GOOD)  # strong ref: attach() only weakrefs
+    pub.attach(b)
+    with pytest.raises(RuntimeError, match="prior fleet-wide publish"):
+        pub.publish_canary(BAD)
+    pub.publish(GOOD)
+    assert pub.publish_canary(BAD) == 2  # resolvable once a tree exists
+
+
+def test_grow_with_fleetless_publisher_logs_blocked_not_crash(tmp_path):
+    """An autopilot handed its own publisher= while the fleet was built
+    without one: fleet.grow() would raise (IT cold-starts from the
+    fleet's publisher) — the grow decision must degrade to a logged
+    grow_blocked, never kill the scheduling loop mid-burn."""
+    hub = get_telemetry()
+    clock = FakeClock()
+    pub = WeightPublisher()
+    pub.publish(GOOD)  # the AUTOPILOT's publisher has weights...
+    fleet = ServingFleet()  # ...but the fleet has no publisher at all
+    fleet.add_replica(make_toy_batcher())
+    monitor = SloMonitor(
+        [SloPolicy(name="err", kind="rate", bad="serve/rejected",
+                   target=0.05, window_s=5.0)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor, publisher=pub,
+        replica_factory=lambda p: make_toy_batcher(params=p),
+        config=AutopilotConfig(grow_after_s=2.0, cooldown_s=1.0),
+        decision_log=tmp_path / "decisions.jsonl", clock=clock,
+    ).attach()
+    for _ in range(8):
+        hub.counter("serve/rejected").add(1)
+        fleet.step()
+        clock.advance(1.0)
+    assert fleet.live_replicas == (0,)
+    actions = [
+        d["action"] for d in read_decisions(tmp_path / "decisions.jsonl")
+    ]
+    assert actions.count("grow_blocked") == 1 and "grow" not in actions
+
+
+def test_idle_shrink_never_picks_the_pending_canary_replica(tmp_path):
+    """Idle shrink normally retires the highest-index live replica —
+    exactly where a pending canary lives (publish_canary defaults to
+    max(live)). Shrinking it mid-window would leave the comparator
+    watching a retired batcher: an eternally-unobserved canary rolling
+    back good weights. The shrink must pick another replica, and hold
+    off entirely when only the canary replica is left."""
+    hub = get_telemetry()
+    clock = FakeClock()
+    pub = WeightPublisher()
+    pub.publish(GOOD)
+    fleet = ServingFleet(publisher=pub)
+    fleet.add_replica(make_shift_batcher(GOOD))
+    fleet.add_replica(make_shift_batcher(GOOD))
+    monitor = SloMonitor(
+        [SloPolicy(name="gen_len_p50", metric="serve/request_tokens",
+                   quantile=0.5, target=6.0, window_s=30.0,
+                   burn_rate=1e18)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        config=AutopilotConfig(
+            scale_policies=(), canary_policies=("gen_len_p50",),
+            canary_window_s=1e9, canary_min_samples=1,
+            canary_max_wait_s=1e9, min_replicas=0,
+            idle_after_s=2.0, cooldown_s=0.0, eval_interval_s=1.0,
+        ),
+        decision_log=tmp_path / "decisions.jsonl", clock=clock,
+    ).attach()
+    ap.publish_canary(BAD)  # lands on replica 1 (the highest live)
+    for _ in range(10):
+        fleet.step()
+        clock.advance(1.0)
+    # replica 0 was the shrink victim; the canary replica survives its
+    # decision window — and with only it left, no further shrink even
+    # though live (1) > min_replicas (0)
+    assert fleet.live_replicas == (1,)
+    assert pub.canary is not None
+    shrinks = [
+        d for d in read_decisions(tmp_path / "decisions.jsonl")
+        if d["action"] == "shrink"
+    ]
+    assert len(shrinks) == 1 and shrinks[0]["detail"]["replica"] == 0
+
+
+# ---------------------------------------------------------------------------
+# decision log schema
+
+
+def test_decision_log_jsonl_roundtrip(tmp_path):
+    log = DecisionLog(tmp_path / "d.jsonl")
+    log.append("grow", reason="sustained burn",
+               detail={"replica": 2, "burning": {"ttft": 3.2}})
+    log.append("shed", reason="queue over target")
+    log.close()
+    decs = read_decisions(tmp_path / "d.jsonl")
+    assert [d["action"] for d in decs] == ["grow", "shed"]
+    for d in decs:
+        assert d["kind"] == "autopilot_decision"
+        assert d["schema"] == DecisionLog.SCHEMA
+        assert isinstance(d["unix_time"], float) and d["reason"]
+    assert decs[0]["detail"]["burning"] == {"ttft": 3.2}
+    # malformed lines are an error, not a silent skip
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"kind": "autopilot_decision", "schema": 1}\n')
+    with pytest.raises(ValueError, match="missing fields"):
+        read_decisions(bad)
+    bad.write_text(json.dumps({
+        "kind": "autopilot_decision", "schema": 99, "action": "grow",
+        "unix_time": 0.0, "reason": "x",
+    }) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        read_decisions(bad)
+
+
+def test_ramp_arrivals_is_deterministic_and_exact():
+    """The chaos ramp injector: fractional rates spread by an exact
+    accumulator (no RNG in arrival times), same seed → same workload,
+    and the tuple shape matches the bench workload builders."""
+    sched = [(4, 0.5), (3, 2.0), (2, 0.0)]
+    a = ramp_arrivals(sched, vocab=32, seed=7)
+    b = ramp_arrivals(sched, vocab=32, seed=7)
+    assert a == b
+    steps = [t for t, _, _ in a]
+    # rate 0.5 over steps 0-3 → arrivals at steps 1 and 3; rate 2.0
+    # over steps 4-6 → two per step; rate 0 → nothing
+    assert steps == [1, 3, 4, 4, 5, 5, 6, 6]
+    for _, prompt, gen in a:
+        assert prompt and all(0 <= t < 32 for t in prompt)
+        assert gen >= 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: ramp + replica kill + bad canary, no human
+
+
+def test_e2e_chaos_ramp_kill_and_bad_canary_recovers(tmp_path):
+    """ISSUE 13 acceptance: a scripted load ramp overloads the fleet
+    (burn → shed + grow), a replica is killed mid-drain (continuation
+    recovery), a bad-weight canary publish is rolled back from its
+    per-replica SLO deltas, and the ramp-down shrinks the fleet back —
+    ending with all SLO policies non-burning, every surviving request
+    token-exact, and the decision log + flight recorder explaining
+    every action. Fully deterministic: shared fake clock, scripted
+    arrivals, no sleeps, no human input."""
+    hub = get_telemetry()
+    flight_dir = tmp_path / "flight"
+    hub.configure_flight_recorder(flight_dir)
+    clock = FakeClock()
+    pub = WeightPublisher()
+    pub.publish(GOOD)
+    fleet = ServingFleet(publisher=pub)
+    for _ in range(2):
+        fleet.add_replica(make_shift_batcher(GOOD, max_queue=3))
+    monitor = SloMonitor(
+        [
+            # scale signal: overload rejections vs completions
+            SloPolicy(name="reject_rate", kind="rate",
+                      bad="serve/rejected",
+                      good=("serve/requests_finished",), target=0.05,
+                      window_s=8.0),
+            # quality signal: tokens-per-request p50 (the canary axis)
+            SloPolicy(name="gen_len_p50", metric="serve/request_tokens",
+                      quantile=0.5, target=6.0, window_s=8.0,
+                      burn_rate=1e18),
+        ],
+        clock=clock,
+    ).attach(hub)
+    log_path = tmp_path / "decisions.jsonl"
+    ap = FleetAutopilot(
+        fleet, monitor,
+        replica_factory=lambda p: make_shift_batcher(p, max_queue=3),
+        config=AutopilotConfig(
+            scale_policies=("reject_rate",), grow_after_s=3.0,
+            cooldown_s=6.0, min_replicas=1, max_replicas=3,
+            idle_after_s=4.0, idle_queue_depth=0,
+            idle_slot_utilization=0.01, shed_queue_depth=4,
+            canary_policies=("gen_len_p50",), canary_window_s=8.0,
+            canary_tolerance=1.25, canary_min_samples=2,
+            canary_max_wait_s=20.0, eval_interval_s=1.0,
+        ),
+        decision_log=log_path, clock=clock,
+    ).attach()
+
+    # replica 0 dies mid-drain early in the ramp (a preemption landing
+    # during overload): its unfinished requests must continue elsewhere
+    shrink_at_step(fleet, 0, step=4)
+    kill_replica_mid_drain(fleet, 0, after_chunks=1)
+
+    # -- phase A: scripted overload ramp (chaos.ramp_arrivals) --------
+    # odd prompts ABOVE the EOS token: good weights never hit EOS, so
+    # every request runs its full budget — long-running rows keep the
+    # dying replica busy for the kill and keep slots saturated so the
+    # ramp actually overloads the bounded queues
+    ramp = ramp_arrivals(
+        [(6, 1.0), (10, 3.0), (6, 1.0)], vocab=6, seed=3,
+        prompt_lo=1, prompt_hi=2, gen_lo=9, gen_hi=10,
+    )
+    ramp = [
+        (t, [7 + 2 * (i % 3)], g) for i, (t, _p, g) in enumerate(ramp)
+    ]
+    frids, rejected, shed_submitted = [], 0, []
+    pending = list(ramp)
+    step = 0
+    while pending or not all(
+        fleet.finished(f) for f in list(fleet._reqs)
+    ):
+        while pending and pending[0][0] <= step:
+            _, prompt, gen = pending.pop(0)
+            # background tier rides along: the ramp's overflow should
+            # land on these, not the paying traffic
+            try:
+                if step % 4 == 2:
+                    shed_submitted.append(fleet.submit(
+                        prompt, max_new_tokens=gen, priority=-1,
+                    ))
+                else:
+                    frids.append((fleet.submit(
+                        prompt, max_new_tokens=gen,
+                    ), prompt, gen))
+            except QueueFullError:
+                rejected += 1
+        fleet.step()
+        clock.advance(1.0)
+        step += 1
+        if step > 400:
+            raise AssertionError("ramp scenario did not converge")
+    assert 0 in fleet.dead, "the chaos kill must have fired"
+    assert rejected > 0, "the ramp must have overloaded the front door"
+    assert len(fleet.live_replicas) >= 2, "the autopilot must have grown"
+
+    # -- phase B: bad-weight canary under steady traffic ---------------
+    # one request per live replica per round: least-loaded routing then
+    # reaches every replica, so the canary actually serves (and shows
+    # its degradation in serve/r{i}/request_tokens)
+    v_bad = ap.publish_canary(BAD)
+    prompts_b = [[3], [5], [1]]
+    for r in range(12):
+        for j in range(len(fleet.live_replicas)):
+            fleet.submit(prompts_b[(r + j) % 3], max_new_tokens=10)
+        fleet.step()
+        clock.advance(1.0)
+    fleet.drain()
+    for _ in range(4):
+        fleet.step()
+        clock.advance(1.0)
+    assert pub.canary is None, "the canary must have been decided"
+
+    # -- phase C: ramp down → idle shrink back to the minimum ----------
+    for _ in range(40):
+        fleet.step()
+        clock.advance(1.0)
+    assert len(fleet.live_replicas) == 1
+
+    # -- acceptance ----------------------------------------------------
+    # all SLO policies non-burning at the end
+    statuses = monitor.evaluate()
+    assert not any(s.violating for s in statuses), [
+        (s.policy.name, s.burn) for s in statuses if s.violating
+    ]
+    # the bad generation is rolled back on every replica: a request on
+    # each live replica emits GOOD-generation tokens
+    for i in fleet.live_replicas:
+        b = fleet._replicas[i]
+        rid = b.submit([3], max_new_tokens=10)
+        b.drain()
+        assert b.outputs[rid] == [4, 5, 6], (i, b.outputs[rid])
+        # no replica is left on the bad stamp: untouched replicas kept
+        # the prior generation, the canary was stamped PAST it
+        assert b.weights_version != v_bad
+    # every surviving phase-A request is token-exact (continuations
+    # from the killed replica included); shed ones are explicit
+    for frid, prompt, gen in frids:
+        if fleet.failed.get(frid) == "shed":
+            continue
+        assert fleet.finished(frid)
+        assert fleet.outputs(frid) == shift_expected(prompt, gen), frid
+    shed_hit = [
+        f for f in shed_submitted if fleet.failed.get(f) == "shed"
+    ]
+    assert shed_hit, "burn-driven shedding must have hit the low tier"
+    # the decision log explains every action class the scenario forced
+    actions = [d["action"] for d in read_decisions(log_path)]
+    assert actions.count("grow") >= 1
+    assert actions.count("shrink") >= 1
+    assert "shed" in actions
+    assert "canary_start" in actions and "canary_rollback" in actions
+    for d in read_decisions(log_path):
+        assert d["reason"], d  # every decision explains itself
+    # flight-recorder black boxes: the replica death and the rollback
+    assert (flight_dir / "flight_recorder_replica_death.json").exists()
+    rb = flight_dir / "flight_recorder_autopilot_rollback.json"
+    assert rb.exists()
+    assert json.loads(rb.read_text())["extra"]["verdicts"]
+    # /healthz autopilot block rides the fleet health payload
+    health = fleet.replica_health()
+    assert health["autopilot"]["burning"] == []
+    assert health["autopilot"]["canary"] is None
+    assert health["autopilot"]["last_decision"]["action"] == "shrink"

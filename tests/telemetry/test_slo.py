@@ -226,6 +226,135 @@ def test_detach_stops_observation():
     assert monitor._digests[("serve/ttft_s", 60.0)].count() == 0
 
 
+def test_rate_policy_pins_windowed_deltas_per_replica_label():
+    """Rate policies over the replica-labeled counter form
+    (``serve/r{i}/...``, docs/design/observability.md) see only that
+    replica's windowed deltas — the per-replica scoping the autopilot's
+    canary comparator builds on. One replica burning must not drag a
+    healthy sibling's policy (or vice versa) through the shared rollup."""
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name=f"miss_r{i}", kind="rate",
+                   bad=f"serve/r{i}/expired",
+                   good=(f"serve/r{i}/requests_finished",), target=0.1,
+                   window_s=10.0)
+         for i in (0, 1)],
+        clock=clock,
+    ).attach(hub)
+    monitor.evaluate()  # baseline samples
+    # r0 burns hard, r1 stays healthy; the rollup would blend to 25%
+    hub.counter("serve/r0/expired").add(5)
+    hub.counter("serve/r0/requests_finished").add(5)
+    hub.counter("serve/r1/requests_finished").add(10)
+    hub.counter("serve/expired").add(5)            # rollup rides along
+    hub.counter("serve/requests_finished").add(15)
+    clock.advance(1.0)
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert by_name["miss_r0"].observed == pytest.approx(0.5)
+    assert by_name["miss_r0"].violating
+    assert by_name["miss_r1"].observed == pytest.approx(0.0)
+    assert not by_name["miss_r1"].violating
+    # the deltas age out per label, exactly like the rollup form
+    clock.advance(11.0)
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert not by_name["miss_r0"].violating
+
+
+def test_quantile_policy_observes_replica_labeled_metric():
+    """A quantile policy over ``serve/r{i}/ttft_s`` sees only that
+    replica's samples (the batcher records base AND labeled names)."""
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="r1_ttft", metric="serve/r1/ttft_s",
+                   quantile=0.5, target=0.1, window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    # what a labeled batcher does per sample: base rollup + namespaced
+    for v in (5.0, 5.0, 5.0):
+        hub.observe("serve/ttft_s", v)
+        hub.observe("serve/r0/ttft_s", v)
+    hub.observe("serve/ttft_s", 0.01)
+    hub.observe("serve/r1/ttft_s", 0.01)
+    (status,) = monitor.evaluate()
+    assert status.samples == 1
+    assert status.observed == pytest.approx(0.01)
+    assert not status.violating  # r0's spikes never bleed into r1
+
+
+def test_extend_and_remove_policies_at_runtime():
+    """``extend`` registers live policies (digests start clean at
+    extension — a scoped decision window); ``remove`` retires them and
+    clears their gauges from snapshots; duplicates are rejected."""
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="base", metric="serve/ttft_s", target=1.0,
+                   window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 9.0)  # recorded BEFORE the extension
+    monitor.extend([
+        SloPolicy(name="scoped", metric="serve/ttft_s", quantile=0.5,
+                  target=1.0, window_s=5.0),
+    ])
+    with pytest.raises(ValueError, match="duplicate"):
+        monitor.extend([
+            SloPolicy(name="scoped", metric="serve/ttft_s", target=1.0),
+        ])
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    # the pre-extension sample reached ONLY the base policy's digest
+    assert by_name["base"].samples == 1 and by_name["base"].violating
+    assert by_name["scoped"].samples == 0
+    hub.observe("serve/ttft_s", 3.0)
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert by_name["scoped"].samples == 1 and by_name["scoped"].violating
+    snap = hub.registry.snapshot()
+    assert snap["gauges"]["slo/scoped/burn"] == pytest.approx(3.0)
+    monitor.remove(["scoped"])
+    assert [p.name for p in monitor.policies] == ["base"]
+    # retired gauges cleared (NaN → dropped), digest key pruned while
+    # the base policy's own-window digest survives untouched
+    snap = hub.registry.snapshot()
+    assert not any(k.startswith("slo/scoped/") for k in snap["gauges"])
+    assert ("serve/ttft_s", 5.0) not in monitor._digests
+    assert monitor._digests[("serve/ttft_s", 10.0)].count() == 2
+    (status,) = monitor.evaluate()
+    assert status.policy.name == "base"
+
+
+def test_isolated_extend_never_aliases_a_standing_digest():
+    """``extend(..., isolate=True)`` with an exact (metric, window)
+    collision gets its OWN digest: a scoped decision window (the canary
+    comparator) must start clean even when it matches a standing
+    policy's key — sharing would mix pre-decision samples in."""
+    clock = FakeClock()
+    hub = Telemetry()
+    monitor = SloMonitor(
+        [SloPolicy(name="base", metric="serve/ttft_s", quantile=0.5,
+                   target=1.0, window_s=10.0)],
+        clock=clock,
+    ).attach(hub)
+    hub.observe("serve/ttft_s", 9.0)  # pre-decision spike
+    monitor.extend([
+        SloPolicy(name="scoped", metric="serve/ttft_s", quantile=0.5,
+                  target=1.0, window_s=10.0),  # SAME metric AND window
+    ], isolate=True)
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert by_name["base"].samples == 1       # kept its own history
+    assert by_name["scoped"].samples == 0     # started clean
+    hub.observe("serve/ttft_s", 0.2)
+    by_name = {s.policy.name: s for s in monitor.evaluate()}
+    assert by_name["scoped"].samples == 1
+    assert by_name["scoped"].observed == pytest.approx(0.2)
+    assert by_name["base"].samples == 2  # sees both, scoped saw one
+    monitor.remove(["scoped"])
+    # the standing policy's digest (and its samples) survive removal
+    assert monitor._digests[("serve/ttft_s", 10.0)].count() == 2
+    assert len(monitor._digests) == 1
+
+
 def test_same_metric_different_windows_get_separate_digests():
     """A 10s policy and a 60s policy over the same metric must each see
     their OWN horizon: a spike that aged out of the short window must

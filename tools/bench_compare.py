@@ -60,16 +60,35 @@ DEFAULT_BASELINE = REPO_ROOT / "BENCH_BASELINE.json"
 MICRO = dict(batch_size=2, requests=6, chunk_k=4, gen_lo=4, gen_hi=10)
 
 
-def _drive_micro(batcher, workload, params, publish: bool = True) -> float:
-    """Drive the deterministic micro workload through ``batcher`` (after
-    its warmup/reset); returns the timed-window wall seconds.
-    ``publish=False`` skips the mid-bench weight publish — the prefix
-    leg uses it because a publish correctly INVALIDATES the prefix
-    cache (cached KV is weights-dependent), and that leg gates
-    steady-state hit economics, not publish cost (the publish
-    dispatch/recompile contract is gated by the other three legs)."""
+def _drive_micro(
+    batcher,
+    workload,
+    params,
+    publish: bool = True,
+    *,
+    front=None,
+    publish_fn=None,
+) -> float:
+    """Drive the deterministic micro workload (after warmup/reset);
+    returns the timed-window wall seconds.
+
+    ONE loop serves every leg, so the byte-identical structural gates
+    always compare the same arrival/clock/drain semantics: ``front``
+    swaps the submit/step/drain surface (the autopilot leg passes its
+    1-replica ``ServingFleet``, whose ``step()`` polls the control loop
+    at every round boundary) while ``batcher`` stays the stats/clock
+    source. ``publish_fn`` swaps the mid-bench publish action (the
+    autopilot leg canary-publishes so its decision loop replaces the
+    direct ``install_weights`` — the same exact-count gates that catch
+    a dispatch regression then also catch a control-loop action that
+    dispatches). ``publish=False`` skips the mid-bench publish — the
+    prefix leg uses it because a publish correctly INVALIDATES the
+    prefix cache (cached KV is weights-dependent), and that leg gates
+    steady-state hit economics, not publish cost."""
     import time
 
+    if front is None:
+        front = batcher
     pending = list(workload)
     clock = 0
     publishes = 0 if publish else 1
@@ -77,22 +96,28 @@ def _drive_micro(batcher, workload, params, publish: bool = True) -> float:
     while pending:
         while pending and pending[0][0] <= clock:
             _, prompt, gen = pending.pop(0)
-            batcher.submit(prompt, max_new_tokens=gen)
+            front.submit(prompt, max_new_tokens=gen)
         if publishes == 0 and len(pending) <= MICRO["requests"] // 2:
             # live weight publish mid-bench: re-installing the same tree
             # exercises the full swap path (stage → boundary apply →
             # generation bump) without changing emissions — the
             # steady_state_compiles/host_dispatches gates then prove a
             # publish is dispatch- and recompile-free
-            batcher.install_weights(params)
+            if publish_fn is not None:
+                publish_fn(params)
+            else:
+                batcher.install_weights(params)
             publishes += 1
         if batcher.active:
             before = batcher.stats.device_steps
-            batcher.step_chunk()
+            if front is batcher:
+                batcher.step_chunk()
+            else:
+                front.step()
             clock += batcher.stats.device_steps - before
         elif pending:
             clock = pending[0][0]
-    batcher.drain()
+    front.drain()
     return time.perf_counter() - t0
 
 
@@ -125,16 +150,21 @@ def run_micro() -> dict:
     and compile counts come from the introspection inventory — only
     ``tok_per_s`` carries wall-clock noise.
 
-    Four legs: **plain** (the historical gate), **exporter-enabled** —
+    Five legs: **plain** (the historical gate), **exporter-enabled** —
     a replica-labeled batcher with the live /metrics endpoint up, an
     SLO monitor attached, and one mid-run scrape — **paged** (the SAME
     workload through a paged-KV batcher: its structural counts must be
     byte-identical to the plain leg's and its tokens exactly equal —
     paging adds zero dispatches/readbacks/steady-state compiles per
-    token), and **prefix** (a shared-system-prompt workload through a
+    token), **prefix** (a shared-system-prompt workload through a
     paged batcher with the content-hashed prefix cache on: gates the
     hit rate, the HBM-bytes-per-concurrent-request reduction vs the
-    dense layout, and its own structural counts). The exporter leg's
+    dense layout, and its own structural counts), and **autopilot**
+    (the same workload through a 1-replica ``ServingFleet`` with the
+    SLO autopilot control loop attached and the mid-run publish
+    upgraded to a canaried publish the autopilot promotes: structural
+    counts must stay byte-identical to the plain leg — the control
+    loop acts only at round boundaries). The exporter leg's
     structural counts must be IDENTICAL to the plain leg's (the
     monitoring plane adds zero dispatches, zero readbacks, zero
     steady-state compiles — the overhead contract's exact half) and
@@ -269,6 +299,69 @@ def run_micro() -> dict:
     px_window_records = introspect.inventory()[mark_px:]
     # dense-layout bytes the same concurrency would have pinned
     px_dense_equiv = px._kv_bytes_static / max(1, px._peak_running)
+
+    # -- autopilot leg: same workload through a 1-replica fleet with the
+    # FULL control loop attached (SLO monitor + FleetAutopilot polled
+    # every scheduling round) and the mid-run publish upgraded to a
+    # CANARY publish decided by the autopilot. The contract this gates:
+    # the control loop acts only at flush/round boundaries — zero added
+    # per-token dispatches/readbacks/compiles, byte-identical structural
+    # counts and tokens vs the plain leg (docs/design/elasticity.md
+    # "SLO autopilot").
+    from d9d_tpu.resilience import (
+        AutopilotConfig,
+        FleetAutopilot,
+        ServingFleet,
+        WeightPublisher,
+    )
+
+    hub = get_telemetry()
+    promotes_before = hub.registry.counter(
+        "autopilot/canary_promotes"
+    ).value
+    ap_pub = WeightPublisher()
+    ap_fleet = ServingFleet(publisher=ap_pub)
+    ap_b = ContinuousBatcher(
+        model, params, batch_size=MICRO["batch_size"],
+        chunk_size=k, overlap=True,
+    )
+    ap_fleet.add_replica(ap_b)
+    ap_pub.publish(params)
+    ap_monitor = SloMonitor([
+        # unreachable targets: the leg gates the always-on control-loop
+        # cost, not a scale action (min==max replicas forbids one too)
+        SloPolicy(name="bench_ap_ttft_p99", metric="serve/ttft_s",
+                  quantile=0.99, target=60.0, window_s=60.0),
+    ]).attach(hub)
+    autopilot = FleetAutopilot(
+        ap_fleet, ap_monitor,
+        config=AutopilotConfig(
+            # epsilon decision window: promote at the first poll after
+            # the canary install (this leg gates control-loop COST, the
+            # verdict quality legs live in tests/resilience)
+            min_replicas=1, max_replicas=1, canary_window_s=1e-6,
+            canary_min_samples=0, eval_interval_s=1.0,
+        ),
+    ).attach()
+    try:
+        ap_fleet.submit(workload[0][1], max_new_tokens=2 * k + 2)
+        ap_fleet.drain()
+        ap_b.reset_measurement()
+        mark_ap = len(introspect.inventory())
+        _drive_micro(
+            ap_b, workload, params,
+            front=ap_fleet, publish_fn=autopilot.publish_canary,
+        )
+    finally:
+        autopilot.detach()
+        ap_monitor.detach()
+        ap_fleet.close()
+    ap_window_records = introspect.inventory()[mark_ap:]
+    ap_promotes = (
+        hub.registry.counter("autopilot/canary_promotes").value
+        - promotes_before
+    )
+    ap_exact = int(ap_b.outputs == batcher.outputs)
     peaks = [
         r.hbm_peak_bytes for r in bench_records if r.hbm_peak_bytes
     ]
@@ -352,6 +445,28 @@ def run_micro() -> dict:
             "serve_micro.prefix_hbm_reduction_x": round(
                 px_dense_equiv / max(px.hbm_bytes_per_request(), 1e-9), 2
             ),
+            # autopilot leg: the control loop (SLO monitor + autopilot
+            # polled per round + canaried publish decided by it) must
+            # keep every structural count byte-identical to the plain
+            # leg — it acts only at round boundaries, never per token
+            "serve_micro.autopilot_emitted_tokens": (
+                ap_b.stats.emitted_tokens
+            ),
+            "serve_micro.autopilot_host_dispatches": (
+                ap_b.stats.host_dispatches
+            ),
+            "serve_micro.autopilot_readbacks": ap_b.stats.readbacks,
+            "serve_micro.autopilot_steady_state_compiles": len(
+                ap_window_records
+            ),
+            "serve_micro.autopilot_added_dispatches": (
+                ap_b.stats.host_dispatches - st.host_dispatches
+            ),
+            # the canary actually flowed through the decision loop (a
+            # silently skipped canary would let a decision-path dispatch
+            # hide) and the emissions stayed exact
+            "serve_micro.autopilot_canary_promotes": ap_promotes,
+            "serve_micro.autopilot_exact_vs_plain": ap_exact,
         },
     }
 
@@ -531,6 +646,8 @@ def default_thresholds(metrics: dict) -> dict:
             ".paged_exact_vs_contiguous",
             ".prefix_hit_rate",
             ".prefix_hbm_reduction_x",
+            ".autopilot_canary_promotes",
+            ".autopilot_exact_vs_plain",
         )):
             specs[name] = {
                 "value": value, "direction": "higher", "rel_tol": 0.0,

@@ -91,6 +91,22 @@ def make_workload(*, vocab, requests, seed, prompt_lo, prompt_hi,
     return arrivals
 
 
+def make_ramp_workload(*, vocab, schedule, seed=0, prompt_lo=2,
+                       prompt_hi=8, gen_lo=4, gen_hi=24):
+    """Scripted arrival-RATE ramp — phases of (steps, arrivals/step)
+    with exactly deterministic arrival times. Delegates to
+    ``resilience.chaos.ramp_arrivals`` so ONE injector shapes both the
+    SLO-autopilot chaos legs and this bench's overload workloads (the
+    same schedule reproduces the same queue depths and shed/scale
+    decisions either place)."""
+    from d9d_tpu.resilience.chaos import ramp_arrivals
+
+    return ramp_arrivals(
+        schedule, vocab=vocab, seed=seed, prompt_lo=prompt_lo,
+        prompt_hi=prompt_hi, gen_lo=gen_lo, gen_hi=gen_hi,
+    )
+
+
 def make_shared_prefix_workload(*, vocab, requests, seed, prefix_len,
                                 tail_lo, tail_hi, gen_lo, gen_hi,
                                 mean_interarrival):
