@@ -98,6 +98,26 @@ class TrainerConfig(pydantic.BaseModel):
     # "serving traffic")
     metrics_port: int | None = pydantic.Field(default=None, ge=0)
 
+    # training numerics plane (telemetry/numerics.py,
+    # docs/design/observability.md "Training numerics plane"): per-layer
+    # device-side tensor statistics (grad/activation RMS + absmax,
+    # update:param ratio, optimizer second-moment health, per-leaf
+    # finite masks) computed INSIDE the jitted step every this-many
+    # steps — and additionally at every step whose metrics the loop
+    # fetches anyway (log cadence / guard-forced checkpoint fetch), so
+    # the window the host decodes is always the fetched step's own.
+    # The stats ride the existing metric readback: off-cadence steps add
+    # zero host dispatches and zero readbacks (bench-gated). None =
+    # compiled out entirely (seed behavior). 1 = freshest provenance
+    # (the anomaly guard names the first non-finite layer of the exact
+    # anomalous step).
+    numerics_every_steps: int | None = pydantic.Field(default=None, ge=1)
+    # drift policies over training metrics (numerics.default_drift_
+    # policies: grad-norm drift vs rolling baseline, update:param ratio
+    # out of band, loss spike) evaluated at the log cadence, surfacing
+    # train_slo/* gauges on /metrics. Active only with numerics enabled.
+    numerics_drift: bool = True
+
     # ZeRO-style optimizer-state sharding (parallel/zero.py,
     # docs/design/zero_sharding.md): partition fp32 masters + Adam
     # moments across the dp_replicate mesh axis — grads reduce-scattered

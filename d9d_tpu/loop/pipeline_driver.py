@@ -193,6 +193,7 @@ class PipelineTrainEngine:
         peft_method=None,
         anomaly_policy: str | None = None,
         zero_sharding: bool = False,
+        numerics: bool = False,
     ):
         if not isinstance(task, PipelineTrainTask):
             raise TypeError(
@@ -292,6 +293,19 @@ class PipelineTrainEngine:
             if anomaly_policy is not None
             else None
         )
+        # per-stage numerics plane (telemetry/numerics.py): specs name
+        # each stage's rows for the trainer's host decode; the stats
+        # executables live on the PipelinedOptimizer and only dispatch
+        # on cadence steps (step(numerics=True))
+        self.numerics = numerics
+        self.numerics_specs: dict[int, Any] = {}
+        if numerics:
+            from d9d_tpu.telemetry.numerics import build_param_spec
+
+            self.numerics_specs = {
+                s: build_param_spec(rt.params)
+                for s, rt in self.stages.items()
+            }
         logger.info(
             "pipeline engine: %d stages over pp=%d (%s), %d microbatches",
             self.num_stages,
@@ -335,10 +349,25 @@ class PipelineTrainEngine:
         with compat.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
             return result.loss_sum / jnp.maximum(result.weight_sum, 1e-8)
 
-    def step(self, microbatches: list[PyTree]) -> dict:
-        """One optimizer step over the microbatch list → device metrics."""
+    def step(self, microbatches: list[PyTree], *, numerics: bool = False) -> dict:
+        """One optimizer step over the microbatch list → device metrics.
+
+        ``numerics=True`` (cadence steps only, trainer-driven) dispatches
+        one per-stage stats executable BEFORE the optimizer update (the
+        update donates params/grads/opt_state buffers) and folds the
+        flat vectors into the metric dict as ``numerics/s{S}`` —
+        off-cadence steps add zero dispatches to the controller loop.
+        """
         result = self.executor.step(microbatches)
         params = {s: rt.params for s, rt in self.stages.items()}
+        numerics_metrics = {}
+        if numerics and self.numerics:
+            for s in sorted(params):
+                numerics_metrics[f"numerics/s{s}"] = (
+                    self.optimizer.stage_numerics(
+                        s, params[s], result.grads[s], self.opt_states[s]
+                    )
+                )
         guard_metrics = {}
         if self.anomaly_policy is not None:
             (new_params, self.opt_states, grad_norm, guard_metrics,
@@ -360,6 +389,7 @@ class PipelineTrainEngine:
             "grad_norm": grad_norm,
             "loss_weight": result.weight_sum,
             **guard_metrics,
+            **numerics_metrics,
             **{f"task/{k}": v for k, v in result.metrics.items()},
         }
 

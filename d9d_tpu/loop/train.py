@@ -58,6 +58,11 @@ from d9d_tpu.telemetry import (
     get_telemetry,
     recompile_guard,
 )
+from d9d_tpu.telemetry.numerics import (
+    NumericsMonitor,
+    TrainDriftMonitor,
+    default_drift_policies,
+)
 from d9d_tpu.telemetry.introspect import executable_flops
 from d9d_tpu.telemetry.flops import (
     active_param_count,
@@ -127,6 +132,7 @@ class Trainer:
                 peft_method=peft_method,
                 anomaly_policy=config.anomaly_policy,
                 zero_sharding=config.zero_sharding,
+                numerics=config.numerics_every_steps is not None,
             )
             self.events.emit(ev.EVENT_MODEL_READY, trainer=self)
             self.events.emit(ev.EVENT_OPTIMIZER_READY, trainer=self)
@@ -195,6 +201,7 @@ class Trainer:
                 anomaly_policy=config.anomaly_policy,
                 zero=self.zero,
                 split_update=config.split_optimizer_update,
+                numerics=config.numerics_every_steps is not None,
             )
 
         self.dataset_provider = dataset_provider
@@ -235,6 +242,23 @@ class Trainer:
             else None
         )
         self.preemption = PreemptionGuard(enabled=config.handle_preemption)
+        # training numerics plane (telemetry/numerics.py): host half —
+        # decodes the cadence windows the metric fetch already carried,
+        # names the first non-finite layer for the anomaly guard, feeds
+        # numerics/* gauges + the schema-v4 JSONL event; drift policies
+        # gauge train_slo/* over the same host metric dicts
+        self.numerics_monitor = (
+            NumericsMonitor(telemetry=get_telemetry())
+            if config.numerics_every_steps is not None
+            else None
+        )
+        self.drift_monitor = (
+            TrainDriftMonitor(
+                default_drift_policies(), telemetry=get_telemetry()
+            )
+            if config.numerics_every_steps is not None and config.numerics_drift
+            else None
+        )
         self.gc = ManualGarbageCollector(config.gc_every_steps)
         self.metric_collector = MetricCollector(self.task)
         self.run = None  # tracker run, opened in train()
@@ -400,10 +424,39 @@ class Trainer:
         self.stepper.advance()
         return metrics
 
+    def _fetches_metrics(self, step: int) -> bool:
+        """Will the loop fetch ``step``'s metrics? Log cadence, final
+        step, or a guard-forced checkpoint fetch (a checkpoint step only
+        forces a fetch when the anomaly guard must examine the state
+        being saved). THE predicate — shared by the loop's fetch site
+        and :meth:`_numerics_on`, so a computed numerics window is
+        always one the host actually decodes and vice versa."""
+        return (
+            step % self.config.log_every == 0
+            or step >= self.config.total_steps
+            or (
+                self.anomaly_guard is not None
+                and self.checkpointer is not None
+                and self.checkpointer.should_checkpoint(step)
+            )
+        )
+
+    def _numerics_on(self) -> bool:
+        """Whether THIS step computes its numerics window: the config
+        cadence, plus every step whose metrics the loop will fetch
+        anyway (:meth:`_fetches_metrics`) — the window the host decodes
+        is always the fetched step's own, at zero extra fetches."""
+        k = self.config.numerics_every_steps
+        if k is None:
+            return False
+        nxt = self.stepper.step + 1
+        return nxt % k == 0 or self._fetches_metrics(nxt)
+
     def _optimizer_step(self, batch: PyTree) -> dict:
         if self.pp_engine is not None:
-            return self.pp_engine.step(batch)
+            return self.pp_engine.step(batch, numerics=self._numerics_on())
         rng = jax.random.fold_in(self.step_rng, self.stepper.step)
+        self.step_fn.numerics_next = self._numerics_on()
         self.params, self.opt_state, metrics = self.step_fn(
             self.params, self.opt_state, batch, rng
         )
@@ -509,13 +562,35 @@ class Trainer:
             logger.info("resumed from checkpoint at step %d", step)
 
     def _reset_guard_state(self) -> None:
-        """Zero both halves of the anomaly guard (post-rollback)."""
+        """Zero both halves of the anomaly guard (post-rollback), plus
+        the numerics/drift windows the restored state invalidates."""
         if self.anomaly_guard is not None:
             self.anomaly_guard.reset()
         if self.pp_engine is not None:
             self.pp_engine.reset_guard()
         elif self.step_fn is not None:
             self.step_fn.reset_guard()
+        if self.numerics_monitor is not None:
+            self.numerics_monitor.reset()
+        if self.drift_monitor is not None:
+            self.drift_monitor.reset()
+
+    def _numerics_windows(self, vecs: dict) -> list:
+        """(prefix, spec, host vector) windows for the monitor: the
+        single-program step's ``numerics/stats``, or one ``pp/s{S}/``-
+        prefixed window per stage under PP."""
+        windows = []
+        if self.pp_engine is not None:
+            for s, spec in sorted(self.pp_engine.numerics_specs.items()):
+                vec = vecs.get(f"numerics/s{s}")
+                if vec is not None:
+                    windows.append((f"pp/s{s}/", spec, vec))
+            return windows
+        spec = self.step_fn.numerics_spec
+        vec = vecs.get("numerics/stats")
+        if spec is not None and vec is not None:
+            windows.append(("", spec, vec))
+        return windows
 
     # -- the loop ------------------------------------------------------
 
@@ -666,21 +741,14 @@ class Trainer:
                     clock.mark("device_block")
                     self.timeout.set_periodic()
                     guard_action = "ok"
-                    # the guard must also observe on checkpoint steps that
-                    # fall between log cadences — otherwise anomalous
-                    # state could be persisted unexamined (the metric
-                    # fetch this forces costs nothing extra: the save
-                    # itself snapshots device state anyway)
-                    will_save = (
-                        self.anomaly_guard is not None
-                        and self.checkpointer is not None
-                        and self.checkpointer.should_checkpoint(step)
-                    )
-                    if (
-                        step % self.config.log_every == 0
-                        or self.stepper.finished
-                        or will_save
-                    ):
+                    # _fetches_metrics: log cadence, final step, or a
+                    # guard-forced checkpoint fetch (anomalous state
+                    # must never be persisted unexamined; the fetch
+                    # costs nothing extra — the save itself snapshots
+                    # device state anyway). The SAME predicate gates the
+                    # step's numerics window (_numerics_on), so every
+                    # fetched step decodes its own fresh window.
+                    if self._fetches_metrics(step):
                         # postprocess sees everything (it may derive scalars
                         # from vector stats, e.g. expert-load counts); only
                         # scalars survive into history/tracker — remaining
@@ -690,6 +758,16 @@ class Trainer:
                             k: float(arr) if (arr := np.asarray(v)).ndim == 0
                             else arr
                             for k, v in metrics.items()
+                        }
+                        # numerics windows ride the same fetch (the
+                        # np.asarray above IS their readback); peel them
+                        # off before task postprocess sees the dict
+                        numerics_vecs = {
+                            k: host_metrics.pop(k)
+                            for k in [
+                                k for k in host_metrics
+                                if k.startswith("numerics/")
+                            ]
                         }
                         host_metrics = self.task.metrics_postprocess(host_metrics)
                         host_metrics = {
@@ -701,13 +779,29 @@ class Trainer:
                             self.metric_collector.flush(self.run, step)
                         )
                         host_metrics["step"] = step
+                        if self.numerics_monitor is not None and numerics_vecs:
+                            report = self.numerics_monitor.ingest(
+                                step, self._numerics_windows(numerics_vecs)
+                            )
+                            if report is not None:
+                                host_metrics.update(report.scalars())
+                        # drift policies gauge BEFORE the guard acts: a
+                        # rollback this cadence must still record what
+                        # was drifting when it fired
+                        if self.drift_monitor is not None:
+                            self.drift_monitor.observe(step, host_metrics)
                         # anomaly guard, host half: the metrics are on
                         # host anyway at this cadence, so inspecting the
                         # device guard's flags (and the loss for spikes)
                         # costs no extra sync (docs/design/resilience.md)
                         if self.anomaly_guard is not None:
                             guard_action = self.anomaly_guard.observe(
-                                step, host_metrics
+                                step, host_metrics,
+                                context=(
+                                    self.numerics_monitor.guard_context()
+                                    if self.numerics_monitor is not None
+                                    else None
+                                ),
                             )
                         host_metrics["wall_s"] = time.perf_counter() - t0
                         # throughput from the batch-maths token count — live

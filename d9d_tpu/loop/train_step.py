@@ -34,6 +34,7 @@ from d9d_tpu.core.types import Array, PyTree
 from d9d_tpu.loop.control.task import TrainTask
 from d9d_tpu.parallel.zero import ZeroSharding, constrain_tree
 from d9d_tpu.resilience.anomaly import ANOMALY_POLICIES
+from d9d_tpu.telemetry import numerics as numerics_mod
 from d9d_tpu.telemetry import tracked_jit
 
 
@@ -47,20 +48,46 @@ class TrainStepFn:
     4-argument step signature. The carry never visits the host: its
     values surface through the step's metric dict, which the trainer
     fetches on its ordinary log cadence.
+
+    With the numerics plane compiled in (``numerics``), the step takes
+    one more traced operand: a device-resident boolean cadence flag
+    (two cached scalars, so toggling it never re-transfers or
+    recompiles). The trainer sets ``numerics_next`` before each call;
+    the spec naming the stats rows materializes at first trace
+    (``numerics_spec``).
     """
 
     fn: Callable[..., tuple[PyTree, PyTree, dict[str, Any]]]
     guarded: bool = False
     guard_state: Any = None  # device int32[2]: [anomaly streak, total]
+    numerics: bool = False
+    numerics_next: bool = False  # trainer-set cadence flag for the NEXT call
+    _numerics_holder: dict | None = None  # {"spec": NumericsSpec} at trace
+    _flags: Any = None  # cached (off, on) device bool scalars
+
+    @property
+    def numerics_spec(self):
+        """Row spec of ``numerics/stats`` (None until the first trace)."""
+        if self._numerics_holder is None:
+            return None
+        return self._numerics_holder.get("spec")
+
+    def _numerics_flag(self):
+        if self._flags is None:
+            self._flags = (jnp.asarray(False), jnp.asarray(True))
+        return self._flags[1] if self.numerics_next else self._flags[0]
 
     def __call__(self, params, opt_state, batch, rng):
+        args = [params, opt_state, batch, rng]
+        if self.guarded:
+            if self.guard_state is None:
+                self.guard_state = jnp.zeros((2,), jnp.int32)
+            args.append(self.guard_state)
+        if self.numerics:
+            args.append(self._numerics_flag())
         if not self.guarded:
-            return self.fn(params, opt_state, batch, rng)
-        if self.guard_state is None:
-            self.guard_state = jnp.zeros((2,), jnp.int32)
-        params, opt_state, metrics, self.guard_state = self.fn(
-            params, opt_state, batch, rng, self.guard_state
-        )
+            return self.fn(*args)
+        params, opt_state, metrics, self.guard_state = self.fn(*args)
         return params, opt_state, metrics
 
     def reset_guard(self) -> None:
@@ -85,6 +112,7 @@ def build_train_step(
     anomaly_policy: str | None = None,
     zero: ZeroSharding | None = None,
     split_update: bool = False,
+    numerics: bool = False,
 ) -> TrainStepFn:
     """Build the jitted step.
 
@@ -117,7 +145,23 @@ def build_train_step(
     inventory then splits the update's FLOPs/HBM claim out of
     ``hbm/train_step`` — the observability mode for attributing the
     optimizer stream (and watching ZeRO's 1/N argument-bytes drop).
+
+    ``numerics`` compiles the per-layer numerics plane
+    (``telemetry/numerics.py``) into the SAME program: activation taps
+    collect through the loss, per-leaf grad/param/update/moment stats
+    assemble under a ``lax.cond`` on a traced cadence flag, and the
+    flat f32 stats vector rides the metric dict as
+    ``numerics/stats`` — zero extra dispatches, zero extra readbacks
+    (off-cadence the cond skips the stats branch and the vector stays
+    NaN). Not composable with ``split_update`` (the update:param ratio
+    needs old and new params in one program).
     """
+    if numerics and split_update:
+        raise ValueError(
+            "numerics is not supported with split_optimizer_update: the "
+            "update:param ratio needs the pre- and post-update params "
+            "inside one program"
+        )
     if anomaly_policy is not None and anomaly_policy not in ANOMALY_POLICIES:
         raise ValueError(
             f"anomaly_policy must be one of {ANOMALY_POLICIES} or None, "
@@ -128,16 +172,28 @@ def build_train_step(
         zero.grad_shardings if zero is not None and zero.active else None
     )
 
+    numerics_holder: dict | None = {"spec": None} if numerics else None
+    tap_order: dict[str, int] = {}  # tap name → forward rank (probe-time)
+
     def microbatch_grads(params, mb, rng):
         def scalar_loss(p):
+            if numerics:
+                # activation taps (telemetry/numerics.py): models tap
+                # their residual stream; collection is active only here,
+                # so every other trace of the same modules is unchanged
+                with numerics_mod.collect_taps() as col:
+                    loss_sum, weight, metrics = task.loss_fn(
+                        module, p, mb, rng
+                    )
+                return loss_sum, (weight, metrics, dict(col.stats))
             loss_sum, weight, metrics = task.loss_fn(module, p, mb, rng)
-            return loss_sum, (weight, metrics)
+            return loss_sum, (weight, metrics, {})
 
         with jax.named_scope("train/microbatch_grad"):
-            (loss_sum, (weight, metrics)), grads = jax.value_and_grad(
+            (loss_sum, (weight, metrics, acts)), grads = jax.value_and_grad(
                 scalar_loss, has_aux=True
             )(params)
-        return loss_sum, weight, metrics, grads
+        return loss_sum, weight, metrics, acts, grads
 
     def accumulate_grads(params, batch, rng):
         """Microbatch scan + sum-then-scale + clip → (grads, metrics)."""
@@ -152,10 +208,12 @@ def build_train_step(
             zero_grads = constrain_tree(zero_grads, grad_shardings)
 
         def scan_body(carry, mb_and_idx):
-            grads_acc, loss_acc, weight_acc, metrics_acc = carry
+            grads_acc, loss_acc, weight_acc, metrics_acc, acts_acc = carry
             mb, idx = mb_and_idx
             mb_rng = jax.random.fold_in(rng, idx)
-            loss_sum, weight, metrics, grads = microbatch_grads(params, mb, mb_rng)
+            loss_sum, weight, metrics, acts, grads = microbatch_grads(
+                params, mb, mb_rng
+            )
             if grad_shardings is not None:
                 # pin the per-microbatch grads to the baseline (replicated)
                 # layout FIRST: the backward partitions exactly as the
@@ -169,27 +227,44 @@ def build_train_step(
             if grad_shardings is not None:
                 grads_acc = constrain_tree(grads_acc, grad_shardings)
             metrics_acc = jax.tree.map(lambda a, m: a + m, metrics_acc, metrics)
+            if numerics:
+                acts_acc = numerics_mod.merge_tap_stats(acts_acc, acts)
             return (
                 grads_acc,
                 loss_acc + loss_sum,
                 weight_acc + weight,
                 metrics_acc,
+                acts_acc,
             ), None
 
-        # probe metric structure with zeros so the scan carry is well-typed
-        init_metrics = jax.eval_shape(
-            lambda: task.loss_fn(
-                module, params, jax.tree.map(lambda x: x[0], batch), rng
-            )[2]
-        )
+        # probe metric (and tap) structure with zeros so the scan carry
+        # is well-typed; the probe runs under a collector so the tap set
+        # — and therefore the numerics row spec — is discovered here.
+        # The collector's insertion order IS forward tap order; record
+        # it before jax's dict canonicalization sorts the keys
+        # ("layers_10" < "layers_2"), so NaN provenance can walk acts in
+        # production order
+        def _probe():
+            mb0 = jax.tree.map(lambda x: x[0], batch)
+            if numerics:
+                with numerics_mod.collect_taps() as col:
+                    m = task.loss_fn(module, params, mb0, rng)[2]
+                tap_order.update(
+                    (n, i) for i, n in enumerate(col.stats)
+                )
+                return m, dict(col.stats)
+            return task.loss_fn(module, params, mb0, rng)[2], {}
+
+        init_metrics, init_acts_shape = jax.eval_shape(_probe)
         init_metrics = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), init_metrics
         )
+        init_acts = numerics_mod.init_tap_stats(init_acts_shape)
 
         idxs = jnp.arange(num_microbatches)
-        (grads, loss_sum, weight_sum, metrics), _ = lax.scan(
+        (grads, loss_sum, weight_sum, metrics, act_stats), _ = lax.scan(
             scan_body,
-            (zero_grads, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), init_metrics),
+            (zero_grads, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), init_metrics, init_acts),
             (batch, idxs),
         )
 
@@ -212,6 +287,8 @@ def build_train_step(
             "loss_weight": weight_sum,
             **{f"task/{k}": v for k, v in metrics.items()},
         }
+        if numerics:
+            return grads, out_metrics, act_stats
         return grads, out_metrics
 
     def apply_update(params, opt_state, grads, out_metrics, guard_state):
@@ -269,9 +346,83 @@ def build_train_step(
             [streak, total]
         )
 
-    def step(params, opt_state, batch, rng, guard_state=None):
-        grads, out_metrics = accumulate_grads(params, batch, rng)
-        return apply_update(params, opt_state, grads, out_metrics, guard_state)
+    def numerics_vector(
+        act_stats, out_metrics, grads, params, new_params, new_opt_state,
+        numerics_flag,
+    ):
+        """The flat stats array (telemetry/numerics.py): assembled under
+        ``lax.cond`` on the traced cadence flag, so off-cadence steps run
+        the identical single-dispatch program with the stats branch
+        skipped and the vector left all-NaN. The spec naming the rows is
+        captured at trace time — it can never drift from the layout."""
+        with jax.named_scope("train/numerics"):
+            nu = numerics_mod.find_second_moments(new_opt_state, params)
+            spec = numerics_mod.build_spec(
+                list(act_stats), numerics_mod.param_leaf_names(grads),
+                act_rank=dict(tap_order),
+            )
+            numerics_holder["spec"] = spec
+
+            def compute(ops):
+                acts, loss, g, p_old, p_new, nu_t = ops
+                parts = []
+                if acts:
+                    parts.append(
+                        numerics_mod.act_rows(acts, num_microbatches)
+                    )
+                parts.append(numerics_mod.loss_row(loss))
+                parts.append(
+                    numerics_mod.stacked_param_rows(g, p_old, p_new, nu_t)
+                )
+                return jnp.concatenate(parts, axis=0).reshape(-1)
+
+            return lax.cond(
+                numerics_flag,
+                compute,
+                lambda ops: jnp.full(
+                    (spec.flat_size,), jnp.nan, jnp.float32
+                ),
+                (act_stats, out_metrics["loss"], grads, params,
+                 new_params, nu),
+            )
+
+    def step_impl(params, opt_state, batch, rng, guard_state, numerics_flag):
+        if numerics:
+            grads, out_metrics, act_stats = accumulate_grads(
+                params, batch, rng
+            )
+        else:
+            grads, out_metrics = accumulate_grads(params, batch, rng)
+        result = apply_update(params, opt_state, grads, out_metrics, guard_state)
+        if not numerics:
+            return result
+        new_params, new_opt_state, out_metrics = result[:3]
+        out_metrics = dict(out_metrics)
+        out_metrics["numerics/stats"] = numerics_vector(
+            act_stats, out_metrics, grads, params, new_params,
+            new_opt_state, numerics_flag,
+        )
+        if anomaly_policy is not None:
+            return new_params, new_opt_state, out_metrics, result[3]
+        return new_params, new_opt_state, out_metrics
+
+    # fixed-arity adapters: tracked_jit sees exactly the operands this
+    # build threads (guard carry at 4; the never-donated numerics flag
+    # last), so signatures stay stable call to call
+    if anomaly_policy is not None and numerics:
+        def step(params, opt_state, batch, rng, guard_state, numerics_flag):
+            return step_impl(
+                params, opt_state, batch, rng, guard_state, numerics_flag
+            )
+    elif anomaly_policy is not None:
+        def step(params, opt_state, batch, rng, guard_state):
+            return step_impl(params, opt_state, batch, rng, guard_state, None)
+    elif numerics:
+        def step(params, opt_state, batch, rng, numerics_flag):
+            return step_impl(params, opt_state, batch, rng, None, numerics_flag)
+    else:
+        def step(params, opt_state, batch, rng):
+            return step_impl(params, opt_state, batch, rng, None, None)
 
     guard_ix = (4,) if anomaly_policy is not None else ()
 
@@ -304,7 +455,10 @@ def build_train_step(
         step, name="train_step",
         donate_argnums=(0, 1) + guard_ix if donate else (),
     )
-    return TrainStepFn(fn=jitted, guarded=anomaly_policy is not None)
+    return TrainStepFn(
+        fn=jitted, guarded=anomaly_policy is not None,
+        numerics=numerics, _numerics_holder=numerics_holder,
+    )
 
 
 def build_eval_step(

@@ -28,6 +28,7 @@ from d9d_tpu.pipelining import (
     PipelineStageInfo,
     distribute_layers_for_pipeline_stage,
 )
+from d9d_tpu.telemetry import numerics
 
 
 def _remat_policy(name: str):
@@ -129,9 +130,15 @@ class Qwen3DenseBackbone(nn.Module):
                 name=f"layers_{gid}",
             )(x, cos, sin, mask)
             x = self._pin(x)
+            # numerics plane (telemetry/numerics.py): tap each layer's
+            # residual-stream output HERE — outside the (possible)
+            # nn.remat boundary — named by the layer's module path.
+            # A no-op unless a numerics-enabled train step is tracing.
+            numerics.tap(f"layers_{gid}", x)
 
         if self.stage.is_last:
             x = RMSNorm(cfg.hidden_size, eps=cfg.norm_eps, name="norm")(x)
+            numerics.tap("norm", x)
         return x
 
 

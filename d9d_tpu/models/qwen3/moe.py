@@ -32,6 +32,7 @@ from d9d_tpu.ops import (
     compute_rope_frequencies,
     make_rope_cos_sin,
 )
+from d9d_tpu.telemetry import numerics
 from d9d_tpu.pipelining import (
     PipelineStageInfo,
     distribute_layers_for_pipeline_stage,
@@ -392,12 +393,18 @@ class Qwen3MoeBackbone(nn.Module):
                 name=f"layers_{gid}",
             )(x, cos, sin, mask, padding_mask)
             x = self._pin(x)
+            # numerics plane (telemetry/numerics.py): tap each layer's
+            # residual-stream output HERE — outside the (possible)
+            # nn.remat boundary — named by the layer's module path.
+            # A no-op unless a numerics-enabled train step is tracing.
+            numerics.tap(f"layers_{gid}", x)
 
         if self.stage.is_last:
             x = RMSNorm(
                 cfg.hidden_size, eps=cfg.norm_eps,
                 zero_centered=cfg.zero_centered_norms, name="norm",
             )(x)
+            numerics.tap("norm", x)
         return x
 
 

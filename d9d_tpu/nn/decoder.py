@@ -4,6 +4,7 @@ d9d/module/model/qwen3_dense/decoder_layer.py:79)."""
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from d9d_tpu.core.types import Array
@@ -37,38 +38,44 @@ class DecoderLayer(nn.Module):
     def __call__(
         self, x: Array, cos: Array, sin: Array, mask: Optional[Array] = None
     ) -> Array:
-        attn_out = GroupedQueryAttention(
-            hidden_size=self.hidden_size,
-            num_heads=self.num_heads,
-            num_kv_heads=self.num_kv_heads,
-            head_dim=self.head_dim,
-            sdpa=self.sdpa,
-            qk_norm=self.qk_norm,
-            rope_style=self.rope_style,
-            window_size=self.window_size,
-            use_sinks=self.use_sinks,
-            use_output_gate=self.use_output_gate,
-            fused_qkv=self.fused_qkv,
-            decode_max_length=self.decode_max_length,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="self_attn",
-        )(
-            RMSNorm(self.hidden_size, eps=self.norm_eps, name="input_layernorm")(x),
-            cos,
-            sin,
-            mask,
-        )
+        # named scopes (trace-time only, zero runtime cost): attach the
+        # module path to the attention/MLP HLO so profiler traces and
+        # trace_summary's device-scope table attribute per-block time —
+        # the same paths the numerics plane's taps mirror
+        with jax.named_scope("decoder/attn"):
+            attn_out = GroupedQueryAttention(
+                hidden_size=self.hidden_size,
+                num_heads=self.num_heads,
+                num_kv_heads=self.num_kv_heads,
+                head_dim=self.head_dim,
+                sdpa=self.sdpa,
+                qk_norm=self.qk_norm,
+                rope_style=self.rope_style,
+                window_size=self.window_size,
+                use_sinks=self.use_sinks,
+                use_output_gate=self.use_output_gate,
+                fused_qkv=self.fused_qkv,
+                decode_max_length=self.decode_max_length,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="self_attn",
+            )(
+                RMSNorm(self.hidden_size, eps=self.norm_eps, name="input_layernorm")(x),
+                cos,
+                sin,
+                mask,
+            )
         x = x + attn_out
-        mlp_out = SwiGLU(
-            hidden_size=self.hidden_size,
-            intermediate_size=self.intermediate_size,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="mlp",
-        )(
-            RMSNorm(
-                self.hidden_size, eps=self.norm_eps, name="post_attention_layernorm"
-            )(x)
-        )
+        with jax.named_scope("decoder/mlp"):
+            mlp_out = SwiGLU(
+                hidden_size=self.hidden_size,
+                intermediate_size=self.intermediate_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="mlp",
+            )(
+                RMSNorm(
+                    self.hidden_size, eps=self.norm_eps, name="post_attention_layernorm"
+                )(x)
+            )
         return x + mlp_out

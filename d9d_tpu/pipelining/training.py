@@ -27,6 +27,7 @@ from d9d_tpu.core.protocol import OptimizerProtocol
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.types import PyTree
 from d9d_tpu.pipelining.runtime.transfer import put_compat
+from d9d_tpu.telemetry import numerics as numerics_mod
 from d9d_tpu.telemetry import tracked_jit
 
 __all__ = ["PipelinedOptimizer"]
@@ -119,6 +120,11 @@ class PipelinedOptimizer:
         # zero-enabled stages get theirs swapped in by init() (per-stage
         # sharding tables baked into the traced program)
         self._stage_fns: dict[int, tuple] = {}
+        # per-stage numerics stats executables (telemetry/numerics.py):
+        # built lazily like the update pairs, dispatched by the engine
+        # ONLY on cadence steps — off-cadence PP steps add zero
+        # dispatches to the single-controller loop
+        self._numerics_fns: dict[int, Any] = {}
         self.zero_shardings: dict[int, Any] = {}
 
     def _stage_sq_norm(self, stage: int):
@@ -184,6 +190,34 @@ class PipelinedOptimizer:
 
     def _scoped(self, stage: int):
         return compat.set_mesh(self.scalar_shardings[stage].mesh)
+
+    # -- per-stage numerics (docs/design/observability.md) -------------
+
+    def stage_numerics(self, stage: int, params, grads, opt_state):
+        """One stage's per-leaf numerics rows as a flat f32 device
+        array (``telemetry/numerics.py`` layout, param rows only).
+
+        Dispatched BEFORE the update (the update executables donate
+        params/opt_state/grads, so post-update those buffers are gone);
+        the update:param ratio column is therefore NaN under PP —
+        cross-stage *grad/param/moment* skew is the signal this surface
+        exists for. One ``pp_numerics/s{S}/stats`` executable per stage:
+        per-stage names keep the ``hbm/*`` gauges distinct, like the
+        update pairs.
+        """
+        fn = self._numerics_fns.get(stage)
+        if fn is None:
+            def stats(params, grads, opt_state):
+                nu = numerics_mod.find_second_moments(opt_state, params)
+                return numerics_mod.stacked_param_rows(
+                    grads, params=None, new_params=params, nu=nu
+                ).reshape(-1)
+
+            fn = self._numerics_fns[stage] = tracked_jit(
+                stats, name=f"pp_numerics/s{stage}/stats"
+            )
+        with self._scoped(stage):
+            return fn(params, grads, opt_state)
 
     def init(self, stage_params: dict[int, PyTree]) -> dict[int, PyTree]:
         from d9d_tpu.core.tree_sharding import replicate_uncommitted

@@ -12,9 +12,18 @@ call, and the flags surface as ordinary metric-dict entries.
 This module is the host side: it inspects those flags whenever the
 trainer fetches metrics anyway (the log cadence — the guard never forces
 an extra sync), layers a rolling loss-spike detector on top (finite but
-exploding losses pass the device finiteness check), counts everything
+exploding losses pass the device finiteness check — the windowed-median
+baseline is :class:`~d9d_tpu.telemetry.numerics.RollingBaseline`, the
+ONE implementation shared with the drift policies), counts everything
 into ``resilience/*`` telemetry, and decides when a ``rollback`` policy
 should actually restore the last checkpoint.
+
+With the numerics plane enabled (``TrainerConfig.numerics_every_steps``,
+``telemetry/numerics.py``), the trainer passes ``observe`` a provenance
+``context`` naming the first non-finite layer of the last numerics
+window (fwd activation vs grad vs optimizer moment): the one-line
+warning and the flight-recorder dump then say *where* the NaN was
+produced, not just that step N went bad.
 
 Latency contract: device-side anomalies are *acted on* (skipped/frozen)
 the step they happen; the host *notices* them — and can trigger a
@@ -22,13 +31,12 @@ rollback — only at the next metric fetch, i.e. within ``log_every``
 steps. Chaos tests run with ``log_every=1`` to make this exact.
 """
 
-import collections
 import logging
 import math
-import statistics
 from typing import Any, Literal
 
 from d9d_tpu.telemetry import get_telemetry
+from d9d_tpu.telemetry.numerics import RollingBaseline
 
 logger = logging.getLogger("d9d_tpu.resilience")
 
@@ -70,9 +78,10 @@ class HostAnomalyGuard:
         self.policy = policy
         self.rollback_after = rollback_after
         self.spike_factor = spike_factor
-        self._losses: collections.deque[float] = collections.deque(
-            maxlen=max(spike_window, 4)
-        )
+        # the shared windowed-median baseline (telemetry/numerics.py):
+        # one definition of "the recent normal" for the spike detector
+        # and the drift policies alike
+        self._baseline = RollingBaseline(spike_window, min_samples=4)
         self._spike_streak = 0
         self._last_device_total = 0.0
         self._tele = telemetry if telemetry is not None else get_telemetry()
@@ -85,19 +94,27 @@ class HostAnomalyGuard:
         normalize itself into the new baseline."""
         if self.spike_factor is None or not math.isfinite(loss):
             return False
-        if len(self._losses) < 4:
-            self._losses.append(loss)
+        if not self._baseline.ready():
+            self._baseline.add(loss)
             return False
-        baseline = statistics.median(self._losses)
-        if loss > self.spike_factor * max(baseline, 1e-12):
+        if loss > self.spike_factor * max(self._baseline.baseline(), 1e-12):
             return True
-        self._losses.append(loss)
+        self._baseline.add(loss)
         return False
 
     # -- the cadence hook ----------------------------------------------
 
-    def observe(self, step: int, host_metrics: dict[str, Any]) -> str:
-        """Feed one fetched metric dict; returns ``ok|warn|rollback``."""
+    def observe(
+        self,
+        step: int,
+        host_metrics: dict[str, Any],
+        context: dict[str, Any] | None = None,
+    ) -> str:
+        """Feed one fetched metric dict; returns ``ok|warn|rollback``.
+
+        ``context`` (optional) is the numerics plane's provenance — the
+        first non-finite layer of the last window — folded into the
+        warning line and the flight-recorder dump's ``extra``."""
         device_flag = float(host_metrics.get(METRIC_ANOMALY, 0.0) or 0.0)
         device_streak = float(host_metrics.get(METRIC_STREAK, 0.0) or 0.0)
         device_total = float(host_metrics.get(METRIC_TOTAL, 0.0) or 0.0)
@@ -116,17 +133,25 @@ class HostAnomalyGuard:
             self._tele.counter("resilience/loss_spikes").add(1)
             logger.warning(
                 "loss spike at step %d: loss=%.6g (rolling median %.6g)",
-                step, loss, statistics.median(self._losses),
+                step, loss, self._baseline.baseline(),
             )
         elif device_flag == 0.0:
             self._spike_streak = 0
 
         anomalous = spike or device_flag > 0.0 or delta > 0.0
         if anomalous and not spike:
+            provenance = ""
+            if context and context.get("first_nonfinite"):
+                # numerics-plane attribution: the first offending layer
+                # (site:name — fwd act vs grad vs optimizer moment)
+                provenance = (
+                    f", first non-finite: {context['first_nonfinite']}"
+                )
             logger.warning(
                 "non-finite step anomaly observed at step %d "
-                "(streak=%d, total=%d, policy=%s)",
+                "(streak=%d, total=%d, policy=%s%s)",
                 step, int(device_streak), int(device_total), self.policy,
+                provenance,
             )
         if not anomalous:
             return "ok"
@@ -143,6 +168,7 @@ class HostAnomalyGuard:
                 "device_streak": device_streak,
                 "device_total": device_total,
                 "policy": self.policy,
+                **(context or {}),
             })
 
         if self.policy == "rollback" and (
@@ -155,6 +181,6 @@ class HostAnomalyGuard:
     def reset(self) -> None:
         """Forget streak state (after a rollback restored a checkpoint
         the pre-rollback history no longer describes the live run)."""
-        self._losses.clear()
+        self._baseline.clear()
         self._spike_streak = 0
         self._last_device_total = 0.0

@@ -79,6 +79,12 @@ __all__ = [
     "SloPolicy",
     "StreamingQuantileDigest",
     "FlightRecorder",
+    # training numerics plane (telemetry/numerics.py)
+    "DriftPolicy",
+    "NumericsMonitor",
+    "RollingBaseline",
+    "TrainDriftMonitor",
+    "default_drift_policies",
 ]
 
 
@@ -102,6 +108,10 @@ class Telemetry:
         # a no-op (telemetry/flight_recorder.py)
         self.slo_monitor = None
         self.flight_recorder = None
+        # last numerics window (telemetry/numerics.py): kept so flight-
+        # recorder dumps carry the per-layer stats + first-non-finite
+        # verdict of the moment things went wrong
+        self.last_numerics = None
         self._slo_eval_warned_t = -float("inf")
 
     # -- instrument passthrough (the API components actually use) ------
@@ -178,6 +188,14 @@ class Telemetry:
         for sink in self.sinks:
             sink.on_request_trace(record)
 
+    def record_numerics(self, record: dict[str, Any]) -> None:
+        """Stream one per-layer numerics window (schema v4 ``numerics``,
+        telemetry/numerics.py) to every sink, and keep it as the hub's
+        ``last_numerics`` so flight-recorder dumps carry the window."""
+        self.last_numerics = record
+        for sink in self.sinks:
+            sink.on_numerics(record)
+
     def flush(self, step: int | None = None) -> dict[str, Any]:
         """Snapshot every instrument and hand it to each sink; returns
         the snapshot (callers fold headline values into their own logs).
@@ -217,7 +235,8 @@ class Telemetry:
             return None
         try:
             return self.flight_recorder.dump(
-                event, self.registry, extra=extra
+                event, self.registry, extra=extra,
+                numerics=self.last_numerics,
             )
         except Exception:  # noqa: BLE001 — see docstring
             return None
@@ -283,6 +302,13 @@ from d9d_tpu.telemetry.slo import (  # noqa: E402
     SloMonitor,
     SloPolicy,
     StreamingQuantileDigest,
+)
+from d9d_tpu.telemetry.numerics import (  # noqa: E402
+    DriftPolicy,
+    NumericsMonitor,
+    RollingBaseline,
+    TrainDriftMonitor,
+    default_drift_policies,
 )
 
 

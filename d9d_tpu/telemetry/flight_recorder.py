@@ -68,7 +68,12 @@ class FlightRecorder:
         self._last_dump: dict[str, float] = {}
 
     def dump(
-        self, event: str, registry, *, extra: dict | None = None
+        self,
+        event: str,
+        registry,
+        *,
+        extra: dict | None = None,
+        numerics: dict | None = None,
     ) -> Path | None:
         """Write ``flight_recorder_{event}.json``; returns the path, or
         None when rate-limited. Never raises (logged instead)."""
@@ -100,6 +105,11 @@ class FlightRecorder:
                     for s in spans
                 ],
                 "executables": _jsonable(executables),
+                # last numerics window (telemetry/numerics.py): the
+                # per-layer stats + first-non-finite verdict of the
+                # moment things went wrong — the "where", next to the
+                # flush ring's "when"
+                **({"numerics": _jsonable(numerics)} if numerics else {}),
                 **({"extra": _jsonable(extra)} if extra else {}),
             }
             self.directory.mkdir(parents=True, exist_ok=True)

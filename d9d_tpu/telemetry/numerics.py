@@ -1,0 +1,776 @@
+"""Training numerics observability plane (docs/design/observability.md
+"Training numerics plane").
+
+The anomaly guard (PR 5) sees two scalars — loss and global grad-norm —
+so a NaN dump says *that* a step went bad, never *where*. This module is
+the per-layer substrate underneath it:
+
+- **Device side** (traced, zero added dispatches/readbacks): per-leaf /
+  per-scope tensor statistics — grad RMS and absmax, parameter RMS,
+  update-to-parameter ratio, optimizer second-moment health, and a
+  per-row finite mask — stacked into ONE flat f32 device array that
+  rides the step's ordinary metric dict. The heavy statistics are gated
+  by a traced cadence flag (``lax.cond``): off-cadence steps run the
+  identical program with the stats branch skipped and the vector left
+  all-NaN, and the host only materializes it at the log cadence it was
+  already fetching metrics at (``tools/bench_compare.py``'s tiny-train
+  leg pins host_dispatches/readbacks byte-identical to a numerics-free
+  loop at off-cadence steps).
+- **Activation taps** (:func:`tap`): models mark residual-stream points
+  (``layers_{gid}`` in the qwen3 backbones, mirroring their
+  ``jax.named_scope`` module paths). A tap is a no-op unless a
+  :func:`collect_taps` context is active — which only the
+  numerics-enabled train step opens around ``task.loss_fn`` — so
+  serving/eval/seed training trace byte-identical programs. Taps must
+  sit OUTSIDE ``nn.remat`` boundaries (a tracer captured from inside a
+  remat body leaks); the backbones tap each layer's *output* at the
+  layer-loop call site for exactly this reason.
+- **Host side**: :class:`NumericsSpec` (row names in device order),
+  :class:`NumericsReport` decode with **NaN provenance** — the finite
+  mask names the first offending row, ordered forward activations →
+  loss → per-leaf grads → optimizer moments, which is the order the
+  NaN was *produced* in — plus :class:`NumericsMonitor`, which feeds
+  gauges, the schema-v4 ``numerics`` JSONL event, and the flight
+  recorder's last-window context.
+- **Drift policies**: :class:`DriftPolicy`/:class:`TrainDriftMonitor` —
+  ``SloPolicy``-style declarative rules over training metrics
+  (grad-norm drift vs a rolling baseline, update:param ratio out of
+  band, loss spike), evaluated at the log cadence, surfacing
+  ``train_slo/*`` gauges on ``/metrics`` and bumping
+  ``train_slo/violations`` once per window. :class:`RollingBaseline` is
+  the ONE windowed-median baseline implementation — the host anomaly
+  guard's loss-spike detector (``resilience/anomaly.py``) delegates to
+  it rather than keeping a second copy.
+
+No jax at module import (the telemetry package core stays jax-free);
+traced helpers defer the import to first use, like ``introspect.py``.
+"""
+
+import collections
+import contextlib
+import dataclasses
+import logging
+import math
+import statistics
+import threading
+import time
+from typing import Any, Iterable, Literal, Sequence
+
+__all__ = [
+    "DriftPolicy",
+    "NumericsMonitor",
+    "NumericsReport",
+    "NumericsRow",
+    "NumericsSpec",
+    "RollingBaseline",
+    "TrainDriftMonitor",
+    "STAT_COLUMNS",
+    "build_spec",
+    "collect_taps",
+    "default_drift_policies",
+    "find_second_moments",
+    "param_leaf_names",
+    "tap",
+]
+
+logger = logging.getLogger("d9d_tpu.telemetry")
+
+# one row of the flat stats array = one scope (activation tap, the loss,
+# or one parameter leaf) x these columns. Rows of every kind share the
+# layout; columns that don't apply to a kind are NaN.
+STAT_COLUMNS = (
+    "rms",           # grad RMS (param rows) / activation RMS (act rows) / |loss|
+    "absmax",        # max |grad| / max |activation| / loss value
+    "param_rms",     # RMS of the post-update parameter leaf
+    "update_ratio",  # RMS(new - old) / RMS(new) — the update:param ratio
+                     # (post-update denominator: see _leaf_row)
+    "moment2_max",   # max of the Adam second-moment leaf (optimizer health)
+    "finite",        # finite code: act/loss 0|1; param rows bit0=grads, bit1=moments
+)
+N_COLS = len(STAT_COLUMNS)
+
+KIND_ACT = "act"
+KIND_LOSS = "loss"
+KIND_PARAM = "param"
+
+
+# -- spec: the host-side naming of the device array's rows ---------------
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsRow:
+    name: str
+    kind: str  # act | loss | param
+    # forward/production rank for provenance ordering. Device row layout
+    # follows jax's canonical (sorted) dict order through scan/cond, so
+    # for act rows this records the TAP order ("layers_2" fires before
+    # "layers_10" even though it sorts after) — _first_nonfinite walks
+    # acts by this rank, never by layout position.
+    order: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsSpec:
+    """Row names/kinds in the exact order the device array stacks them:
+    activation taps (forward order) → the loss → parameter leaves (tree
+    order). Built at trace time, so the naming can never drift from the
+    compiled layout."""
+
+    rows: tuple[NumericsRow, ...]
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+    @property
+    def flat_size(self) -> int:
+        return len(self.rows) * N_COLS
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        parts.append(str(p) if key is None else str(key))
+    return "/".join(parts)
+
+
+def param_leaf_names(params) -> list[str]:
+    """Leaf names from the parameter tree's paths (flax module paths:
+    ``layers_0/self_attn/q_proj/kernel``), in tree-flatten order — the
+    same order the device stats stack in. A common leading ``params/``
+    collection is stripped."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    names = [_path_str(path) for path, _ in leaves]
+    if names and all(n.startswith("params/") for n in names):
+        names = [n[len("params/"):] for n in names]
+    return names
+
+
+def build_spec(
+    act_names: Sequence[str], param_names: Sequence[str], *,
+    include_loss: bool = True,
+    act_rank: dict[str, int] | None = None,
+) -> NumericsSpec:
+    """``act_names`` in DEVICE layout order (jax's sorted dict order);
+    ``act_rank`` maps tap name → forward application rank so provenance
+    can walk acts in the order the NaN was produced, not sorted order."""
+    rows = [
+        NumericsRow(n, KIND_ACT, (act_rank or {}).get(n, i))
+        for i, n in enumerate(act_names)
+    ]
+    if include_loss:
+        rows.append(NumericsRow("loss", KIND_LOSS))
+    rows.extend(NumericsRow(n, KIND_PARAM) for n in param_names)
+    return NumericsSpec(rows=tuple(rows))
+
+
+def build_param_spec(params) -> NumericsSpec:
+    """Param-rows-only spec (the PP per-stage form: stages see grads and
+    params, not the global loss or the forward taps)."""
+    return build_spec((), param_leaf_names(params), include_loss=False)
+
+
+# -- activation taps (trace-time collection) -----------------------------
+
+_tls = threading.local()
+
+
+class _TapCollector:
+    """Per-trace accumulator: name → stacked ``[sq_mean, absmax, finite]``
+    f32 device values. A re-tapped name (shared module applied N times)
+    merges rather than overwrites, so row count stays trace-stable; the
+    sq_mean merge weights every application equally (running mean over
+    the trace-time application count, not a pairwise average)."""
+
+    __slots__ = ("stats", "_counts")
+
+    def __init__(self):
+        self.stats: dict[str, Any] = {}
+        self._counts: dict[str, int] = {}
+
+    def add(self, name: str, x) -> None:
+        import jax.numpy as jnp
+
+        x32 = jnp.asarray(x).astype(jnp.float32)
+        new = jnp.stack([
+            jnp.mean(jnp.square(x32)),
+            jnp.max(jnp.abs(x32)),
+            jnp.all(jnp.isfinite(x32)).astype(jnp.float32),
+        ])
+        prev = self.stats.get(name)
+        if prev is not None:
+            k = self._counts[name]
+            new = jnp.stack([
+                (prev[0] * k + new[0]) / (k + 1),
+                jnp.maximum(prev[1], new[1]),
+                jnp.minimum(prev[2], new[2]),
+            ])
+        self._counts[name] = self._counts.get(name, 0) + 1
+        self.stats[name] = new
+
+
+def tap(name: str, x) -> None:
+    """Observe an activation for the numerics plane. No-op (not even a
+    traced op) unless a :func:`collect_taps` context is active — only the
+    numerics-enabled train step opens one, so models can tap
+    unconditionally. Call OUTSIDE ``nn.remat`` bodies (see module doc)."""
+    col = getattr(_tls, "collector", None)
+    if col is not None:
+        col.add(name, x)
+
+
+@contextlib.contextmanager
+def collect_taps():
+    """Activate tap collection for the enclosed trace region; yields the
+    collector whose ``.stats`` maps tap name → ``[3]`` f32 stats."""
+    prev = getattr(_tls, "collector", None)
+    col = _TapCollector()
+    _tls.collector = col
+    try:
+        yield col
+    finally:
+        _tls.collector = prev
+
+
+# -- device-side assembly (traced helpers) -------------------------------
+
+
+def find_second_moments(opt_state, params):
+    """The Adam-family second-moment (``nu``) tree matching ``params``'
+    structure, or None. Walks the (possibly wrapped/nested) optimizer
+    state for the first node carrying both ``mu`` and ``nu`` — optax's
+    ``ScaleByAdamState`` shape, which ``stochastic_adamw`` shares."""
+    import jax
+
+    treedef = jax.tree_util.tree_structure(params)
+    found: list[Any] = []
+
+    def walk(node):
+        if found:
+            return
+        if hasattr(node, "nu") and hasattr(node, "mu"):
+            found.append(node.nu)
+            return
+        if isinstance(node, (list, tuple)):
+            for c in node:
+                walk(c)
+        elif isinstance(node, dict):
+            for c in node.values():
+                walk(c)
+
+    walk(opt_state)
+    if not found:
+        return None
+    nu = found[0]
+    if jax.tree_util.tree_structure(nu) != treedef:
+        return None
+    return nu
+
+
+def _leaf_row(g, p_old, p_new, nu_leaf):
+    """One param row: [grad_rms, grad_absmax, param_rms, update_ratio,
+    moment2_max, finite_code] as a [N_COLS] f32 stack (all operands may
+    be None except ``g``)."""
+    import jax.numpy as jnp
+
+    g32 = jnp.asarray(g).astype(jnp.float32)
+    grad_rms = jnp.sqrt(jnp.mean(jnp.square(g32)))
+    grad_absmax = jnp.max(jnp.abs(g32))
+    grad_finite = jnp.all(jnp.isfinite(g32)).astype(jnp.float32)
+    nan = jnp.float32(jnp.nan)
+    if p_new is not None:
+        pn32 = jnp.asarray(p_new).astype(jnp.float32)
+        param_rms = jnp.sqrt(jnp.mean(jnp.square(pn32)))
+    else:
+        param_rms = nan
+    if p_old is not None and p_new is not None:
+        po32 = jnp.asarray(p_old).astype(jnp.float32)
+        upd_rms = jnp.sqrt(jnp.mean(jnp.square(pn32 - po32)))
+        # denominator is the POST-update RMS: a zero-initialized leaf
+        # (bias at step 0) then reads ~1 instead of 1/eps, and in steady
+        # state new ≈ old so the conventional ratio is unchanged
+        update_ratio = upd_rms / (param_rms + 1e-8)
+    else:
+        update_ratio = nan
+    if nu_leaf is not None:
+        nu32 = jnp.asarray(nu_leaf).astype(jnp.float32)
+        moment2_max = jnp.max(nu32)
+        moment_finite = jnp.all(jnp.isfinite(nu32)).astype(jnp.float32)
+    else:
+        moment2_max = nan
+        moment_finite = jnp.float32(1.0)
+    finite = grad_finite + 2.0 * moment_finite
+    return jnp.stack([
+        grad_rms, grad_absmax, param_rms, update_ratio, moment2_max, finite,
+    ])
+
+
+def stacked_param_rows(grads, params=None, new_params=None, nu=None):
+    """[n_leaves, N_COLS] f32 rows over the grad tree's leaves, in the
+    tree order :func:`param_leaf_names` reports. Traced — call inside
+    the jitted step (or a per-stage stats executable under PP)."""
+    import jax
+    import jax.numpy as jnp
+
+    g_leaves = jax.tree.leaves(grads)
+    p_leaves = jax.tree.leaves(params) if params is not None else [None] * len(g_leaves)
+    n_leaves = (
+        jax.tree.leaves(new_params) if new_params is not None
+        else [None] * len(g_leaves)
+    )
+    nu_leaves = jax.tree.leaves(nu) if nu is not None else [None] * len(g_leaves)
+    rows = [
+        _leaf_row(g, p, pn, v)
+        for g, p, pn, v in zip(g_leaves, p_leaves, n_leaves, nu_leaves)
+    ]
+    return jnp.stack(rows)
+
+
+def act_rows(act_stats: dict[str, Any], num_microbatches: int):
+    """[n_taps, N_COLS] rows from microbatch-aggregated tap stats
+    (``[sq_sum, absmax, finite_min]`` per tap, summed/maxed/minned over
+    the microbatch scan)."""
+    import jax.numpy as jnp
+
+    nan = jnp.float32(jnp.nan)
+    rows = []
+    for name in act_stats:
+        s = act_stats[name]
+        rms = jnp.sqrt(s[0] / jnp.float32(max(num_microbatches, 1)))
+        rows.append(jnp.stack([rms, s[1], nan, nan, nan, s[2]]))
+    return jnp.stack(rows)
+
+
+def loss_row(loss):
+    import jax.numpy as jnp
+
+    loss32 = jnp.asarray(loss).astype(jnp.float32)
+    nan = jnp.float32(jnp.nan)
+    return jnp.stack([
+        jnp.abs(loss32), loss32, nan, nan, nan,
+        jnp.isfinite(loss32).astype(jnp.float32),
+    ])[None, :]
+
+
+def merge_tap_stats(acc, new):
+    """Scan-carry aggregation of two tap-stat dicts: sq_mean sums (the
+    finalize divides by the trip count), absmax maxes, finite mins."""
+    import jax.numpy as jnp
+
+    return {
+        k: jnp.stack([
+            acc[k][0] + new[k][0],
+            jnp.maximum(acc[k][1], new[k][1]),
+            jnp.minimum(acc[k][2], new[k][2]),
+        ])
+        for k in acc
+    }
+
+
+def init_tap_stats(shapes: dict[str, Any]):
+    """Zero-element of :func:`merge_tap_stats` matching ``shapes``
+    (sq_sum 0, absmax -inf, finite 1)."""
+    import jax.numpy as jnp
+
+    zero = jnp.stack([
+        jnp.float32(0.0), jnp.float32(-jnp.inf), jnp.float32(1.0)
+    ])
+    return {k: zero for k in shapes}
+
+
+# -- host-side decode + monitor -----------------------------------------
+
+
+@dataclasses.dataclass
+class NumericsReport:
+    """One decoded window: per-row stats keyed by (possibly
+    stage-prefixed) scope name, plus the NaN-provenance verdict."""
+
+    step: int
+    rows: dict[str, dict[str, Any]]
+    # {"site": "act"|"loss"|"grad"|"moment", "name": row name} or None
+    first_nonfinite: dict[str, str] | None
+
+    def scalars(self) -> dict[str, float]:
+        """Aggregate scalars folded back into the trainer's host metric
+        dict (drift policies key off these)."""
+        out: dict[str, float] = {}
+        grad_rms = [
+            r["rms"] for r in self.rows.values()
+            if r["kind"] == KIND_PARAM and math.isfinite(r["rms"])
+        ]
+        ratios = [
+            r["update_ratio"] for r in self.rows.values()
+            if r["kind"] == KIND_PARAM
+            and r["update_ratio"] is not None
+            and math.isfinite(r["update_ratio"])
+        ]
+        if grad_rms:
+            out["numerics/grad_rms_max"] = max(grad_rms)
+        if ratios:
+            out["numerics/update_ratio_max"] = max(ratios)
+        out["numerics/nonfinite_rows"] = float(sum(
+            1 for r in self.rows.values() if not r["finite_ok"]
+        ))
+        return out
+
+
+def decode_window(
+    spec: NumericsSpec, vec, *, prefix: str = ""
+) -> dict[str, dict[str, Any]] | None:
+    """Decode one flat device vector against its spec → row dict, or
+    None when the window was off-cadence (all-NaN finite column)."""
+    import numpy as np
+
+    arr = np.asarray(vec, dtype=np.float64).reshape(spec.n_rows, N_COLS)
+    finite_col = arr[:, 5]
+    if not np.isfinite(finite_col).any():
+        return None
+    rows: dict[str, dict[str, Any]] = {}
+    for i, row in enumerate(spec.rows):
+        code = finite_col[i]
+        if row.kind == KIND_PARAM:
+            grad_ok = bool(int(code) & 1) if math.isfinite(code) else False
+            moment_ok = bool(int(code) & 2) if math.isfinite(code) else False
+            finite_ok = grad_ok and moment_ok
+        else:
+            grad_ok = moment_ok = finite_ok = bool(
+                math.isfinite(code) and code >= 0.5
+            )
+        rows[prefix + row.name] = {
+            "kind": row.kind,
+            "order": row.order,
+            "rms": float(arr[i, 0]),
+            "absmax": float(arr[i, 1]),
+            "param_rms": float(arr[i, 2]),
+            "update_ratio": float(arr[i, 3]),
+            "moment2_max": float(arr[i, 4]),
+            "grad_finite": grad_ok,
+            "moment_finite": moment_ok,
+            "finite_ok": finite_ok,
+        }
+    return rows
+
+
+def _first_nonfinite(
+    ordered: Iterable[tuple[str, dict[str, Any]]]
+) -> dict[str, str] | None:
+    """Provenance: the first offending row in production order — forward
+    activations (TAP order, via the rows' ``order`` rank — the device
+    layout itself is jax's sorted dict order), then the loss, then grads
+    (tree order), then moments. A NaN loss with clean activations is
+    attributed to the loss (the site that produced it — e.g.
+    ``ChaosScaleTask``'s injection)."""
+    items = list(ordered)
+    acts = [(n, r) for n, r in items if r["kind"] == KIND_ACT]
+    acts.sort(key=lambda nr: nr[1].get("order", 0))
+    for name, r in acts:
+        if not r["finite_ok"]:
+            return {"site": "act", "name": name}
+    for name, r in items:
+        if r["kind"] == KIND_LOSS and not r["finite_ok"]:
+            return {"site": "loss", "name": name}
+    for name, r in items:
+        if r["kind"] == KIND_PARAM and not r["grad_finite"]:
+            return {"site": "grad", "name": name}
+    for name, r in items:
+        if r["kind"] == KIND_PARAM and not r["moment_finite"]:
+            return {"site": "moment", "name": name}
+    return None
+
+
+class NumericsMonitor:
+    """Host half: decodes the cadence windows the trainer fetched,
+    feeds the ``numerics/*`` gauges, streams the schema-v4 ``numerics``
+    JSONL event, and keeps the last window for the anomaly guard's
+    provenance context and the flight recorder."""
+
+    def __init__(self, telemetry=None):
+        if telemetry is None:
+            from d9d_tpu.telemetry import get_telemetry
+
+            telemetry = get_telemetry()
+        self._tele = telemetry
+        self.last: NumericsReport | None = None
+
+    def ingest(
+        self,
+        step: int,
+        windows: Sequence[tuple[str, NumericsSpec, Any]],
+    ) -> NumericsReport | None:
+        """``windows`` is ``[(prefix, spec, host_vector), ...]`` — one
+        entry for the single-program step, one per stage under PP.
+        Returns the merged report, or None when every window was
+        off-cadence."""
+        rows: dict[str, dict[str, Any]] = {}
+        for prefix, spec, vec in windows:
+            decoded = decode_window(spec, vec, prefix=prefix)
+            if decoded is not None:
+                rows.update(decoded)
+        if not rows:
+            return None
+        report = NumericsReport(
+            step=step,
+            rows=rows,
+            first_nonfinite=_first_nonfinite(rows.items()),
+        )
+        self.last = report
+        self._tele.gauge("numerics/last_step").set(float(step))
+        self._tele.counter("numerics/windows").add(1)
+        for k, v in report.scalars().items():
+            self._tele.gauge(k).set(v)
+        record: dict[str, Any] = {
+            "step": step,
+            "unix_time": time.time(),
+            "rows": {
+                name: {
+                    stat: (r[stat] if math.isfinite(r[stat]) else None)
+                    for stat in STAT_COLUMNS[:-1]
+                } | {"kind": r["kind"], "finite": bool(r["finite_ok"])}
+                for name, r in rows.items()
+            },
+        }
+        if report.first_nonfinite is not None:
+            record["first_nonfinite"] = report.first_nonfinite
+        self._tele.record_numerics(record)
+        return report
+
+    def guard_context(self) -> dict[str, Any] | None:
+        """Provenance context for ``HostAnomalyGuard.observe``: the last
+        window's first-offending row (None while everything is finite)."""
+        if self.last is None or self.last.first_nonfinite is None:
+            return None
+        fn = self.last.first_nonfinite
+        return {
+            "first_nonfinite": f"{fn['site']}:{fn['name']}",
+            "numerics_step": self.last.step,
+        }
+
+    def reset(self) -> None:
+        """Forget the last window (post-rollback: the restored state is
+        not the one the window describes)."""
+        self.last = None
+
+
+# -- rolling baseline + drift policies ----------------------------------
+
+
+class RollingBaseline:
+    """THE windowed-median baseline (docs/design/observability.md):
+    shared by the host anomaly guard's loss-spike detector and the drift
+    policies, so there is exactly one definition of "the recent normal".
+
+    The caller decides what the window absorbs — the guard/policies add
+    only non-violating values, so a plateau of spikes can never
+    normalize itself into the new baseline (the PR 5 contract, pinned by
+    ``tests/resilience/test_anomaly_guard.py``).
+    """
+
+    def __init__(self, window: int, *, min_samples: int = 4):
+        if window < 1 or min_samples < 1:
+            raise ValueError(
+                f"need window >= 1 and min_samples >= 1, got "
+                f"{window}, {min_samples}"
+            )
+        self.min_samples = min_samples
+        self._values: collections.deque[float] = collections.deque(
+            maxlen=max(window, min_samples)
+        )
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def ready(self) -> bool:
+        return len(self._values) >= self.min_samples
+
+    def add(self, value: float) -> None:
+        self._values.append(float(value))
+
+    def baseline(self) -> float:
+        """Windowed median; NaN before ``min_samples`` values exist."""
+        if not self.ready():
+            return float("nan")
+        return statistics.median(self._values)
+
+    def ratio(self, value: float) -> float:
+        """``value / baseline`` (guarded denominator); NaN while the
+        baseline is not ready."""
+        base = self.baseline()
+        if not math.isfinite(base):
+            return float("nan")
+        return float(value) / max(base, 1e-12)
+
+    def clear(self) -> None:
+        self._values.clear()
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftPolicy:
+    """One declarative rule over a host training metric (the
+    ``SloPolicy`` shape, at step cadence instead of wall cadence).
+
+    ``kind="drift"``: violating when ``value > factor x rolling-median
+    baseline`` over the last ``window`` observed values (the baseline
+    absorbs only non-violating values). ``kind="band"``: violating when
+    the value leaves ``[lo, hi]`` (either bound may be None); the first
+    ``min_samples`` observations only gauge, never page — a fresh run's
+    step-0 transient (zero-initialized leaves take their first real
+    update) must not fire the pager.
+
+    ``burn = observed / threshold`` (drift: ``factor x baseline``;
+    band: the violated bound), mirroring the serving SLO convention —
+    burning at ``burn >= 1``.
+    """
+
+    name: str
+    metric: str
+    kind: Literal["drift", "band"] = "drift"
+    factor: float = 10.0
+    window: int = 64
+    lo: float | None = None
+    hi: float | None = None
+    min_samples: int = 4
+
+    def __post_init__(self):
+        if not self.name or not self.metric:
+            raise ValueError("DriftPolicy needs a name and a metric")
+        if self.kind == "drift":
+            if self.factor <= 1.0 or self.window < self.min_samples:
+                raise ValueError(
+                    f"{self.name}: drift needs factor > 1 and "
+                    f"window >= min_samples"
+                )
+        elif self.kind == "band":
+            if self.lo is None and self.hi is None:
+                raise ValueError(f"{self.name}: band needs lo and/or hi")
+        else:
+            raise ValueError(f"{self.name}: unknown kind {self.kind!r}")
+
+
+def default_drift_policies() -> tuple[DriftPolicy, ...]:
+    """The trainer's stock policy set (``TrainerConfig.numerics_drift``):
+    grad-norm drift vs its rolling baseline, update:param ratio out of
+    band (an optimizer moving some parameter leaf by > 50% RMS per step
+    is pathological at any LR schedule this repo ships — small-norm
+    leaves like biases legitimately see 10-20% early in training), and
+    the loss-spike rule the host anomaly guard also acts on."""
+    return (
+        DriftPolicy(name="grad_norm_drift", metric="grad_norm",
+                    kind="drift", factor=10.0, window=64),
+        DriftPolicy(name="update_ratio_band",
+                    metric="numerics/update_ratio_max", kind="band",
+                    hi=0.5),
+        DriftPolicy(name="loss_spike", metric="loss", kind="drift",
+                    factor=10.0, window=64),
+    )
+
+
+class TrainDriftMonitor:
+    """Evaluate drift policies against each log-cadence host metric dict;
+    surface ``train_slo/*`` gauges (scraped live by ``/metrics``) and
+    bump ``train_slo/violations`` at most once per ``window`` steps per
+    policy — a sustained drift pages once per window, not per cadence."""
+
+    def __init__(
+        self, policies: Sequence[DriftPolicy], *, telemetry=None
+    ):
+        names = [p.name for p in policies]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate drift policy names in {names}")
+        if telemetry is None:
+            from d9d_tpu.telemetry import get_telemetry
+
+            telemetry = get_telemetry()
+        self._tele = telemetry
+        self.policies = tuple(policies)
+        self._baselines = {
+            p.name: RollingBaseline(p.window, min_samples=p.min_samples)
+            for p in self.policies if p.kind == "drift"
+        }
+        self._band_seen: dict[str, int] = {}
+        self._last_violation: dict[str, int] = {}
+
+    def observe(self, step: int, host_metrics: dict[str, Any]) -> list[str]:
+        """→ names of the policies burning at this observation."""
+        burning: list[str] = []
+        for p in self.policies:
+            raw = host_metrics.get(p.metric)
+            if raw is None:
+                continue
+            try:
+                value = float(raw)
+            except (TypeError, ValueError):
+                continue
+            if not math.isfinite(value):
+                continue
+            baseline = float("nan")
+            if p.kind == "drift":
+                rb = self._baselines[p.name]
+                baseline = rb.baseline()
+                if not rb.ready():
+                    rb.add(value)
+                    continue
+                threshold = p.factor * max(baseline, 1e-12)
+                burn = value / threshold
+                violating = burn >= 1.0
+                if not violating:
+                    rb.add(value)
+            else:
+                seen = self._band_seen.get(p.name, 0)
+                self._band_seen[p.name] = seen + 1
+                # guarded ratios: a zero bound (metric expected <= 0) is
+                # a legitimate band — burn saturates instead of dividing
+                # by zero, and `is not None` keeps hi=0.0 from reading
+                # as an absent bound
+                if p.hi is not None and value > p.hi:
+                    burn = value / p.hi if abs(p.hi) > 1e-12 else math.inf
+                    violating = seen >= p.min_samples
+                elif p.lo is not None and value < p.lo:
+                    burn = p.lo / value if value > 1e-12 else math.inf
+                    violating = seen >= p.min_samples
+                else:
+                    burn = (
+                        value / p.hi
+                        if p.hi is not None and abs(p.hi) > 1e-12
+                        else 0.0
+                    )
+                    violating = False
+            self._tele.gauge(f"train_slo/{p.name}/observed").set(value)
+            if math.isfinite(baseline):
+                self._tele.gauge(f"train_slo/{p.name}/baseline").set(baseline)
+            self._tele.gauge(f"train_slo/{p.name}/burn").set(burn)
+            self._tele.gauge(f"train_slo/{p.name}/violating").set(
+                1.0 if violating else 0.0
+            )
+            if violating:
+                burning.append(p.name)
+                last = self._last_violation.get(p.name)
+                if last is None or step - last >= p.window:
+                    self._last_violation[p.name] = step
+                    self._tele.counter("train_slo/violations").add(1)
+                    self._tele.counter(
+                        f"train_slo/{p.name}/violations"
+                    ).add(1)
+                    logger.warning(
+                        "train drift policy %s burning at step %d: "
+                        "%s=%.6g (burn %.2fx%s)",
+                        p.name, step, p.metric, value, burn,
+                        f", baseline {baseline:.6g}"
+                        if math.isfinite(baseline) else "",
+                    )
+        self._tele.gauge("train_slo/burning").set(float(len(burning)))
+        return burning
+
+    def reset(self) -> None:
+        """Forget baselines (post-rollback — the restored run's normal
+        is not the exploded run's)."""
+        for rb in self._baselines.values():
+            rb.clear()
+        self._last_violation.clear()

@@ -48,7 +48,10 @@ __all__ = [
 # v3: adds the ``request_trace`` event kind (per-request serving
 # milestones keyed by a fleet-stable trace id); v1/v2 files remain
 # readable.
-SCHEMA_VERSION = 3
+# v4: adds the ``numerics`` event kind (per-layer training tensor
+# statistics windows from telemetry/numerics.py); v1-v3 files remain
+# readable.
+SCHEMA_VERSION = 4
 
 
 def exp_edges(lo: float, hi: float, bins: int) -> tuple[float, ...]:

@@ -52,6 +52,8 @@ class TelemetrySink:
 
     def on_request_trace(self, record: dict[str, Any]) -> None: ...
 
+    def on_numerics(self, record: dict[str, Any]) -> None: ...
+
     def close(self) -> None: ...
 
 
@@ -126,6 +128,11 @@ class JsonlSink(TelemetrySink):
         # per-request milestones (schema v3): buffered like spans — a
         # handful of events per request, flushed on the flush cadence
         self._write({"kind": "request_trace", **record})
+
+    def on_numerics(self, record: dict[str, Any]) -> None:
+        # per-layer numerics windows (schema v4): one event per cadence
+        # window, buffered like spans (the flush cadence bounds loss)
+        self._write({"kind": "numerics", **record})
 
     def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
         self._file()  # ensure the meta header exists even for span-free runs
@@ -264,6 +271,7 @@ _REQUIRED = {
     "flush": ("step", "counters", "gauges", "histograms"),
     "executable": ("name", "signature", "lower_s", "compile_s"),
     "request_trace": ("trace_id", "event", "t"),
+    "numerics": ("step", "rows"),
 }
 
 
@@ -271,8 +279,8 @@ def validate_event(event: dict[str, Any]) -> None:
     """Raise ``ValueError`` if ``event`` is not a well-formed telemetry
     event (the contract bench harness tests pin). Files written by any
     schema version up to the current one stay readable — v2 added the
-    ``executable`` kind and v3 the ``request_trace`` kind, which older
-    files simply never contain."""
+    ``executable`` kind, v3 the ``request_trace`` kind and v4 the
+    ``numerics`` kind, which older files simply never contain."""
     kind = event.get("kind")
     if kind not in _REQUIRED:
         raise ValueError(f"unknown event kind {kind!r}")

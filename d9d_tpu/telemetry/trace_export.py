@@ -29,6 +29,11 @@ Perfetto JSON (``{"traceEvents": [...]}``) in which:
   → …) and terminal milestones into ``"i"`` pins, so a request that
   crossed a preemption-driven migration reads as ONE contiguous lane —
   the continuity the fleet's kill-recovery contract promises;
+- **numerics** events (schema v4 — per-layer training tensor-statistics
+  windows, ``telemetry/numerics.py``) become ``"C"`` counter tracks:
+  one ``numerics/{layer}/grad_rms`` series per parameter row, so a
+  layer's gradient drifting away from its siblings is visible as a
+  diverging counter lane next to the ``train/step`` spans;
 - process/thread ``"M"`` metadata events name every lane.
 
 The output ordering is deterministic (sorted by timestamp, then pid,
@@ -209,6 +214,24 @@ def merge_to_chrome_trace(paths: Iterable[str | Path]) -> dict[str, Any]:
                     trace_events.append({
                         "ph": "C", "pid": pid, "tid": 0, "ts": ts,
                         "name": name, "cat": "counter",
+                        "args": {"value": value},
+                    })
+            elif kind == "numerics":
+                # per-layer grad-RMS counter tracks (param rows only —
+                # act/loss rows have no grad axis); the event carries
+                # its own wall clock like flush events
+                ts = (ev.get("unix_time", t0_wall) - t0_wall) * 1e6
+                for row_name in sorted(ev.get("rows", {})):
+                    row = ev["rows"][row_name]
+                    if row.get("kind") != "param":
+                        continue
+                    value = row.get("rms")
+                    if value is None:
+                        continue
+                    trace_events.append({
+                        "ph": "C", "pid": pid, "tid": 0, "ts": ts,
+                        "name": f"numerics/{row_name}/grad_rms",
+                        "cat": "numerics",
                         "args": {"value": value},
                     })
             elif kind == "executable":

@@ -1,0 +1,208 @@
+"""Numerics plane through the jitted train step (train_step.py +
+telemetry/numerics.py): the spec is discovered at trace time, the flat
+stats vector rides the ordinary metric dict, off-cadence steps run the
+identical program with the vector left all-NaN — and add zero host
+dispatches/readbacks (pinned with jax's transfer guard, the
+test_anomaly_guard idiom the bench leg mirrors)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from d9d_tpu.loop.control.task import TrainTask
+from d9d_tpu.loop.train_step import build_train_step
+from d9d_tpu.telemetry import Telemetry
+from d9d_tpu.telemetry import numerics as numerics_mod
+from d9d_tpu.telemetry.numerics import NumericsMonitor, decode_window
+
+
+class _Tapped(nn.Module):
+    """Two Dense blocks with residual-stream taps, the backbone shape."""
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(8, name="l0")(x)
+        numerics_mod.tap("l0", h)
+        h = nn.Dense(4, name="l1")(jax.nn.relu(h))
+        numerics_mod.tap("l1", h)
+        return h
+
+
+class _Task(TrainTask):
+    def prepare_batch(self, batch):
+        return batch
+
+    def loss_fn(self, module, params, mb, rng):
+        y = module.apply(params, mb["x"])
+        return jnp.sum((y - mb["y"]) ** 2), jnp.float32(mb["x"].shape[0]), {}
+
+
+def _setup(**kwargs):
+    module = _Tapped()
+    opt = optax.adam(1e-2)
+    x = jnp.ones((2, 4, 8))
+    y = jnp.zeros((2, 4, 4))
+    params = module.init(jax.random.PRNGKey(0), x[0])
+    opt_state = opt.init(params)
+    step = build_train_step(
+        module=module, task=_Task(), optimizer=opt,
+        num_microbatches=2, numerics=True, **kwargs,
+    )
+    return step, params, opt_state, {"x": x, "y": y}
+
+
+def test_cadence_window_decodes_all_surfaces():
+    step, params, opt_state, batch = _setup()
+    assert step.numerics_spec is None  # not traced yet
+    step.numerics_next = True
+    params, opt_state, m = step(params, opt_state, batch, jax.random.PRNGKey(1))
+    spec = step.numerics_spec
+    assert spec is not None
+    names = [r.name for r in spec.rows]
+    # taps (forward order) → loss → param leaves (tree order)
+    assert names[:3] == ["l0", "l1", "loss"]
+    assert sum(1 for r in spec.rows if r.kind == "param") == 4  # 2x(W+b)
+    rows = decode_window(spec, np.asarray(m["numerics/stats"]))
+    assert rows is not None and len(rows) == len(names)
+    for name, r in rows.items():
+        assert r["finite_ok"], name
+    # activation stats are real (inputs are ones → RMS > 0)
+    assert rows["l0"]["rms"] > 0
+    # loss row mirrors the metric-dict loss
+    assert rows["loss"]["absmax"] == pytest.approx(float(m["loss"]), rel=1e-5)
+    # param rows carry the full column set: grads, post-update params,
+    # update:param ratio, Adam second-moment health
+    kernel = next(n for n in names if n.endswith("l0/kernel"))
+    r = rows[kernel]
+    assert r["rms"] > 0 and r["param_rms"] > 0
+    assert 0 < r["update_ratio"] < 1
+    assert np.isfinite(r["moment2_max"])
+
+
+def test_off_cadence_vector_is_nan_and_decodes_to_none():
+    step, params, opt_state, batch = _setup()
+    step.numerics_next = False
+    params, opt_state, m = step(params, opt_state, batch, jax.random.PRNGKey(1))
+    vec = np.asarray(m["numerics/stats"])
+    assert vec.shape == (step.numerics_spec.flat_size,)
+    assert np.all(np.isnan(vec))
+    assert decode_window(step.numerics_spec, vec) is None
+
+
+def test_off_cadence_steps_add_zero_dispatches_and_readbacks():
+    """The acceptance pin at the step level: after warmup, off-cadence
+    numerics-enabled steps run under a device→host transfer guard (any
+    readback the stats added would raise) at exactly one dispatch per
+    step — and toggling the cadence flag afterwards needs no
+    recompile."""
+    step, params, opt_state, batch = _setup()
+    rng = jax.random.PRNGKey(1)
+    step.numerics_next = True
+    params, opt_state, m = step(params, opt_state, batch, rng)  # compile
+    jax.block_until_ready(m["loss"])
+
+    calls = 0
+    inner = step.fn
+
+    def counting(*args):
+        nonlocal calls
+        calls += 1
+        return inner(*args)
+
+    step.fn = counting
+    step.numerics_next = False
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(3):
+            params, opt_state, m = step(params, opt_state, batch, rng)
+        # back on cadence: still the same single dispatch, no transfer
+        # until the host actually fetches the metrics
+        step.numerics_next = True
+        params, opt_state, m = step(params, opt_state, batch, rng)
+    jax.block_until_ready(m["loss"])
+    assert calls == 4
+    rows = decode_window(step.numerics_spec, np.asarray(m["numerics/stats"]))
+    assert rows is not None  # the cadence window actually computed
+
+
+def test_numerics_composes_with_anomaly_guard():
+    step, params, opt_state, batch = _setup(anomaly_policy="skip_step")
+    step.numerics_next = True
+    rng = jax.random.PRNGKey(1)
+    params, opt_state, m = step(params, opt_state, batch, rng)
+    assert float(m["resilience/anomaly"]) == 0.0
+    assert "numerics/stats" in m
+
+    # poisoned inputs: the guard freezes the update AND the window names
+    # the first non-finite site as the forward activation that made it
+    bad = {"x": batch["x"] * jnp.nan, "y": batch["y"]}
+    params, opt_state, m = step(params, opt_state, bad, rng)
+    assert float(m["resilience/anomaly"]) == 1.0
+    mon = NumericsMonitor(telemetry=Telemetry())
+    report = mon.ingest(
+        2, [("", step.numerics_spec, np.asarray(m["numerics/stats"]))]
+    )
+    assert report.first_nonfinite == {"site": "act", "name": "l0"}
+    assert mon.guard_context()["first_nonfinite"] == "act:l0"
+
+
+def test_provenance_tap_order_survives_jax_dict_canonicalization():
+    """End-to-end pin for the >10-layer attribution bug: jax sorts dict
+    pytrees through eval_shape/scan/cond, so a tap named "z_first" that
+    fires BEFORE "a_second" lands after it in the device layout — the
+    provenance verdict must still name the forward-first tap."""
+
+    class _Misordered(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(8, name="d0")(x)
+            numerics_mod.tap("z_first", h)
+            h = nn.Dense(4, name="d1")(h)
+            numerics_mod.tap("a_second", h)
+            return h
+
+    module = _Misordered()
+    opt = optax.adam(1e-2)
+    x = jnp.ones((2, 4, 8))
+    params = module.init(jax.random.PRNGKey(0), x[0])
+    opt_state = opt.init(params)
+    step = build_train_step(
+        module=module, task=_Task(), optimizer=opt,
+        num_microbatches=2, numerics=True,
+    )
+    step.numerics_next = True
+    bad = {"x": x * jnp.nan, "y": jnp.zeros((2, 4, 4))}
+    params, opt_state, m = step(params, opt_state, bad, jax.random.PRNGKey(1))
+    spec = step.numerics_spec
+    # the LAYOUT is sorted — that's jax's canonical dict order...
+    assert [r.name for r in spec.rows[:2]] == ["a_second", "z_first"]
+    # ...but the verdict walks forward tap order
+    mon = NumericsMonitor(telemetry=Telemetry())
+    report = mon.ingest(1, [("", spec, np.asarray(m["numerics/stats"]))])
+    assert report.first_nonfinite == {"site": "act", "name": "z_first"}
+
+
+def test_numerics_rejects_split_update():
+    with pytest.raises(ValueError, match="split_optimizer_update"):
+        _setup(split_update=True)
+
+
+def test_plain_step_has_no_numerics_surface():
+    """numerics=False (the default) compiles the seed program: no
+    metric-dict key, no spec, and models' taps stay no-ops."""
+    module = _Tapped()
+    opt = optax.adam(1e-2)
+    x = jnp.ones((2, 4, 8))
+    params = module.init(jax.random.PRNGKey(0), x[0])
+    opt_state = opt.init(params)
+    step = build_train_step(
+        module=module, task=_Task(), optimizer=opt, num_microbatches=2,
+    )
+    params, opt_state, m = step(
+        params, opt_state, {"x": x, "y": jnp.zeros((2, 4, 4))},
+        jax.random.PRNGKey(1),
+    )
+    assert not any(k.startswith("numerics/") for k in m)
+    assert step.numerics_spec is None

@@ -1,0 +1,79 @@
+"""Per-stage numerics executables (PipelinedOptimizer.stage_numerics):
+param-space rows in build_param_spec order, the update:param column NaN
+by contract (the stats dispatch runs BEFORE the donating update), and a
+per-stage NaN marked on exactly the producing stage's rows. The full
+trainer-driven PP parity leg is tests/loop/test_pp_numerics.py (slow
+tier — whole-model compiles)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from d9d_tpu.pipelining.training import PipelinedOptimizer
+from d9d_tpu.telemetry.numerics import build_param_spec, decode_window
+
+
+def _setup():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("x",))
+    sh = NamedSharding(mesh, P())
+    opt = PipelinedOptimizer(
+        optimizer=optax.adam(1e-2),
+        scalar_shardings={0: sh, 1: sh},
+    )
+    params = {
+        0: {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))},
+        1: {"w": jnp.full((4, 4), 2.0)},
+    }
+    states = opt.init(params)
+    return opt, params, states
+
+
+def test_stage_rows_decode_against_param_spec():
+    opt, params, states = _setup()
+    grads = {s: jax.tree.map(lambda p: p * 0 + 0.5, params[s]) for s in params}
+    for s in (0, 1):
+        spec = build_param_spec(params[s])
+        vec = np.asarray(opt.stage_numerics(s, params[s], grads[s], states[s]))
+        assert vec.shape == (spec.flat_size,)
+        rows = decode_window(spec, vec, prefix=f"pp/s{s}/")
+        assert set(rows) == {f"pp/s{s}/{n}" for n in params[s]}
+        for name, r in rows.items():
+            assert r["finite_ok"], name
+            assert r["rms"] == pytest.approx(0.5)
+            assert r["param_rms"] >= 0  # the zero-init bias reads 0
+            # pre-update dispatch: no old/new pair → the ratio column
+            # is NaN under PP by contract
+            assert math.isnan(r["update_ratio"])
+            # Adam second moments found through the per-stage state
+            assert np.isfinite(r["moment2_max"])
+
+
+def test_stage_nan_lands_on_the_producing_stage_only():
+    opt, params, states = _setup()
+    bad = {"w": jnp.full((4, 4), jnp.nan), "b": jnp.zeros((4,))}
+    good = {"w": jnp.full((4, 4), 0.1)}
+    rows0 = decode_window(
+        build_param_spec(params[0]),
+        np.asarray(opt.stage_numerics(0, params[0], bad, states[0])),
+    )
+    rows1 = decode_window(
+        build_param_spec(params[1]),
+        np.asarray(opt.stage_numerics(1, params[1], good, states[1])),
+    )
+    assert not rows0["w"]["grad_finite"] and rows0["b"]["grad_finite"]
+    assert rows0["w"]["moment_finite"]  # moments untouched
+    assert all(r["finite_ok"] for r in rows1.values())
+
+
+def test_stage_executables_are_cached_per_stage():
+    opt, params, states = _setup()
+    grads = {s: jax.tree.map(jnp.zeros_like, params[s]) for s in params}
+    for _ in range(3):
+        for s in (0, 1):
+            opt.stage_numerics(s, params[s], grads[s], states[s])
+    assert set(opt._numerics_fns) == {0, 1}

@@ -44,6 +44,29 @@ def test_dump_contents(tmp_path):
     assert "executables" in record
 
 
+def test_dump_carries_last_numerics_window(tmp_path):
+    """record_numerics keeps the hub's last window; every subsequent
+    dump — anomaly, serve_stall, rollback — carries it under a
+    ``numerics`` key next to the flush ring (ISSUE 14 satellite)."""
+    hub = Telemetry()
+    hub.configure_flight_recorder(tmp_path)
+    window = {
+        "step": 42,
+        "rows": {"layers_3": {"kind": "param", "rms": 1.5, "finite": False}},
+        "first_nonfinite": {"site": "grad", "name": "layers_3"},
+    }
+    hub.record_numerics(window)
+    path = hub.dump_flight_record("anomaly")
+    record = json.loads(path.read_text())
+    assert record["numerics"]["step"] == 42
+    assert record["numerics"]["first_nonfinite"]["name"] == "layers_3"
+    # a hub that never saw a window dumps without the key
+    hub2 = Telemetry()
+    hub2.configure_flight_recorder(tmp_path / "other")
+    record2 = json.loads(hub2.dump_flight_record("anomaly").read_text())
+    assert "numerics" not in record2
+
+
 def test_dump_rate_limited_per_event(tmp_path):
     hub = Telemetry()
     hub.configure_flight_recorder(tmp_path, min_interval_s=3600)

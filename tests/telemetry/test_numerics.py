@@ -1,0 +1,412 @@
+"""Training numerics plane, host half (telemetry/numerics.py): spec /
+window decode, NaN-provenance ordering, the shared RollingBaseline,
+drift policies, monitor gauges + JSONL fan-out, and the traced helpers'
+row math (eager on CPU — tiny arrays, no trainer).
+
+The step-level integration (the vector riding the jitted step's metric
+dict at zero extra dispatches) lives in tests/loop/test_numerics_step.py;
+the end-to-end provenance chaos leg in
+tests/resilience/test_numerics_provenance.py.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from d9d_tpu.telemetry import Telemetry
+from d9d_tpu.telemetry.numerics import (
+    N_COLS,
+    DriftPolicy,
+    NumericsMonitor,
+    RollingBaseline,
+    TrainDriftMonitor,
+    build_spec,
+    collect_taps,
+    decode_window,
+    default_drift_policies,
+    find_second_moments,
+    param_leaf_names,
+    stacked_param_rows,
+    tap,
+)
+from d9d_tpu.telemetry.sinks import TelemetrySink, validate_event
+
+
+class _CaptureSink(TelemetrySink):
+    def __init__(self):
+        self.numerics = []
+
+    def on_numerics(self, record):
+        self.numerics.append(record)
+
+
+def _row(rms=1.0, absmax=2.0, param_rms=0.5, update_ratio=0.01,
+         moment2_max=0.1, finite=3.0):
+    return [rms, absmax, param_rms, update_ratio, moment2_max, finite]
+
+
+def _vec(rows):
+    return np.asarray(rows, np.float32).reshape(-1)
+
+
+# -- spec + decode --------------------------------------------------------
+
+
+def test_spec_orders_rows_acts_then_loss_then_params():
+    spec = build_spec(["l0", "l1"], ["a/kernel", "a/bias"])
+    assert [r.name for r in spec.rows] == [
+        "l0", "l1", "loss", "a/kernel", "a/bias",
+    ]
+    assert [r.kind for r in spec.rows] == [
+        "act", "act", "loss", "param", "param",
+    ]
+    assert spec.flat_size == 5 * N_COLS
+
+
+def test_decode_window_none_when_off_cadence():
+    spec = build_spec(["l0"], ["a"])
+    vec = np.full((spec.flat_size,), np.nan, np.float32)
+    assert decode_window(spec, vec) is None
+
+
+def test_decode_param_finite_bits():
+    spec = build_spec([], ["a", "b", "c"], include_loss=False)
+    rows = decode_window(spec, _vec([
+        _row(finite=3.0),  # grads + moments ok
+        _row(finite=2.0),  # bit0 off: grads non-finite
+        _row(finite=1.0),  # bit1 off: moments non-finite
+    ]))
+    assert rows["a"]["finite_ok"] and rows["a"]["grad_finite"]
+    assert not rows["b"]["grad_finite"] and rows["b"]["moment_finite"]
+    assert rows["c"]["grad_finite"] and not rows["c"]["moment_finite"]
+    assert not rows["b"]["finite_ok"] and not rows["c"]["finite_ok"]
+
+
+# -- monitor: provenance ordering + surfaces ------------------------------
+
+
+def _monitor():
+    hub = Telemetry()
+    sink = _CaptureSink()
+    hub.add_sink(sink)
+    return NumericsMonitor(telemetry=hub), hub, sink
+
+
+def test_monitor_ingest_feeds_gauges_and_sink():
+    mon, hub, sink = _monitor()
+    spec = build_spec(["l0"], ["a", "b"])
+    report = mon.ingest(7, [("", spec, _vec([
+        _row(finite=1.0),                      # act, finite
+        _row(rms=2.5, absmax=2.5, finite=1.0),  # loss
+        _row(rms=0.25, update_ratio=0.02),
+        _row(rms=0.75, update_ratio=0.04),
+    ]))])
+    assert report is not None and report.first_nonfinite is None
+    assert hub.registry.gauge("numerics/last_step").value == 7.0
+    assert hub.registry.counter("numerics/windows").value == 1
+    assert hub.registry.gauge("numerics/grad_rms_max").value == 0.75
+    assert hub.registry.gauge(
+        "numerics/update_ratio_max"
+    ).value == pytest.approx(0.04)
+    assert hub.registry.gauge("numerics/nonfinite_rows").value == 0.0
+    # the schema-v4 event fanned out, with per-row stats named
+    [record] = sink.numerics
+    assert record["step"] == 7
+    assert record["rows"]["a"]["rms"] == 0.25
+    assert record["rows"]["loss"]["kind"] == "loss"
+    assert "first_nonfinite" not in record
+    validate_event({"kind": "numerics", **record})
+    # off-cadence windows decode to nothing and change nothing
+    nan_vec = np.full((spec.flat_size,), np.nan, np.float32)
+    assert mon.ingest(8, [("", spec, nan_vec)]) is None
+    assert mon.last is not None and mon.last.step == 7
+
+
+def test_provenance_orders_act_loss_grad_moment():
+    mon, _, _ = _monitor()
+    spec = build_spec(["l0", "l1"], ["a", "b"])
+
+    def verdict(l0, l1, loss, a, b):
+        rep = mon.ingest(1, [("", spec, _vec([
+            _row(finite=l0), _row(finite=l1), _row(finite=loss),
+            _row(finite=a), _row(finite=b),
+        ]))])
+        return rep.first_nonfinite
+
+    # everything bad → the FIRST forward activation wins (production order)
+    assert verdict(0.0, 0.0, 0.0, 0.0, 0.0) == {"site": "act", "name": "l0"}
+    assert verdict(1.0, 0.0, 0.0, 0.0, 0.0) == {"site": "act", "name": "l1"}
+    # acts clean, loss bad → loss-site fault (ChaosScaleTask's shape)
+    assert verdict(1.0, 1.0, 0.0, 0.0, 0.0) == {
+        "site": "loss", "name": "loss",
+    }
+    # grads before moments, tree order among grads
+    assert verdict(1.0, 1.0, 1.0, 2.0, 2.0) == {"site": "grad", "name": "a"}
+    assert verdict(1.0, 1.0, 1.0, 3.0, 1.0) == {
+        "site": "moment", "name": "b",
+    }
+    assert verdict(1.0, 1.0, 1.0, 3.0, 3.0) is None
+    # guard context is the site:name string the anomaly warning prints
+    verdict(1.0, 1.0, 0.0, 0.0, 0.0)
+    assert mon.guard_context() == {
+        "first_nonfinite": "loss:loss", "numerics_step": 1,
+    }
+    mon.reset()
+    assert mon.guard_context() is None and mon.last is None
+
+
+def test_provenance_walks_acts_in_tap_order_not_sorted_order():
+    """Device layout is jax's sorted dict order ("layers_10" before
+    "layers_2"), but provenance must walk acts in FORWARD tap order —
+    the layer that produced the NaN, not the one that sorts first."""
+    mon, _, _ = _monitor()
+    # layout order (sorted) with act_rank recording forward order
+    spec = build_spec(
+        ["layers_10", "layers_2"], ["a"],
+        act_rank={"layers_2": 0, "layers_10": 1},
+    )
+    report = mon.ingest(1, [("", spec, _vec([
+        _row(finite=0.0),  # layers_10 (layout row 0) — downstream victim
+        _row(finite=0.0),  # layers_2 — the producer
+        _row(finite=0.0),  # loss
+        _row(finite=0.0),  # grads
+    ]))])
+    assert report.first_nonfinite == {"site": "act", "name": "layers_2"}
+
+
+def test_monitor_merges_pp_stage_windows_with_prefixes():
+    mon, _, _ = _monitor()
+    s0 = build_spec([], ["w0"], include_loss=False)
+    s1 = build_spec([], ["w1"], include_loss=False)
+    report = mon.ingest(2, [
+        ("pp/s0/", s0, _vec([_row(rms=0.1)])),
+        ("pp/s1/", s1, _vec([_row(rms=0.2, finite=2.0)])),
+    ])
+    assert set(report.rows) == {"pp/s0/w0", "pp/s1/w1"}
+    assert report.first_nonfinite == {"site": "grad", "name": "pp/s1/w1"}
+
+
+def test_validate_event_requires_step_and_rows():
+    validate_event({"kind": "numerics", "step": 1, "rows": {}})
+    with pytest.raises(ValueError):
+        validate_event({"kind": "numerics", "step": 1})
+
+
+# -- rolling baseline (the ONE windowed-median implementation) ------------
+
+
+def test_rolling_baseline_median_and_ratio():
+    rb = RollingBaseline(8, min_samples=3)
+    assert not rb.ready() and math.isnan(rb.baseline())
+    assert math.isnan(rb.ratio(5.0))
+    for v in (1.0, 2.0, 3.0):
+        rb.add(v)
+    assert rb.ready() and rb.baseline() == 2.0
+    assert rb.ratio(4.0) == 2.0
+    rb.clear()
+    assert not rb.ready() and len(rb) == 0
+
+
+def test_rolling_baseline_validates():
+    with pytest.raises(ValueError):
+        RollingBaseline(0)
+    with pytest.raises(ValueError):
+        RollingBaseline(4, min_samples=0)
+
+
+def test_anomaly_guard_shares_the_baseline():
+    """The satellite pin: HostAnomalyGuard's spike detector IS
+    RollingBaseline — one windowed-median implementation, not two."""
+    from d9d_tpu.resilience.anomaly import HostAnomalyGuard
+
+    guard = HostAnomalyGuard(
+        policy="warn", spike_factor=10.0, telemetry=Telemetry()
+    )
+    assert isinstance(guard._baseline, RollingBaseline)
+
+
+# -- drift policies -------------------------------------------------------
+
+
+def test_drift_policy_validation():
+    with pytest.raises(ValueError):
+        DriftPolicy(name="", metric="loss")
+    with pytest.raises(ValueError):
+        DriftPolicy(name="x", metric="loss", kind="drift", factor=1.0)
+    with pytest.raises(ValueError):
+        DriftPolicy(name="x", metric="loss", kind="band")
+    with pytest.raises(ValueError):
+        DriftPolicy(name="x", metric="loss", kind="nope")  # type: ignore
+    with pytest.raises(ValueError):
+        TrainDriftMonitor(
+            [DriftPolicy(name="d", metric="a"),
+             DriftPolicy(name="d", metric="b")],
+            telemetry=Telemetry(),
+        )
+
+
+def test_drift_policy_burns_and_pages_once_per_window():
+    hub = Telemetry()
+    mon = TrainDriftMonitor(
+        [DriftPolicy(name="gn", metric="grad_norm", kind="drift",
+                     factor=2.0, window=16, min_samples=2)],
+        telemetry=hub,
+    )
+    # warmup: first min_samples observations only seed the baseline
+    assert mon.observe(1, {"grad_norm": 1.0}) == []
+    assert mon.observe(2, {"grad_norm": 1.0}) == []
+    assert mon.observe(3, {"grad_norm": 1.1}) == []
+    assert hub.registry.gauge("train_slo/gn/burn").value < 1.0
+    # 5x the baseline burns; the counter bumps once
+    assert mon.observe(4, {"grad_norm": 5.0}) == ["gn"]
+    assert hub.registry.counter("train_slo/violations").value == 1
+    assert hub.registry.gauge("train_slo/gn/violating").value == 1.0
+    assert hub.registry.gauge("train_slo/burning").value == 1.0
+    # sustained burn within the window: gauges track, counter does not
+    assert mon.observe(5, {"grad_norm": 5.0}) == ["gn"]
+    assert hub.registry.counter("train_slo/violations").value == 1
+    # the violating values never entered the baseline
+    assert mon.observe(6, {"grad_norm": 1.0}) == []
+    assert hub.registry.gauge("train_slo/gn/baseline").value == 1.0
+    # past the window, a still-burning policy pages again
+    assert mon.observe(4 + 16, {"grad_norm": 5.0}) == ["gn"]
+    assert hub.registry.counter("train_slo/violations").value == 2
+    mon.reset()
+    assert mon.observe(100, {"grad_norm": 5.0}) == []  # baseline forgotten
+
+
+def test_band_policy_warmup_then_bounds():
+    hub = Telemetry()
+    mon = TrainDriftMonitor(
+        [DriftPolicy(name="ur", metric="r", kind="band", hi=0.5,
+                     min_samples=2)],
+        telemetry=hub,
+    )
+    # the first min_samples observations gauge but never page (step-0
+    # transients: a zero-initialized leaf's first real update)
+    assert mon.observe(1, {"r": 0.9}) == []
+    assert mon.observe(2, {"r": 0.9}) == []
+    assert mon.observe(3, {"r": 0.9}) == ["ur"]
+    assert hub.registry.gauge("train_slo/ur/burn").value == pytest.approx(1.8)
+    assert mon.observe(4, {"r": 0.1}) == []
+    # missing / non-finite metrics are skipped, not violations
+    assert mon.observe(5, {}) == []
+    assert mon.observe(6, {"r": float("nan")}) == []
+
+
+def test_band_policy_lo_bound():
+    mon = TrainDriftMonitor(
+        [DriftPolicy(name="lo", metric="m", kind="band", lo=0.5,
+                     min_samples=1)],
+        telemetry=Telemetry(),
+    )
+    assert mon.observe(1, {"m": 1.0}) == []
+    assert mon.observe(2, {"m": 0.1}) == ["lo"]
+
+
+def test_band_policy_zero_bounds_saturate_instead_of_dividing():
+    """hi=0.0 is a legitimate band (metric expected <= 0): burn
+    saturates to inf on violation instead of raising ZeroDivisionError,
+    and the zero bound never reads as an absent one."""
+    hub = Telemetry()
+    mon = TrainDriftMonitor(
+        [DriftPolicy(name="z", metric="m", kind="band", hi=0.0,
+                     min_samples=0)],
+        telemetry=hub,
+    )
+    assert mon.observe(1, {"m": -1.0}) == []
+    assert mon.observe(2, {"m": 0.5}) == ["z"]
+    assert hub.registry.gauge("train_slo/z/burn").value == math.inf
+    # a zero OBSERVATION below a lo bound saturates the same way
+    mon2 = TrainDriftMonitor(
+        [DriftPolicy(name="lo", metric="m", kind="band", lo=0.5,
+                     min_samples=0)],
+        telemetry=Telemetry(),
+    )
+    assert mon2.observe(1, {"m": 0.0}) == ["lo"]
+
+
+def test_default_policies_cover_the_stock_set():
+    names = {p.name for p in default_drift_policies()}
+    assert names == {"grad_norm_drift", "update_ratio_band", "loss_spike"}
+
+
+# -- traced row math (eager CPU) ------------------------------------------
+
+
+def test_stacked_param_rows_values_and_finite_codes():
+    import jax.numpy as jnp
+
+    grads = {"a": jnp.full((2, 2), 3.0), "b": jnp.array([jnp.nan, 1.0])}
+    params = {"a": jnp.full((2, 2), 1.0), "b": jnp.array([2.0, 2.0])}
+    new = {"a": jnp.full((2, 2), 1.1), "b": jnp.array([2.0, 2.0])}
+    nu = {"a": jnp.full((2, 2), 0.25), "b": jnp.array([0.5, jnp.nan])}
+    rows = np.asarray(stacked_param_rows(grads, params, new, nu))
+    spec = build_spec([], param_leaf_names(grads), include_loss=False)
+    decoded = decode_window(spec, rows.reshape(-1))
+    a, b = decoded["a"], decoded["b"]
+    assert a["rms"] == pytest.approx(3.0)
+    assert a["absmax"] == pytest.approx(3.0)
+    assert a["param_rms"] == pytest.approx(1.1)
+    # update ratio: RMS(new-old)/RMS(new) — ~0.1/1.1
+    assert a["update_ratio"] == pytest.approx(0.1 / 1.1, rel=1e-4)
+    assert a["moment2_max"] == pytest.approx(0.25)
+    assert a["finite_ok"]
+    assert not b["grad_finite"] and not b["moment_finite"]
+
+
+def test_stacked_param_rows_optional_operands_nan_columns():
+    import jax.numpy as jnp
+
+    rows = np.asarray(stacked_param_rows({"a": jnp.ones((2,))}))
+    spec = build_spec([], ["a"], include_loss=False)
+    decoded = decode_window(spec, rows.reshape(-1))["a"]
+    assert decoded["rms"] == pytest.approx(1.0)
+    assert math.isnan(decoded["param_rms"])
+    assert math.isnan(decoded["update_ratio"])
+    assert math.isnan(decoded["moment2_max"])
+    assert decoded["finite_ok"]  # absent moments count as finite
+
+
+def test_find_second_moments_walks_wrapped_states():
+    import jax.numpy as jnp
+    import optax
+
+    params = {"a": jnp.ones((2,)), "b": jnp.ones((3,))}
+    adam_state = optax.chain(
+        optax.clip_by_global_norm(1.0), optax.adam(1e-2)
+    ).init(params)
+    nu = find_second_moments(adam_state, params)
+    assert nu is not None
+    assert set(nu) == {"a", "b"}
+    assert find_second_moments(optax.sgd(1e-2).init(params), params) is None
+
+
+def test_tap_is_noop_without_collector_and_merges_reuse():
+    import jax.numpy as jnp
+
+    tap("free", jnp.ones((2,)))  # no context: not even a traced op
+    with collect_taps() as col:
+        tap("x", jnp.array([1.0, -3.0]))
+        tap("y", jnp.array([2.0]))
+        # a re-applied shared module merges instead of growing the spec
+        tap("x", jnp.array([5.0, 5.0]))
+    assert set(col.stats) == {"x", "y"}
+    sq_mean, absmax, finite = np.asarray(col.stats["x"])
+    assert absmax == 5.0 and finite == 1.0
+    with collect_taps() as col2:
+        tap("z", jnp.array([jnp.nan]))
+    assert np.asarray(col2.stats["z"])[2] == 0.0
+
+
+def test_tap_remerge_weights_every_application_equally():
+    """A module applied N >= 3 times under one tap name: the merged
+    sq_mean is the true mean over applications, not a pairwise running
+    average biased toward the last one."""
+    import jax.numpy as jnp
+
+    with collect_taps() as col:
+        for v in (1.0, 2.0, 3.0):  # sq means 1, 4, 9 → mean 14/3
+            tap("shared", jnp.array([v]))
+    assert np.asarray(col.stats["shared"])[0] == pytest.approx(14.0 / 3.0)

@@ -323,6 +323,97 @@ def test_trace_summary_cli_trace_id_filter(tmp_path):
     assert "req-a" not in out.stdout
 
 
+def _append_numerics(path, step, unix_time, rows, first_nonfinite=None):
+    ev = {"kind": "numerics", "step": step, "unix_time": unix_time,
+          "rows": rows}
+    if first_nonfinite is not None:
+        ev["first_nonfinite"] = first_nonfinite
+    with open(path, "a") as fh:
+        fh.write(json.dumps(ev) + "\n")
+
+
+def test_perfetto_renders_numerics_grad_rms_counter_tracks(tmp_path):
+    """Schema-v4 numerics windows become per-layer grad-RMS counter
+    lanes — param rows only (act/loss rows have no grad axis)."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "num_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0, spans=[],
+    )
+    _append_numerics(path, 3, wall + 2.0, {
+        "layers_0": {"kind": "param", "rms": 0.25},
+        "layers_1": {"kind": "param", "rms": 0.5},
+        "l0": {"kind": "act", "rms": 9.0},
+        "loss": {"kind": "loss", "rms": 1.0},
+        "broken": {"kind": "param", "rms": None},  # NaN → no sample
+    })
+    trace = merge_to_chrome_trace([path])
+    cs = {
+        e["name"]: e for e in trace["traceEvents"] if e["ph"] == "C"
+    }
+    assert set(cs) == {
+        "numerics/layers_0/grad_rms", "numerics/layers_1/grad_rms",
+    }
+    assert cs["numerics/layers_0/grad_rms"]["args"]["value"] == 0.25
+    assert cs["numerics/layers_0/grad_rms"]["ts"] == 2_000_000.0
+
+
+def test_trace_summary_numerics_table_worst_first(tmp_path, capsys):
+    """--numerics prints the LAST window as a table, non-finite rows
+    first then by absmax descending, with the provenance verdict."""
+    from tests.conftest import load_repo_module
+
+    ts = load_repo_module("trace_summary", "tools/trace_summary.py")
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "numtab_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0, spans=[],
+    )
+    _append_numerics(path, 1, wall + 1.0, {
+        "stale": {"kind": "param", "rms": 99.0, "absmax": 99.0,
+                  "finite": True},
+    })
+    _append_numerics(path, 7, wall + 2.0, {
+        "quiet": {"kind": "param", "rms": 0.1, "absmax": 0.2,
+                  "finite": True},
+        "hot": {"kind": "param", "rms": 2.0, "absmax": 8.0,
+                "finite": True},
+        "dead": {"kind": "param", "rms": None, "absmax": None,
+                 "finite": False},
+    }, first_nonfinite={"site": "grad", "name": "dead"})
+    ts.summarize_telemetry([path], top=10, numerics=True)
+    out = capsys.readouterr().out
+    assert "numerics window at step 7" in out
+    assert "stale" not in out  # only the LAST window prints
+    lines = [ln for ln in out.splitlines()
+             if ln.endswith(("dead", "hot", "quiet"))
+             and not ln.startswith("first non-finite")]
+    assert [ln.split()[-1] for ln in lines] == ["dead", "hot", "quiet"]
+    assert "first non-finite: grad:dead" in out
+    # empty logs explain how to enable the plane instead of crashing
+    ts.print_numerics([], top=10)
+    assert "numerics_every_steps" in capsys.readouterr().out
+
+
+def test_cli_numerics_errors_without_telemetry_inputs(tmp_path):
+    """--numerics against a dir with no telemetry JSONL must fail loudly
+    (like --perfetto), not silently fall through to profiler mode."""
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path), "--numerics"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode != 0
+    assert "--numerics needs telemetry JSONL inputs" in out.stderr
+
+
 def test_perfetto_merge_rejects_headerless_files(tmp_path):
     from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
 

@@ -467,7 +467,141 @@ def run_micro() -> dict:
             # hide) and the emissions stayed exact
             "serve_micro.autopilot_canary_promotes": ap_promotes,
             "serve_micro.autopilot_exact_vs_plain": ap_exact,
+            # numerics tiny-train leg: the training-side structural gate
+            # (zero added dispatches/readbacks with the numerics plane
+            # compiled in; off-cadence steps transfer-guard-clean)
+            **run_train_micro(),
         },
+    }
+
+
+TRAIN_MICRO = dict(steps=6, cadence=3, num_microbatches=2)
+
+
+def run_train_micro() -> dict:
+    """The numerics-enabled tiny-train leg (docs/design/observability.md
+    "Training numerics plane"): the SAME toy training loop twice — plain
+    vs ``numerics=True`` at a cadence — counting host dispatches and
+    metric readbacks. The contract gated here: the numerics plane rides
+    the existing step program and the existing metric readback, so every
+    structural count is BYTE-IDENTICAL to the plain leg, and off-cadence
+    steps run to completion under ``jax.transfer_guard_device_to_host(
+    "disallow")`` — any readback the stats added would raise.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from d9d_tpu.loop.control.task import TrainTask
+    from d9d_tpu.loop.train_step import build_train_step
+    from d9d_tpu.telemetry import introspect
+    from d9d_tpu.telemetry import numerics as numerics_mod
+
+    class _Toy(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(8, name="l0")(x)
+            numerics_mod.tap("l0", h)
+            h = nn.Dense(4, name="l1")(jax.nn.relu(h))
+            numerics_mod.tap("l1", h)
+            return h
+
+    class _Task(TrainTask):
+        def prepare_batch(self, batch):
+            return batch
+
+        def loss_fn(self, module, params, mb, rng):
+            y = module.apply(params, mb["x"])
+            return (
+                jnp.sum((y - mb["y"]) ** 2),
+                jnp.float32(mb["x"].shape[0]),
+                {},
+            )
+
+    module = _Toy()
+    n_mb = TRAIN_MICRO["num_microbatches"]
+    x = jnp.ones((n_mb, 4, 8))
+    y = jnp.zeros((n_mb, 4, 4))
+    batch = {"x": x, "y": y}
+    opt = optax.adam(1e-2)
+
+    def drive(numerics: bool) -> dict:
+        step = build_train_step(
+            module=module, task=_Task(), optimizer=opt,
+            num_microbatches=n_mb, numerics=numerics,
+        )
+        # fresh per leg: the step donates params/opt_state buffers
+        params = module.init(jax.random.PRNGKey(0), x[0])
+        opt_state = opt.init(params)
+        dispatches = 0
+        inner = step.fn
+
+        def counting(*args):
+            nonlocal dispatches
+            dispatches += 1
+            return inner(*args)
+
+        step.fn = counting
+        # warmup: the one legitimate compile, outside the window
+        step.numerics_next = True
+        params, opt_state, m = step(
+            params, opt_state, batch, jax.random.PRNGKey(10**6)
+        )
+        jax.block_until_ready(m["loss"])
+        dispatches = 0
+        readbacks = 0
+        mark = len(introspect.inventory())
+        for i in range(TRAIN_MICRO["steps"]):
+            s = i + 1
+            on_cadence = s % TRAIN_MICRO["cadence"] == 0
+            step.numerics_next = on_cadence
+            rng = jax.random.fold_in(jax.random.PRNGKey(1), s)
+            if on_cadence:
+                params, opt_state, m = step(params, opt_state, batch, rng)
+                # the log-cadence metric fetch — the ONE readback, which
+                # the numerics vector rides
+                host = {k: np.asarray(v) for k, v in m.items()}
+                readbacks += 1
+                assert np.isfinite(host["loss"])
+            else:
+                # off-cadence: any device→host transfer raises — the
+                # numerics leg must be as silent as the plain one
+                with jax.transfer_guard_device_to_host("disallow"):
+                    params, opt_state, m = step(params, opt_state, batch, rng)
+        jax.block_until_ready(m["loss"])
+        spec = step.numerics_spec
+        return {
+            "host_dispatches": dispatches,
+            "readbacks": readbacks,
+            "steady_state_compiles": len(introspect.inventory()) - mark,
+            "rows": spec.n_rows if spec is not None else 0,
+        }
+
+    plain = drive(numerics=False)
+    num = drive(numerics=True)
+    return {
+        # structural counts, exact: the numerics leg must be
+        # byte-identical to the plain leg
+        "train_micro.host_dispatches": plain["host_dispatches"],
+        "train_micro.readbacks": plain["readbacks"],
+        "train_micro.steady_state_compiles": plain["steady_state_compiles"],
+        "train_micro.numerics_host_dispatches": num["host_dispatches"],
+        "train_micro.numerics_readbacks": num["readbacks"],
+        "train_micro.numerics_steady_state_compiles": (
+            num["steady_state_compiles"]
+        ),
+        "train_micro.numerics_added_dispatches": (
+            num["host_dispatches"] - plain["host_dispatches"]
+        ),
+        "train_micro.numerics_added_readbacks": (
+            num["readbacks"] - plain["readbacks"]
+        ),
+        # the off-cadence transfer guard held (the loop would have raised
+        # otherwise) AND the stats rows actually materialized — a
+        # silently-empty spec would let a regression hide
+        "train_micro.numerics_rows": num["rows"],
     }
 
 
@@ -648,6 +782,7 @@ def default_thresholds(metrics: dict) -> dict:
             ".prefix_hbm_reduction_x",
             ".autopilot_canary_promotes",
             ".autopilot_exact_vs_plain",
+            ".numerics_rows",
         )):
             specs[name] = {
                 "value": value, "direction": "higher", "rel_tol": 0.0,

@@ -103,7 +103,7 @@ _MB_SUFFIX = re.compile(r"\.s\d+\.mb\d+$|\.mb\d+$")
 # named-scope paths as stamped by this repo's instrumentation; matched
 # anywhere in the op metadata because JAX prepends jit(<fn>)/ components
 _SCOPE = re.compile(
-    r"(?:^|/)((?:pp_s\d+|pp_opt|ep|train|loop|moe)/[\w.-]+)"
+    r"(?:^|/)((?:pp_s\d+|pp_opt|ep|train|loop|moe|decoder)/[\w.-]+)"
 )
 
 
@@ -171,21 +171,67 @@ def _fmt_bytes(v) -> str:
     return f"{v:.1f}GiB"  # pragma: no cover — loop always returns
 
 
+def _numerics_sort_key(item):
+    """Worst offenders first: non-finite rows, then by grad/act absmax
+    descending (NaN absmax sorts last among the finite rows)."""
+    name, row = item
+    absmax = row.get("absmax")
+    bad = not row.get("finite", True)
+    mag = absmax if isinstance(absmax, (int, float)) and absmax == absmax else -1.0
+    return (0 if bad else 1, -mag, name)
+
+
+def print_numerics(numerics_events, *, top: int) -> None:
+    """The --numerics table: per-layer stats of the LAST window in the
+    logs (by step, then file order), worst offenders first."""
+    if not numerics_events:
+        print("\nno numerics events in the logs (enable "
+              "TrainerConfig.numerics_every_steps)")
+        return
+    path, ev = max(
+        enumerate(numerics_events),
+        key=lambda ie: (ie[1][1].get("step", -1), ie[0]),
+    )[1]
+    rows = ev.get("rows", {})
+    print(f"\nnumerics window at step {ev.get('step')} "
+          f"[{path.name}] ({len(rows)} row(s), worst first):")
+    print(f"{'grad/act_rms':>13}  {'absmax':>11}  {'param_rms':>10}  "
+          f"{'upd:param':>10}  {'m2_max':>10}  {'fin':>3}  {'kind':>5}  name")
+
+    def fmt(v, w):
+        return f"{v:>{w}.4g}" if isinstance(v, (int, float)) else f"{'-':>{w}}"
+
+    for name, row in sorted(rows.items(), key=_numerics_sort_key)[:top]:
+        print(
+            f"{fmt(row.get('rms'), 13)}  {fmt(row.get('absmax'), 11)}  "
+            f"{fmt(row.get('param_rms'), 10)}  "
+            f"{fmt(row.get('update_ratio'), 10)}  "
+            f"{fmt(row.get('moment2_max'), 10)}  "
+            f"{'ok' if row.get('finite', True) else 'NaN':>3}  "
+            f"{row.get('kind', '?'):>5}  {name}"
+        )
+    fn = ev.get("first_nonfinite")
+    if fn:
+        print(f"first non-finite: {fn.get('site')}:{fn.get('name')}")
+
+
 def summarize_telemetry(
-    files, *, top: int, perfetto=None, trace_id=None
+    files, *, top: int, perfetto=None, trace_id=None, numerics=False
 ) -> None:
     """Telemetry-mode report: span aggregate, per-executable inventory,
     per-request trace summary (schema v3 ``request_trace``), final flush
     counters; optional merged Perfetto export. ``trace_id`` filters the
-    request-trace section to one request's full milestone sequence.
-    Reads leniently — a crashed process's truncated log must still
-    report."""
+    request-trace section to one request's full milestone sequence;
+    ``numerics`` prints the per-layer table of the last numerics window
+    (schema v4). Reads leniently — a crashed process's truncated log
+    must still report."""
     from d9d_tpu.telemetry.trace_export import _read_events_lenient
 
     spans = collections.defaultdict(lambda: [0.0, 0])  # name → [Σs, n]
     executables = []
     last_flush = {}
     requests = collections.defaultdict(list)  # trace_id → [events]
+    numerics_events = []  # (path, event)
     for path in files:
         for ev in _read_events_lenient(path):
             if ev["kind"] == "span":
@@ -198,8 +244,12 @@ def summarize_telemetry(
                 last_flush[path] = ev
             elif ev["kind"] == "request_trace":
                 requests[ev["trace_id"]].append(ev)
+            elif ev["kind"] == "numerics":
+                numerics_events.append((path, ev))
 
     print(f"telemetry logs: {[str(f) for f in files]}")
+    if numerics:
+        print_numerics(numerics_events, top=top)
     if trace_id is not None:
         evs = sorted(requests.get(trace_id, []), key=lambda e: e["t"])
         if not evs:
@@ -324,19 +374,30 @@ def main():
         help="telemetry mode: print the full request_trace milestone "
         "sequence for one per-request trace id (schema v3)",
     )
+    ap.add_argument(
+        "--numerics", action="store_true",
+        help="telemetry mode: print the per-layer numerics table of the "
+        "last window (schema v4, worst offenders first)",
+    )
     args = ap.parse_args()
 
     telemetry_files = collect_telemetry_files(args.logdir)
     if telemetry_files:
         summarize_telemetry(
             telemetry_files, top=args.top, perfetto=args.perfetto,
-            trace_id=args.trace_id,
+            trace_id=args.trace_id, numerics=args.numerics,
         )
         return
     if args.perfetto:
         raise SystemExit(
             "--perfetto needs telemetry JSONL inputs (JsonlSink event "
             "logs); none found among the given paths"
+        )
+    if args.numerics:
+        raise SystemExit(
+            "--numerics needs telemetry JSONL inputs (schema-v4 "
+            "numerics events from a TrainerConfig.numerics_every_steps "
+            "run); none found among the given paths"
         )
     if len(args.logdir) != 1:
         raise SystemExit("profiler mode takes exactly one logdir")
