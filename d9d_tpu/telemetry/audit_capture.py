@@ -1,0 +1,398 @@
+"""Compile-time artifact capture for the d9d-audit contract checker.
+
+``d9d-lint`` (tools/lint/) enforces invariants the *source* can show;
+the bugs that have cost this repo the most only become checkable facts
+in the **lowered artifact**: params baked as jit constants, a donation
+XLA silently dropped (double-buffered KV pool), a sharding constraint
+whose collective schedule drifted, an f64 op smuggled in by a Python
+float. This module harvests those facts at the one moment they exist
+and cost nothing to read — inside ``TrackedJit._compile``, between
+``lower()`` and the first dispatch:
+
+- **collective census** — every all-reduce / all-gather / reduce-scatter
+  / all-to-all / collective-permute op in the *post-SPMD optimized* HLO
+  (``compiled.as_text()``), i.e. the schedule XLA actually runs, not the
+  one the source hoped for;
+- **donation coverage** — declared donated buffers (from the wrapper's
+  ``donate_argnums``/``donate_argnames`` against the concrete call
+  arguments) vs the ``input_output_alias`` pairs the compiled module
+  header actually carries;
+- **baked constants** — the closed jaxpr's ``consts`` (closure-captured
+  arrays the trace embedded into the program) with byte sizes;
+- **dtype census** — per-primitive output dtypes from the jaxpr, plus
+  the two disciplined classes: any f64 aval, and f32 matmuls
+  (``dot_general``/conv) that a bf16-compute program must not contain;
+- **host callbacks** — callback primitives in the jaxpr (a hot
+  executable with a host round-trip is a dispatch-contract breach).
+
+Capture is **opt-in** (``D9D_AUDIT_CAPTURE=1`` or :func:`enable`) and
+runs at compile time only: the steady-state call path is byte-identical
+with it on or off — zero added dispatches, zero readbacks (pinned in
+tests/tools/test_audit_clean.py). With capture on, the only delta is
+that the AOT path goes ``trace() → lower()`` instead of ``lower()``
+directly (the same trace jax performs inside ``lower()``, split so the
+jaxpr is inspectable).
+
+Facts ride the inventory (``ExecutableRecord.audit``) and the schema
+``executable`` JSONL event as an optional ``audit`` block; the checker
+in ``tools/audit/`` turns them into violations against the committed
+``AUDIT_BASELINE.json``. A process-wide *context label*
+(:func:`context`) tags which harness leg compiled an executable, so one
+name ("train_step") can carry different contracts under different
+configurations (plain vs ZeRO).
+
+Stdlib-only at module load (the telemetry package core stays jax-free);
+jax types are only touched through the objects handed in.
+"""
+
+import contextlib
+import dataclasses
+import math
+import os
+import re
+import threading
+from typing import Any
+
+__all__ = [
+    "AuditFacts",
+    "capture_enabled",
+    "context",
+    "current_context",
+    "enable",
+    "extract_facts",
+]
+
+# collective op kinds as they appear in optimized HLO text. Async pairs
+# count once via the -start half; -done is bookkeeping for the same op.
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+)
+
+# op-definition lines: `%name = <type> <kind>[-start](...)`. The result
+# type is a single token for sync ops but a parenthesized tuple WITH
+# SPACES for async (-start) and variadic collectives — `(f32[2]{0},
+# f32[4]{0}) all-gather-start(` — and on TPU HLO the tuple carries
+# NESTED parens from tiled-layout/memory-space annotations
+# (`bf16[1024,8192]{1,0:T(8,128)}`), so the tuple alternative tolerates
+# one nesting level. The `\(` anchor right after the kind keeps `-done`
+# halves (and operand references like `%all-reduce.3,`) out of the
+# count.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?:\((?:[^()]|\([^()]*\))*\)|\S+)\s+("
+    + "|".join(re.escape(k) for k in COLLECTIVE_KINDS)
+    + r")(-start)?\("
+)
+
+# jaxpr primitives that round-trip through the host
+CALLBACK_PRIMITIVES = (
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "host_callback_call",
+    "outside_call",
+)
+
+# the f32-disciplined op class: under a bf16_compute policy the heavy
+# contractions must run in bf16 — f32 is allowlisted only for the cheap
+# elementwise/reduction classes (grad accumulation, norms, masters)
+MATMUL_PRIMITIVES = ("dot_general", "conv_general_dilated")
+
+
+# -- opt-in flag + context label ----------------------------------------
+
+_lock = threading.Lock()
+_state: dict[str, Any] = {"enabled": None, "context": "default"}
+
+
+def capture_enabled() -> bool:
+    """True when artifact capture is on: programmatic :func:`enable`
+    wins; otherwise the ``D9D_AUDIT_CAPTURE`` env var (bench legs)."""
+    with _lock:
+        if _state["enabled"] is not None:
+            return _state["enabled"]
+    return os.environ.get("D9D_AUDIT_CAPTURE", "") not in ("", "0")
+
+
+def enable(on: bool = True) -> None:
+    """Force capture on/off for this process (None-able via
+    :func:`reset` semantics: ``enable(None)`` restores env control)."""
+    with _lock:
+        _state["enabled"] = on
+
+
+@contextlib.contextmanager
+def context(label: str):
+    """Tag executables compiled inside the block with ``label`` — the
+    audit manifest keys expectations by (context, executable name), so
+    the same name can carry per-configuration contracts."""
+    with _lock:
+        prev = _state["context"]
+        _state["context"] = label
+    try:
+        yield
+    finally:
+        with _lock:
+            _state["context"] = prev
+
+
+def current_context() -> str:
+    """The active context label (``D9D_AUDIT_CONTEXT`` seeds the
+    default for bench legs that can't wrap their compiles)."""
+    with _lock:
+        label = _state["context"]
+    if label == "default":
+        return os.environ.get("D9D_AUDIT_CONTEXT", "default")
+    return label
+
+
+# -- facts ---------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AuditFacts:
+    """Artifact-level facts of one compiled executable (see module
+    docstring for what each block witnesses)."""
+
+    name: str
+    context: str
+    # post-SPMD optimized-HLO collective census: kind → op count
+    collectives: dict[str, int]
+    num_partitions: int
+    # donation: declared at the call site vs aliased by the compiler
+    donated_declared: int
+    donated_bytes: int
+    aliased_pairs: int
+    # closed-jaxpr consts (closure-baked arrays), largest first
+    consts: list[dict]  # {"bytes", "shape", "dtype"}, top _MAX_CONSTS
+    const_bytes_total: int
+    n_consts: int
+    # jaxpr dtype census: dtype string → eqn-output count
+    dtype_ops: dict[str, int]
+    f64_ops: list[str]  # primitive names with an f64 operand/output
+    f32_matmuls: int  # dot/conv eqns carrying f32
+    callbacks: list[str]  # host-callback primitive names
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_MAX_CONSTS = 8  # largest consts kept per executable (facts stay small)
+
+
+def _collective_census(hlo_text: str) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        kind = m.group(1)
+        counts[kind] = counts.get(kind, 0) + 1
+    return counts
+
+
+def _module_header(hlo_text: str) -> str:
+    head = hlo_text.lstrip()
+    nl = head.find("\n")
+    return head if nl < 0 else head[:nl]
+
+
+def _alias_pairs(hlo_text: str) -> int:
+    """Number of input→output alias entries in the compiled module
+    header (``input_output_alias={ {0}: (1, {}, may-alias), ... }``) —
+    the donations XLA actually honored."""
+    header = _module_header(hlo_text)
+    start = header.find("input_output_alias={")
+    if start < 0:
+        return 0
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for j, ch in enumerate(header[i:], i):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    block = header[i:end]
+    return block.count("-alias")  # may-alias | must-alias, one per pair
+
+
+def _num_partitions(hlo_text: str) -> int:
+    m = re.search(r"num_partitions=(\d+)", _module_header(hlo_text))
+    return int(m.group(1)) if m else 1
+
+
+def _array_leaves(tree) -> list:
+    """Shape/dtype-bearing leaves of a pytree, without importing jax at
+    module scope (deferred import; capture only runs when jax exists)."""
+    import jax
+
+    return [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+    ]
+
+
+def _leaf_bytes(leaf) -> int:
+    itemsize = getattr(leaf.dtype, "itemsize", None)
+    if itemsize is None:
+        return 0
+    return math.prod(leaf.shape) * itemsize if leaf.shape else itemsize
+
+
+def _donated(args, kwargs, jit_kwargs) -> tuple[int, int]:
+    """(buffer count, bytes) the call site declared donated — the
+    coverage the compiled aliasing is checked against."""
+    donate_argnums = jit_kwargs.get("donate_argnums", ())
+    if isinstance(donate_argnums, int):
+        donate_argnums = (donate_argnums,)
+    donate_argnames = jit_kwargs.get("donate_argnames", ())
+    if isinstance(donate_argnames, str):
+        donate_argnames = (donate_argnames,)
+    count = 0
+    total = 0
+    for i in donate_argnums:
+        if i < len(args):
+            for leaf in _array_leaves(args[i]):
+                count += 1
+                total += _leaf_bytes(leaf)
+    for name in donate_argnames:
+        if name in kwargs:
+            for leaf in _array_leaves(kwargs[name]):
+                count += 1
+                total += _leaf_bytes(leaf)
+    return count, total
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and its sub-jaxprs (scan bodies, cond
+    branches, pjit calls — anything an eqn param smuggles in)."""
+    stack = [jaxpr]
+    seen: set[int] = set()
+    while stack:
+        jx = stack.pop()
+        if id(jx) in seen:
+            continue
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            yield eqn
+            for value in eqn.params.values():
+                stack.extend(_sub_jaxprs(value))
+
+
+def _sub_jaxprs(value) -> list:
+    out = []
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        out.append(value.jaxpr)  # ClosedJaxpr
+    elif hasattr(value, "eqns"):
+        out.append(value)  # raw Jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(_sub_jaxprs(v))
+    return out
+
+
+def _eqn_dtypes(eqn) -> list:
+    dts = []
+    for var in list(eqn.invars) + list(eqn.outvars):
+        dtype = getattr(getattr(var, "aval", None), "dtype", None)
+        if dtype is not None:
+            dts.append(dtype)
+    return dts
+
+
+def _jaxpr_census(closed_jaxpr) -> dict[str, Any]:
+    """Const / dtype / callback facts from the closed jaxpr (the traced
+    program before XLA touches it — platform-independent, so dtype
+    discipline can't be confused by a backend's internal upcasts)."""
+    consts = sorted(
+        (
+            {
+                "bytes": _leaf_bytes(c),
+                "shape": list(getattr(c, "shape", ())),
+                "dtype": str(getattr(c, "dtype", "?")),
+            }
+            for c in closed_jaxpr.consts
+            if hasattr(c, "shape") and hasattr(c, "dtype")
+        ),
+        key=lambda d: -d["bytes"],
+    )
+    dtype_ops: dict[str, int] = {}
+    f64_ops: list[str] = []
+    f32_matmuls = 0
+    callbacks: list[str] = []
+    for eqn in _iter_eqns(closed_jaxpr.jaxpr):
+        prim = eqn.primitive.name
+        dts = _eqn_dtypes(eqn)
+        for var in eqn.outvars:
+            dtype = getattr(getattr(var, "aval", None), "dtype", None)
+            if dtype is not None:
+                key = str(dtype)
+                dtype_ops[key] = dtype_ops.get(key, 0) + 1
+        if any(str(dt) == "float64" for dt in dts):
+            if prim not in f64_ops:
+                f64_ops.append(prim)
+        if prim in MATMUL_PRIMITIVES and any(
+            str(dt) == "float32" for dt in dts
+        ):
+            f32_matmuls += 1
+        if prim in CALLBACK_PRIMITIVES or "callback" in prim:
+            if prim not in callbacks:
+                callbacks.append(prim)
+    return {
+        "consts": consts[:_MAX_CONSTS],
+        "const_bytes_total": sum(c["bytes"] for c in consts),
+        "n_consts": len(consts),
+        "dtype_ops": dtype_ops,
+        "f64_ops": sorted(f64_ops),
+        "f32_matmuls": f32_matmuls,
+        "callbacks": sorted(callbacks),
+    }
+
+
+def extract_facts(
+    name: str,
+    *,
+    closed_jaxpr,
+    compiled_text: str,
+    args=(),
+    kwargs=None,
+    jit_kwargs=None,
+) -> AuditFacts:
+    """Assemble one executable's :class:`AuditFacts`.
+
+    ``closed_jaxpr`` may be None (a runtime without the ``trace()``
+    stage): the jaxpr-derived blocks degrade to empty, the HLO-derived
+    ones (collectives, aliasing) still land.
+    """
+    kwargs = kwargs or {}
+    jit_kwargs = jit_kwargs or {}
+    declared, donated_bytes = _donated(args, kwargs, jit_kwargs)
+    jx = (
+        _jaxpr_census(closed_jaxpr)
+        if closed_jaxpr is not None
+        else {
+            "consts": [],
+            "const_bytes_total": 0,
+            "n_consts": 0,
+            "dtype_ops": {},
+            "f64_ops": [],
+            "f32_matmuls": 0,
+            "callbacks": [],
+        }
+    )
+    return AuditFacts(
+        name=name,
+        context=current_context(),
+        collectives=_collective_census(compiled_text),
+        num_partitions=_num_partitions(compiled_text),
+        donated_declared=declared,
+        donated_bytes=donated_bytes,
+        aliased_pairs=_alias_pairs(compiled_text),
+        **jx,
+    )

@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from d9d_tpu.telemetry import audit_capture  # stdlib-only at import
+
 __all__ = [
     "ExecutableRecord",
     "RecompileGuard",
@@ -79,6 +81,10 @@ class ExecutableRecord:
     generated_code_bytes: int | None = None
     alias_bytes: int | None = None
     calls: int = 0
+    # compile-time artifact facts (telemetry/audit_capture.py): only
+    # populated when audit capture is opted in — collective census,
+    # donation coverage, baked consts, dtype census, host callbacks
+    audit: dict[str, Any] | None = None
 
     @property
     def hbm_peak_bytes(self) -> int | None:
@@ -127,6 +133,8 @@ class ExecutableRecord:
         }
         if hbm:
             ev["hbm"] = hbm
+        if self.audit is not None:
+            ev["audit"] = self.audit
         return ev
 
 
@@ -294,6 +302,9 @@ class TrackedJit:
         self.name = name
         self._fn = fn
         self._jit = jax.jit(fn, **jit_kwargs)
+        # kept for the audit-capture donation check (declared donated
+        # buffers are counted against the concrete call arguments)
+        self._jit_kwargs = dict(jit_kwargs)
         self._compiled: dict[Any, Any] = {}
         self._records: dict[Any, ExecutableRecord] = {}
         self._fallback = False
@@ -320,9 +331,35 @@ class TrackedJit:
 
         tele = get_telemetry()
         recompile = bool(self._compiled)
+        # artifact capture (audit_capture.py) is compile-time-only and
+        # opt-in: with it off this path is byte-identical to before; with
+        # it on, trace()+lower() replace the single lower() call (the
+        # same trace jax runs inside lower(), split so the jaxpr is
+        # inspectable) — the dispatch path below never changes
+        capture = audit_capture.capture_enabled()
+        traced = None
         t0 = time.perf_counter()
         try:
-            lowered = self._jit.lower(*args, **kwargs)
+            if capture and hasattr(self._jit, "trace"):
+                try:
+                    traced = self._jit.trace(*args, **kwargs)
+                    lowered = traced.lower()
+                except Exception:  # noqa: BLE001 — capture must never
+                    # degrade the TRACKED path: a quirk specific to the
+                    # trace() split falls back to the plain lower()
+                    # (facts omitted, accounting kept); a genuinely
+                    # untraceable fn re-raises identically from lower()
+                    # and lands in the outer fallback as before
+                    traced = None
+                    logger.warning(
+                        "audit capture: trace() failed for %r; "
+                        "retrying the plain lower() path (facts "
+                        "omitted, compile accounting kept)",
+                        self.name, exc_info=True,
+                    )
+                    lowered = self._jit.lower(*args, **kwargs)
+            else:
+                lowered = self._jit.lower(*args, **kwargs)
             t1 = time.perf_counter()
             compiled = lowered.compile()
             t2 = time.perf_counter()
@@ -357,6 +394,24 @@ class TrackedJit:
                 "generated_code_size_in_bytes"
             )
             record.alias_bytes = ma.get("alias_size_in_bytes")
+
+        if capture:
+            try:
+                record.audit = audit_capture.extract_facts(
+                    self.name,
+                    closed_jaxpr=getattr(traced, "jaxpr", None),
+                    compiled_text=compiled.as_text(),
+                    args=args,
+                    kwargs=kwargs,
+                    jit_kwargs=self._jit_kwargs,
+                ).to_dict()
+            except Exception:  # noqa: BLE001 — facts are observability,
+                # never a reason to fail a compile; the audit gate reads
+                # a missing block as "not captured" and fails THERE
+                logger.warning(
+                    "audit capture failed for %r (facts omitted)",
+                    self.name, exc_info=True,
+                )
 
         with _INVENTORY_LOCK:
             _INVENTORY.append(record)

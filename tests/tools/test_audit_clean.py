@@ -1,0 +1,184 @@
+"""The tier-1 audit gate: ``d9d-audit`` over the registered hot
+executables must be clean against the committed ``AUDIT_BASELINE.json``.
+
+Same shape as the lint gate (test_lint_clean.py) one layer down the
+stack: the trace harness compiles every registered executable shape —
+non-PP train step, ZeRO dp_replicate>1 train step, the fused-K and
+legacy serving paths, the speculative round, the PipelinedOptimizer
+per-stage programs — at tiny config on the CPU rig with artifact
+capture on, and the rule set certifies the *compiled artifacts*: the
+ZeRO collective schedule and the serve zero-collective contract at the
+HLO level, donation coverage 100%-or-baselined-with-reasons, no baked
+constants over threshold, no f64, no host callbacks. Every future PR
+that changes an executable's shape (MPMD stages, quantized decode)
+re-certifies here or fails with the contract named.
+
+Budget-pinned: the harness is a handful of tiny-config compiles
+(~20-40s on the 2-core rig); the pin keeps it from growing into a
+second bench suite.
+"""
+
+import pathlib
+import time
+
+import pytest
+
+from tools.audit import manifest as manifest_mod
+from tools.audit.cli import DEFAULT_BASELINE, REPO_ROOT
+from tools.audit.rules import run_rules
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+pytestmark = pytest.mark.e2e  # compiles real (tiny) executables
+
+
+@pytest.fixture
+def fresh_hub():
+    """Isolate the harness's compiles from the process hub other tests
+    assert against (recompile counters, hbm gauges), and restore."""
+    from d9d_tpu.telemetry import Telemetry, get_telemetry, set_telemetry
+    from d9d_tpu.telemetry.introspect import recompile_guard
+
+    prev = get_telemetry()
+    guard = recompile_guard()
+    set_telemetry(Telemetry())
+    guard.reset()
+    yield
+    guard.reset()
+    set_telemetry(prev)
+
+
+def test_repo_executables_are_audit_clean(fresh_hub):
+    from tools.audit.harness import LEGS, trace_registered_executables
+
+    t0 = time.perf_counter()
+    facts = trace_registered_executables()
+    wall = time.perf_counter() - t0
+
+    manifest = manifest_mod.load(DEFAULT_BASELINE)
+    report = run_rules(facts, manifest)
+    diff = manifest_mod.diff_against_baseline(report.violations, manifest)
+
+    # every leg captured facts (the harness raises otherwise) and every
+    # committed expectation found its executable — the gate cannot be
+    # hollowed out by a rename or a dropped leg
+    contexts = {f["context"] for f in facts}
+    assert contexts == set(LEGS)
+    assert report.unmatched_expectations == [], (
+        "expectations that matched no captured executable: "
+        f"{report.unmatched_expectations}"
+    )
+    assert report.unchecked_contexts == []
+
+    assert diff.ok, (
+        "NEW d9d-audit violations (fix the artifact, or accept into "
+        "AUDIT_BASELINE.json with a reason):\n"
+        + "\n".join(v.render() for v in diff.new)
+    )
+    assert not diff.stale, (
+        "stale AUDIT_BASELINE.json entries (the debt was paid — "
+        "refresh with `d9d-audit --write-baseline`):\n"
+        + "\n".join(str(e) for e in diff.stale)
+    )
+
+    # the headline contracts, asserted against the raw facts so a
+    # manifest edit can't silently weaken them:
+    # (a) the ZeRO step's update collectives exist and were verified at
+    # the HLO level on a >1-partition program
+    zero_facts = [
+        f for f in facts
+        if f["context"] == "train_zero" and f["name"] == "train_step"
+    ]
+    assert zero_facts and all(
+        f["num_partitions"] > 1 and f["collectives"] for f in zero_facts
+    )
+    # (b) every serving-path executable is collective-free on the
+    # 1-replica mesh — decode never pays a cross-replica hop
+    serve_facts = [
+        f for f in facts if f["context"] in ("serve", "spec_decode")
+    ]
+    assert serve_facts and all(
+        not f["collectives"] for f in serve_facts
+    )
+    # (c) donation coverage: 100% everywhere or baselined with a reason
+    baselined = {
+        e["fingerprint"]: e for e in manifest.get("baseline", [])
+    }
+    for v in report.violations:
+        if v.rule == "D9D101":
+            entry = baselined[v.fingerprint()]
+            assert entry["reason"].strip()
+    # (d) no f64, no callbacks, no over-threshold consts anywhere in
+    # the registered set (none are currently baselined)
+    assert all(not f["f64_ops"] for f in facts)
+    assert all(not f["callbacks"] for f in facts)
+
+    # budget pin: a handful of tiny compiles, generous 4x headroom on
+    # the 2-core rig
+    assert wall < 120.0, f"audit harness took {wall:.1f}s — budget blown"
+
+
+def test_capture_adds_zero_runtime_work(fresh_hub):
+    """The acceptance pin that audit facts are harvested at compile
+    time only: with capture forced on, a tracked executable compiles
+    once, its steady-state calls hit the compiled-executable cache, and
+    an off-compile call completes under a device→host transfer guard
+    (any capture-added readback would raise)."""
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.telemetry import audit_capture, introspect, tracked_jit
+
+    audit_capture.enable(True)
+    try:
+        mark = len(introspect.inventory())
+        tj = tracked_jit(
+            lambda x, s: (x * 2 + 1, s + 1),
+            name="audit_gate/pin", donate_argnums=(1,),
+        )
+        x = jnp.ones((8, 8))
+        s = jnp.zeros((), jnp.int32)
+        _, s = tj(x, s)
+        with jax.transfer_guard_device_to_host("disallow"):
+            for _ in range(3):
+                out, s = tj(x, s)
+        jax.block_until_ready(out)
+        recs = introspect.inventory()[mark:]
+        assert len(recs) == 1, "steady-state calls must not re-capture"
+        assert recs[0].calls == 4
+        assert recs[0].audit is not None
+        assert recs[0].audit["donated_declared"] == 1
+        assert recs[0].audit["aliased_pairs"] == 1
+    finally:
+        audit_capture.enable(None)
+
+
+def test_gate_paths_are_the_committed_ones():
+    """The gate must audit against the real committed manifest — a
+    drifted default would hollow out the gate."""
+    assert REPO_ROOT == ROOT
+    assert DEFAULT_BASELINE == ROOT / "AUDIT_BASELINE.json"
+    assert DEFAULT_BASELINE.exists()
+    manifest = manifest_mod.load(DEFAULT_BASELINE)
+    # the committed contracts this PR pre-registered stay committed
+    for context in ("train", "train_zero", "serve", "spec_decode",
+                    "pp_opt"):
+        assert context in manifest["expectations"], context
+    # every baseline entry carries a human reason (load enforces it; the
+    # explicit loop keeps the failure message naming the entry)
+    for entry in manifest["baseline"]:
+        assert entry["reason"].strip(), entry
+
+
+def test_console_entry_declared():
+    pyproject = (ROOT / "pyproject.toml").read_text(encoding="utf-8")
+    assert 'd9d-audit = "tools.audit.cli:main"' in pyproject
+
+
+def test_cli_list_surfaces():
+    from tools.audit.cli import main
+
+    assert main(["--list-rules"]) == 0
+    assert main(["--list-legs"]) == 0
+    # --facts with no files is a usage error, not a clean run
+    assert main(["--facts"]) == 2

@@ -669,3 +669,132 @@ def test_syntax_error_reported_not_crash(tmp_path):
 
     with pytest.raises(Exception):
         lint_paths(root, [root / "d9d_tpu"], list(ALL_RULES))
+
+
+# -- D9D007 (tracked_jit name uniqueness, cross-file) ---------------------
+
+
+def test_d9d007_duplicate_literal_names_fire_across_files(tmp_path):
+    make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            f = tracked_jit(lambda x: x, name="shared/step")
+        """,
+        "d9d_tpu/loop/b.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            g = tracked_jit(lambda x: x + 1, name="shared/step")
+        """,
+    })
+    found = run(tmp_path, [RULES_BY_ID["D9D007"]])
+    # every site of the duplicated name is flagged, and each message
+    # names the other collision sites
+    assert len(found) == 2
+    assert {f.rule for f in found} == {"D9D007"}
+    assert {f.path for f in found} == {
+        "d9d_tpu/loop/a.py", "d9d_tpu/loop/b.py",
+    }
+    assert all("shared/step" in f.message for f in found)
+    assert all("a.py" in f.message and "b.py" in f.message for f in found)
+
+
+def test_d9d007_identical_fstring_templates_fire(tmp_path):
+    make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            def build(stage):
+                return tracked_jit(lambda x: x, name=f"pp/s{stage}/update")
+
+            def build2(stage):
+                return tracked_jit(lambda x: x, name=f"pp/s{stage}/update")
+        """,
+    })
+    found = run(tmp_path, [RULES_BY_ID["D9D007"]])
+    # two SITES with the same template collide for every formatted
+    # value — the blended-gauge bug the per-stage factories fixed
+    assert len(found) == 2
+
+
+def test_d9d007_distinct_names_and_single_factory_clean(tmp_path):
+    make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            f = tracked_jit(lambda x: x, name="serve/step")
+            g = tracked_jit(lambda x: x, name="serve/reset_row")
+
+            def per_stage(sid, label):
+                # ONE site formatted many ways is a single factory, not
+                # a collision
+                return tracked_jit(lambda x: x, name=f"pp_s{sid}/{label}")
+
+            def dynamic(name):
+                # non-static name: out of the rule's reach, never flagged
+                return tracked_jit(lambda x: x, name=name)
+        """,
+    })
+    assert run(tmp_path, [RULES_BY_ID["D9D007"]]) == []
+
+
+def test_d9d007_suppression_with_reason_applies(tmp_path):
+    make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            # d9d-lint: disable=D9D007 — deliberate share, one of the two is ever built
+            f = tracked_jit(lambda x: x, name="shared/step")
+            g = tracked_jit(lambda x: x, name="shared/step")  # d9d-lint: disable=D9D007 — deliberate share, one of the two is ever built
+        """,
+    })
+    assert run(tmp_path, [RULES_BY_ID["D9D007"]]) == []
+
+
+def test_d9d007_lint_file_single_file_still_checks(tmp_path):
+    from tools.lint.engine import lint_file
+
+    make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": """
+            from d9d_tpu.telemetry import tracked_jit
+
+            f = tracked_jit(lambda x: x, name="shared/step")
+            g = tracked_jit(lambda x: x, name="shared/step")
+        """,
+    })
+    found = lint_file(
+        tmp_path, tmp_path / "d9d_tpu/loop/a.py",
+        [RULES_BY_ID["D9D007"]],
+    )
+    assert len(found) == 2
+
+
+def test_rule_raised_linterror_routes_to_on_error(tmp_path):
+    """A LintError raised by a rule's check() (not just a parse
+    failure) reports via on_error and the scan continues — the
+    documented no-raise contract library callers rely on."""
+    from tools.lint.engine import LintError as LE
+
+    root = make_repo(tmp_path, {
+        "d9d_tpu/loop/a.py": "x = 1\n",
+        "d9d_tpu/loop/b.py": "y = 2\n",
+    })
+
+    class ExplodingRule:
+        rule_id = "D9DX99"
+        summary = "always raises"
+
+        @classmethod
+        def check(cls, ctx):
+            raise LE(f"{ctx.path}: rule blew up")
+            yield  # pragma: no cover
+
+    errors = []
+    findings = lint_paths(
+        root, [root / "d9d_tpu"], [ExplodingRule],
+        on_error=lambda e: errors.append(str(e)),
+    )
+    assert findings == []
+    assert len(errors) == 2  # every file reported, scan never aborted
+    with pytest.raises(LE):
+        lint_paths(root, [root / "d9d_tpu"], [ExplodingRule])

@@ -1,0 +1,40 @@
+"""d9d-audit: static analysis over *compiled artifacts* (docs/design/
+static_analysis.md "Compiled-artifact audit").
+
+``d9d-lint`` checks what the source can show; this package checks what
+only the lowered artifact can: the post-SPMD collective schedule, the
+compiled input_output_alias set vs declared donations, closure-baked
+constants, the dtype census, host callbacks. Facts are harvested at
+compile time by ``d9d_tpu/telemetry/audit_capture.py`` (opt-in, zero
+runtime dispatches/readbacks) and checked here against the committed
+``AUDIT_BASELINE.json`` — named expectations per (context, executable)
+plus an accepted-violation baseline with mandatory reasons.
+
+Gate entry points: ``d9d-audit`` / ``python -m tools.audit`` (CLI),
+``tests/tools/test_audit_clean.py`` (the tier-1 gate over the
+registered hot executables).
+"""
+
+from tools.audit.manifest import (
+    AuditManifestError,
+    diff_against_baseline,
+    load,
+    write_baseline,
+)
+from tools.audit.rules import (
+    RULE_SUMMARIES,
+    AuditReport,
+    Violation,
+    run_rules,
+)
+
+__all__ = [
+    "AuditManifestError",
+    "AuditReport",
+    "RULE_SUMMARIES",
+    "Violation",
+    "diff_against_baseline",
+    "load",
+    "run_rules",
+    "write_baseline",
+]

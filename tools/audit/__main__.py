@@ -1,0 +1,6 @@
+"""``python -m tools.audit`` — the d9d-audit console entry."""
+
+from tools.audit.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,294 @@
+"""Trace the registered hot executables on the CPU rig and collect
+their compile-time audit facts.
+
+This is the standing certification harness the tier-1 gate
+(tests/tools/test_audit_clean.py) and the ``d9d-audit`` CLI both run:
+every executable shape the repo dispatches in production is compiled
+here once, at tiny config, with artifact capture on — non-PP train
+step, ZeRO dp_replicate>1 train step, the serving fused-K and legacy
+step paths, the speculative-decode round, and the PipelinedOptimizer
+per-stage update programs. Each leg runs under its own capture context
+so the manifest can pre-register per-configuration contracts (the same
+``train_step`` name carries "no collectives" plain and the exact
+reduce-scatter/all-gather schedule under ZeRO).
+
+Facts are harvested at compile time only (telemetry/audit_capture.py):
+the legs below dispatch a handful of steps merely to force each
+wrapper's lower→compile, and the gate pins that capture added zero
+runtime dispatches/readbacks.
+
+Every leg asserts it captured at least one fact block — a silently
+disabled capture (or a renamed executable) must fail the gate, not
+read as clean.
+"""
+
+import contextlib
+from typing import Callable
+
+__all__ = ["LEGS", "trace_registered_executables"]
+
+
+def _collect(leg_name: str, fn: Callable[[], None]) -> list[dict]:
+    from d9d_tpu.telemetry import audit_capture, introspect
+
+    mark = len(introspect.inventory())
+    with audit_capture.context(leg_name):
+        fn()
+    facts = [
+        r.audit
+        for r in introspect.inventory()[mark:]
+        if r.audit is not None
+    ]
+    if not facts:
+        raise RuntimeError(
+            f"audit leg {leg_name!r} captured no facts — either capture "
+            "was not enabled or the leg compiled nothing; the gate "
+            "cannot certify what it did not see"
+        )
+    return facts
+
+
+# -- toy fixtures (the tests/parallel/test_zero.py shapes) ---------------
+
+
+def _toy_train(dp: int, zero_on: bool, steps: int = 2) -> None:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.core.mesh import MeshParameters
+    from d9d_tpu.core.tree_sharding import replicate_uncommitted
+    from d9d_tpu.loop.control.task import TrainTask
+    from d9d_tpu.loop.train_step import build_train_step
+    from d9d_tpu.parallel.zero import (
+        ZeroShardedOptimizer,
+        build_zero_sharding,
+        place_tree,
+    )
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    class ToyTask(TrainTask):
+        def prepare_batch(self, batch):
+            return batch
+
+        def loss_fn(self, module, params, mb, rng):
+            y = module.apply(params, mb["x"])
+            return (
+                jnp.sum((y - mb["y"]) ** 2),
+                jnp.float32(mb["x"].shape[0]),
+                {},
+            )
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(16)(x)
+            return nn.Dense(4)(jax.nn.relu(h))
+
+    ctx = MeshParameters(dp_replicate=dp).build(jax.devices()[:dp])
+    module = Net()
+    x = jnp.ones((2, 4, 8)) * jnp.arange(8)
+    y = jnp.linspace(0, 1, 2 * 4 * 4).reshape(2, 4, 4)
+    params = jax.device_put(
+        module.init(jax.random.PRNGKey(0), x[0]),
+        NamedSharding(ctx.mesh, P()),
+    )
+    opt = optax.adamw(1e-2)
+    opt_state = replicate_uncommitted(jax.jit(opt.init)(params), ctx.mesh)
+    zero = None
+    if zero_on:
+        zero = build_zero_sharding(
+            params=params, opt_state=opt_state, mesh=ctx.mesh
+        )
+        opt_state = place_tree(opt_state, zero.state_shardings)
+        opt = ZeroShardedOptimizer(opt, zero)
+    step = build_train_step(
+        module=module, task=ToyTask(), optimizer=opt,
+        num_microbatches=2, zero=zero,
+    )
+    rng = jax.random.PRNGKey(1)
+    for _ in range(steps):
+        params, opt_state, metrics = step(
+            params, opt_state, {"x": x, "y": y}, rng
+        )
+    jax.block_until_ready(metrics["loss"])
+
+
+def leg_train() -> None:
+    """Non-PP train step on a 1-chip mesh: zero collectives."""
+    _toy_train(dp=1, zero_on=False)
+
+
+def leg_train_zero() -> None:
+    """ZeRO dp_replicate=2 train step: the reduce-scatter/all-gather
+    schedule (expressed as all-reduce + all-gather on the CPU SPMD
+    backend) pre-registered in the manifest."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "the ZeRO audit leg needs >= 2 devices — run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(the d9d-audit CLI sets this automatically)"
+        )
+    _toy_train(dp=2, zero_on=True)
+
+
+def leg_serve() -> None:
+    """The fused-K serving path (fused_k4[_admit] + row reset) and the
+    legacy per-token ``serve/step`` — the legacy leg runs the tiny
+    model in bf16 so the gate exercises the bf16_compute dtype policy
+    on a real decode program."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.bench_serve import build_model
+
+    from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM
+
+    model, params, cfg = build_model(tiny=True)
+    prompt = [1, 2, 3]
+    fused = ContinuousBatcher(
+        model, params, batch_size=2, chunk_size=4, overlap=True
+    )
+    fused.submit(prompt, max_new_tokens=10)
+    fused.drain()
+
+    bf16_model = Qwen3DenseCausalLM(
+        config=model.config, sdpa=model.sdpa, dtype=jnp.bfloat16,
+        decode_max_length=model.decode_max_length,
+    )
+    bf16_params = jax.tree.map(
+        lambda x: (
+            x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
+        ),
+        params,
+    )
+    legacy = ContinuousBatcher(
+        bf16_model, bf16_params, batch_size=2, chunk_size=None
+    )
+    legacy.submit(prompt, max_new_tokens=4)
+    legacy.drain()
+
+
+def leg_spec_decode() -> None:
+    """The fused speculative round (serve/spec_round): draft + verify
+    as one executable, zero collectives."""
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.loop.speculative import speculative_generate
+    from d9d_tpu.models.qwen3 import Qwen3DenseCausalLM, Qwen3DenseConfig
+    from d9d_tpu.ops.attention.eager import eager_sdpa
+
+    def dense(seed: int):
+        cfg = Qwen3DenseConfig(
+            vocab_ranges=(("default", 64),),
+            hidden_size=32, num_layers=2, num_heads=4, num_kv_heads=2,
+            head_dim=8, intermediate_size=64, remat=False,
+        )
+        model = Qwen3DenseCausalLM(
+            config=cfg, sdpa=eager_sdpa, dtype=jnp.float32,
+            decode_max_length=24,
+        )
+        z = jnp.zeros((2, 4), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (2, 4))
+        params = model.clone(decode_max_length=0).init(
+            jax.random.PRNGKey(seed), z, pos, z
+        )["params"]
+        return model, params
+
+    model, params = dense(0)
+    draft, draft_params = dense(7)
+    prompt = jnp.ones((2, 4), jnp.int32)
+    out = speculative_generate(
+        model, params, draft, draft_params, prompt,
+        max_new_tokens=6, speculate_k=2,
+    )
+    jax.block_until_ready(out)
+
+
+def leg_pp_opt() -> None:
+    """PipelinedOptimizer per-stage device programs under ZeRO
+    (pp_opt/s{S}/update_guarded + combine_guarded + sq_norm): the
+    per-stage pairs the MPMD runtime will inherit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from d9d_tpu.core.mesh import AXIS_DP_REPLICATE
+    from d9d_tpu.pipelining.training import PipelinedOptimizer
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "the pp_opt audit leg needs >= 2 devices — run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    mesh = Mesh(np.array(jax.devices()[:2]), (AXIS_DP_REPLICATE,))
+    sh = NamedSharding(mesh, P())
+    popt = PipelinedOptimizer(
+        optimizer=optax.adamw(1e-2),
+        scalar_shardings={0: sh, 1: sh},
+        anomaly_freeze=True,
+        zero_axis=AXIS_DP_REPLICATE,
+    )
+    params = {
+        s: {"w": jax.device_put(
+            jnp.linspace(s, s + 1, 16).reshape(4, 4), sh
+        )}
+        for s in (0, 1)
+    }
+    states = popt.init(params)
+    guard = popt.init_guard_state()
+    for i in range(2):
+        grads = {
+            s: {"w": jnp.full((4, 4), 0.1 * (i + 1))} for s in (0, 1)
+        }
+        params, states, _, _gm, guard = popt.step_guarded(
+            params, states, grads, jnp.float32(1.0), jnp.float32(1.0),
+            guard,
+        )
+    jax.block_until_ready(guard)
+
+
+LEGS: dict[str, Callable[[], None]] = {
+    "train": leg_train,
+    "train_zero": leg_train_zero,
+    "serve": leg_serve,
+    "spec_decode": leg_spec_decode,
+    "pp_opt": leg_pp_opt,
+}
+
+
+def trace_registered_executables(
+    legs: list[str] | None = None,
+) -> list[dict]:
+    """Run the requested legs (default: all) with capture forced on;
+    returns every captured fact block. The caller owns telemetry-hub
+    hygiene (the gate test installs a fresh hub around this)."""
+    names = list(LEGS) if legs is None else list(legs)
+    unknown = [n for n in names if n not in LEGS]
+    if unknown:
+        raise ValueError(
+            f"unknown audit leg(s) {unknown}; available: {list(LEGS)}"
+        )
+    facts: list[dict] = []
+    with _capture_forced_on():
+        for name in names:
+            facts.extend(_collect(name, LEGS[name]))
+    return facts
+
+
+@contextlib.contextmanager
+def _capture_forced_on():
+    from d9d_tpu.telemetry import audit_capture
+
+    audit_capture.enable(True)
+    try:
+        yield
+    finally:
+        audit_capture.enable(None)  # back to env-var control

@@ -1,0 +1,151 @@
+"""``AUDIT_BASELINE.json``: the committed named-expectation manifest +
+accepted-violation baseline (the bench_compare / lint-baseline shape).
+
+Two sections, one file, both committed at the repo root:
+
+- ``expectations`` — hand-written contracts keyed by capture context
+  then executable name (exact or glob): the pre-registered collective
+  schedules (D9D100), per-executable dtype policies and const-size
+  overrides. These are the *positive* contracts — the audit fails when
+  they drift OR when an expectation stops matching anything.
+- ``baseline`` — violations that were consciously accepted, each with a
+  fingerprint and a MANDATORY human reason (mirroring the inline-lint
+  suppression policy: the reason documents WHY the artifact may stay
+  that way). The gate fails only on NEW violations; stale entries
+  (baselined violations that no longer fire) are reported so the file
+  shrinks as debt is paid.
+
+``--write-baseline`` refreshes the section, carrying existing reasons
+forward by fingerprint and stamping new entries with ``FILL-ME`` — the
+loader rejects those, so an author cannot land an acceptance without
+writing its justification.
+"""
+
+import dataclasses
+import json
+import pathlib
+from typing import Any, Optional
+
+from tools.audit.rules import Violation
+
+__all__ = [
+    "AuditManifestError",
+    "BaselineDiff",
+    "FILL_ME",
+    "diff_against_baseline",
+    "load",
+    "write_baseline",
+]
+
+FILL_ME = "FILL-ME: justify why this artifact may stay this way"
+
+
+class AuditManifestError(ValueError):
+    """A manifest that cannot gate anything (bad shape, missing
+    reasons) — rc 2 territory, never silently treated as empty."""
+
+
+@dataclasses.dataclass
+class BaselineDiff:
+    new: list[Violation]
+    baselined: list[Violation]
+    stale: list[dict]  # baseline entries that no longer fire
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def load(path: pathlib.Path) -> dict[str, Any]:
+    """Parse + validate the manifest; a missing file is an empty one
+    (no expectations, no baseline — the universal rules still run)."""
+    if not path.exists():
+        return {"version": 1, "expectations": {}, "baseline": []}
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except ValueError as e:
+        raise AuditManifestError(f"{path}: not valid JSON: {e}") from e
+    if not isinstance(data, dict) or "expectations" not in data:
+        raise AuditManifestError(
+            f"{path}: not a d9d-audit manifest (no 'expectations' key)"
+        )
+    entries = data.get("baseline", [])
+    unkeyed = [
+        i for i, e in enumerate(entries)
+        if not isinstance(e, dict)
+        or not str(e.get("fingerprint", "")).strip()
+    ]
+    if unkeyed:
+        # the baseline is the file humans hand-edit to fill in reasons:
+        # a dropped/typo'd fingerprint must be an rc-2 manifest error
+        # here, not a KeyError traceback downstream
+        raise AuditManifestError(
+            f"{path}: baseline entries without a fingerprint (indices "
+            f"{unkeyed}) — every entry must carry the violation "
+            "fingerprint it accepts"
+        )
+    missing = [
+        e["fingerprint"]
+        for e in entries
+        if not str(e.get("reason", "")).strip()
+        or str(e.get("reason", "")).startswith("FILL-ME")
+    ]
+    if missing:
+        raise AuditManifestError(
+            f"{path}: baseline entries without a reason: {missing} — "
+            "every accepted violation must document why the artifact "
+            "may stay that way (the lint suppression policy, applied "
+            "to executables)"
+        )
+    return data
+
+
+def diff_against_baseline(
+    violations: list[Violation], manifest: dict[str, Any]
+) -> BaselineDiff:
+    entries = manifest.get("baseline", [])
+    known = {e["fingerprint"] for e in entries}
+    new, old = [], []
+    seen = set()
+    for v in violations:
+        fp = v.fingerprint()
+        seen.add(fp)
+        (old if fp in known else new).append(v)
+    stale = [e for e in entries if e["fingerprint"] not in seen]
+    return BaselineDiff(new=new, baselined=old, stale=stale)
+
+
+def write_baseline(
+    path: pathlib.Path,
+    violations: list[Violation],
+    previous: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    """Rewrite the ``baseline`` section from the current violations,
+    keeping ``expectations``/``defaults`` and carrying existing reasons
+    forward by fingerprint; new entries get :data:`FILL_ME` (which
+    :func:`load` rejects until a human writes the reason)."""
+    previous = previous if previous is not None else (
+        json.loads(path.read_text(encoding="utf-8"))
+        if path.exists()
+        else {"version": 1, "expectations": {}}
+    )
+    reasons = {
+        e["fingerprint"]: e.get("reason", FILL_ME)
+        for e in previous.get("baseline", [])
+    }
+    data = {k: v for k, v in previous.items() if k != "baseline"}
+    data["baseline"] = [
+        {
+            "fingerprint": v.fingerprint(),
+            "rule": v.rule,
+            "context": v.context,
+            "executable": v.executable,
+            "message": v.message,
+            "reason": reasons.get(v.fingerprint(), FILL_ME),
+        }
+        for v in violations
+    ]
+    path.write_text(
+        json.dumps(data, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    return data

@@ -1,0 +1,322 @@
+"""The d9d-audit rule set: contracts over compiled-artifact facts.
+
+Input is the ``audit`` fact blocks ``telemetry/audit_capture.py``
+harvests at compile time (one dict per executable, tagged with a
+context label); output is :class:`Violation` rows the committed
+``AUDIT_BASELINE.json`` gate diffs (tools/audit/manifest.py).
+
+Rules (docs/design/static_analysis.md "Compiled-artifact audit"):
+
+- **D9D100 collective census** — executables with a manifest
+  expectation must carry EXACTLY the pre-registered collective schedule
+  (``collectives: {kind: count}``) or none at all
+  (``no_collectives``). The ZeRO update's reduce-scatter/all-gather
+  pairs and the serve paths' zero-collective contract are checked at
+  the post-SPMD HLO level — the schedule XLA actually runs. An
+  expectation that matches no captured executable is itself a failure
+  (a contract that silently stopped being checked).
+- **D9D101 donation coverage** — every donated buffer declared at the
+  call site must appear in the compiled module's input_output_alias
+  set. A silently dropped donation double-buffers the tree it covers
+  (the KV pool, the optimizer state).
+- **D9D102 baked constants** — no closure-baked constant above the
+  size threshold (manifest ``defaults.max_const_bytes``, per-executable
+  override). The artifact-level closure of D9D002's AST heuristic: a
+  param tree that reaches the trace as a constant shows up here no
+  matter how it was smuggled.
+- **D9D103 dtype discipline** — f64 anywhere is a violation (this repo
+  never enables x64; an f64 op is a host Python float leaking into a
+  program). Under a ``dtype_policy: bf16_compute`` expectation, f32
+  matmuls are violations too — the heavy contractions must run bf16,
+  f32 is allowlisted only for the cheap accumulation/norm classes.
+- **D9D104 host callbacks** — a tracked (hot) executable must not
+  contain host-callback primitives: every tracked program is on a
+  dispatch-counted path where a host round-trip breaks the
+  1-dispatch-per-chunk contracts.
+"""
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+from typing import Any, Optional
+
+__all__ = [
+    "AuditReport",
+    "RULE_SUMMARIES",
+    "Violation",
+    "run_rules",
+]
+
+RULE_SUMMARIES = {
+    "D9D100": "collective census must match the pre-registered schedule",
+    "D9D101": "every declared donated buffer must be aliased when compiled",
+    "D9D102": "no closure-baked constant above the size threshold",
+    "D9D103": "no f64 anywhere; bf16_compute programs carry no f32 matmul",
+    "D9D104": "no host callbacks in tracked executables",
+}
+
+DEFAULT_MAX_CONST_BYTES = 16384
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One contract breach on one executable.
+
+    ``key`` is the stable identity detail the baseline fingerprint
+    hashes: it changes when the *violating artifact* changes (a new
+    census, a different const) but not across re-runs — the lint
+    fingerprint discipline, applied to executables instead of lines.
+    """
+
+    rule: str
+    context: str
+    executable: str
+    message: str
+    key: str
+
+    def fingerprint(self) -> str:
+        digest = hashlib.sha1(
+            f"{self.rule}|{self.context}|{self.executable}|{self.key}"
+            .encode()
+        ).hexdigest()[:16]
+        return digest
+
+    def render(self) -> str:
+        return (
+            f"{self.context}:{self.executable}: {self.rule} {self.message}"
+        )
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Everything one audit pass over a fact set produced."""
+
+    violations: list[Violation]
+    # expectation entries whose context appeared in the facts but whose
+    # pattern matched no captured executable — a hollowed-out contract
+    unmatched_expectations: list[tuple[str, str]]
+    # expectation contexts with no captured facts at all (a partial
+    # capture, e.g. one bench leg): reported, not failed
+    unchecked_contexts: list[str]
+    n_executables: int = 0
+
+
+def _match_expectation(
+    expectations: dict[str, Any], context: str, name: str
+) -> tuple[Optional[dict], Optional[str]]:
+    """The expectation entry for (context, name): exact name match wins,
+    then glob patterns in sorted order. Returns (entry, pattern)."""
+    table = expectations.get(context)
+    if not table:
+        return None, None
+    if name in table:
+        return table[name], name
+    for pattern in sorted(table):
+        if any(ch in pattern for ch in "*?[") and fnmatch.fnmatchcase(
+            name, pattern
+        ):
+            return table[pattern], pattern
+    return None, None
+
+
+def _census_key(census: dict[str, int]) -> str:
+    return json.dumps({k: census[k] for k in sorted(census)})
+
+
+def _check_collectives(
+    fact: dict, exp: Optional[dict]
+) -> Optional[Violation]:
+    if not exp:
+        return None
+    census = {k: v for k, v in fact.get("collectives", {}).items() if v}
+    expected: Optional[dict[str, int]] = None
+    if exp.get("no_collectives"):
+        expected = {}
+    if "collectives" in exp:
+        expected = {k: v for k, v in exp["collectives"].items() if v}
+    if expected is None or census == expected:
+        return None
+    return Violation(
+        rule="D9D100",
+        context=fact["context"],
+        executable=fact["name"],
+        message=(
+            f"collective schedule drifted: compiled HLO carries "
+            f"{census or 'no collectives'}, the manifest pre-registered "
+            f"{expected or 'no collectives'} "
+            f"(num_partitions={fact.get('num_partitions', 1)})"
+        ),
+        key=_census_key(census),
+    )
+
+
+def _check_donation(fact: dict) -> Optional[Violation]:
+    declared = fact.get("donated_declared", 0)
+    aliased = fact.get("aliased_pairs", 0)
+    if declared <= aliased:
+        return None
+    return Violation(
+        rule="D9D101",
+        context=fact["context"],
+        executable=fact["name"],
+        message=(
+            f"donation dropped: {declared} donated buffer(s) declared "
+            f"({fact.get('donated_bytes', 0)} B) but only {aliased} "
+            "input_output_alias pair(s) in the compiled module — the "
+            "un-aliased buffers are double-buffered for the life of "
+            "the dispatch"
+        ),
+        key=f"declared={declared},aliased={aliased}",
+    )
+
+
+def _check_consts(
+    fact: dict, exp: Optional[dict], defaults: dict
+) -> list[Violation]:
+    threshold = (exp or {}).get(
+        "max_const_bytes",
+        defaults.get("max_const_bytes", DEFAULT_MAX_CONST_BYTES),
+    )
+    out = []
+    # two distinct baked consts can share dtype+shape (two smuggled
+    # weight matrices): an occurrence index keeps their fingerprints
+    # distinct so one baseline entry never covers any number of them
+    occurrence: dict[tuple[str, str], int] = {}
+    for const in fact.get("consts", []):
+        if const["bytes"] <= threshold:
+            continue  # consts arrive sorted, but don't rely on it
+        ident = (const["dtype"], str(const["shape"]))
+        n = occurrence.get(ident, 0)
+        occurrence[ident] = n + 1
+        out.append(Violation(
+            rule="D9D102",
+            context=fact["context"],
+            executable=fact["name"],
+            message=(
+                f"baked constant {const['dtype']}{const['shape']} "
+                f"({const['bytes']} B > {threshold} B threshold): a "
+                "closure-captured array was compiled into the program "
+                "— pass it as a traced argument (the install_weights "
+                "bug class)"
+            ),
+            key=f"const:{const['dtype']}:{const['shape']}:{n}",
+        ))
+    return out
+
+
+def _check_dtypes(fact: dict, exp: Optional[dict]) -> list[Violation]:
+    out = []
+    if fact.get("f64_ops"):
+        out.append(Violation(
+            rule="D9D103",
+            context=fact["context"],
+            executable=fact["name"],
+            message=(
+                f"f64 in the traced program (primitives "
+                f"{fact['f64_ops']}): this repo never enables x64 — "
+                "an f64 aval is a host Python float leaking into the "
+                "program at double width"
+            ),
+            key="f64:" + ",".join(fact["f64_ops"]),
+        ))
+    policy = (exp or {}).get("dtype_policy", "any")
+    if policy == "bf16_compute" and fact.get("f32_matmuls", 0) > 0:
+        out.append(Violation(
+            rule="D9D103",
+            context=fact["context"],
+            executable=fact["name"],
+            message=(
+                f"{fact['f32_matmuls']} f32 matmul(s) in a "
+                "bf16_compute program: the heavy contractions must run "
+                "bf16 — f32 is allowlisted only for accumulation/norm/"
+                "master classes, which are not matmuls"
+            ),
+            key=f"f32_matmuls={fact['f32_matmuls']}",
+        ))
+    return out
+
+
+def _check_callbacks(fact: dict) -> Optional[Violation]:
+    callbacks = fact.get("callbacks", [])
+    if not callbacks:
+        return None
+    return Violation(
+        rule="D9D104",
+        context=fact["context"],
+        executable=fact["name"],
+        message=(
+            f"host callback(s) {callbacks} in a tracked executable: "
+            "every tracked program is on a dispatch-counted hot path "
+            "where a host round-trip breaks the fused-dispatch "
+            "contracts"
+        ),
+        key="cb:" + ",".join(sorted(callbacks)),
+    )
+
+
+def run_rules(
+    facts: list[dict], manifest: dict[str, Any]
+) -> AuditReport:
+    """All violations of ``facts`` against ``manifest`` expectations.
+
+    Dedup: one executable may compile several signatures (admit vs
+    steady-state fused variants share a name only when identical —
+    tracked names are unique, but one name can legitimately hold
+    multiple signature records). Identical violations (same
+    fingerprint) collapse to one row.
+    """
+    expectations = manifest.get("expectations", {})
+    defaults = manifest.get("defaults", {})
+    violations: list[Violation] = []
+    matched: set[tuple[str, str]] = set()
+    contexts_seen = {f["context"] for f in facts}
+    # D9D100 certifies the STEADY-STATE program: when one name compiled
+    # several signatures in a leg (a legitimate warmup variant, e.g. the
+    # PipelinedOptimizer's first step before its state lands on the 1/N
+    # layout), the last-compiled artifact is the one the loop keeps
+    # dispatching — that census is the contract. Every other rule
+    # checks every signature.
+    last_by_name = {(f["context"], f["name"]): f for f in facts}
+    for fact in facts:
+        exp, pattern = _match_expectation(
+            expectations, fact["context"], fact["name"]
+        )
+        if pattern is not None:
+            matched.add((fact["context"], pattern))
+        if last_by_name[(fact["context"], fact["name"])] is fact:
+            v = _check_collectives(fact, exp)
+            if v:
+                violations.append(v)
+        v = _check_donation(fact)
+        if v:
+            violations.append(v)
+        violations.extend(_check_consts(fact, exp, defaults))
+        violations.extend(_check_dtypes(fact, exp))
+        v = _check_callbacks(fact)
+        if v:
+            violations.append(v)
+
+    unmatched = []
+    unchecked = []
+    for context, table in expectations.items():
+        if context not in contexts_seen:
+            unchecked.append(context)
+            continue
+        for pattern in table:
+            if (context, pattern) not in matched:
+                unmatched.append((context, pattern))
+
+    seen: set[str] = set()
+    unique = []
+    for v in violations:
+        fp = v.fingerprint()
+        if fp not in seen:
+            seen.add(fp)
+            unique.append(v)
+    return AuditReport(
+        violations=unique,
+        unmatched_expectations=sorted(unmatched),
+        unchecked_contexts=sorted(unchecked),
+        n_executables=len(facts),
+    )

@@ -37,8 +37,10 @@ __all__ = [
     "FileContext",
     "Finding",
     "LintError",
+    "lint_context",
     "lint_file",
     "lint_paths",
+    "project_findings",
 ]
 
 _SUPPRESS_RE = re.compile(
@@ -560,13 +562,8 @@ def _engine_findings(ctx: FileContext) -> list[Finding]:
     return out
 
 
-def lint_file(
-    root: pathlib.Path,
-    path: pathlib.Path,
-    rules: Iterable[Any],
-) -> list[Finding]:
-    """All non-suppressed findings for one file."""
-    ctx = FileContext(root, path)
+def lint_context(ctx: FileContext, rules: Iterable[Any]) -> list[Finding]:
+    """All non-suppressed per-file findings for one parsed context."""
     findings = _engine_findings(ctx)
     for rule in rules:
         for f in rule.check(ctx):
@@ -574,6 +571,39 @@ def lint_file(
                 findings.append(f)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
+
+
+def project_findings(
+    contexts: Iterable[FileContext], rules: Iterable[Any]
+) -> list[Finding]:
+    """Findings from rules with a cross-file ``check_project`` pass
+    (e.g. D9D007's process-wide tracked_jit name uniqueness). Inline
+    suppressions apply exactly as for per-file findings."""
+    contexts = list(contexts)
+    by_path = {ctx.path: ctx for ctx in contexts}
+    findings: list[Finding] = []
+    for rule in rules:
+        check_project = getattr(rule, "check_project", None)
+        if check_project is None:
+            continue
+        for f in check_project(contexts):
+            ctx = by_path.get(f.path)
+            if ctx is None or not ctx.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(
+    root: pathlib.Path,
+    path: pathlib.Path,
+    rules: Iterable[Any],
+) -> list[Finding]:
+    """All non-suppressed findings for one file (project-level rules
+    run over this single file's context)."""
+    rules = list(rules)
+    ctx = FileContext(root, path)
+    return lint_context(ctx, rules) + project_findings([ctx], rules)
 
 
 def iter_python_files(
@@ -619,11 +649,24 @@ def lint_paths(
             on_error(err)
             continue
         live_targets.append(target)
+    contexts: list[FileContext] = []
     for path in iter_python_files(root, live_targets):
+        # rule checks can raise LintError too (e.g. D9D006's doc load):
+        # both parse and check failures route to on_error so one bad
+        # file reports without aborting the rest of the scan
         try:
-            findings.extend(lint_file(root, path, rules))
+            ctx = FileContext(root, path)
+            contexts.append(ctx)
+            findings.extend(lint_context(ctx, rules))
         except LintError as e:
             if on_error is None:
                 raise
             on_error(e)
+    # cross-file passes see every successfully parsed context at once
+    try:
+        findings.extend(project_findings(contexts, rules))
+    except LintError as e:
+        if on_error is None:
+            raise
+        on_error(e)
     return findings

@@ -8,6 +8,7 @@ from tools.lint.rules.d9d003_host_sync import HostSyncRule
 from tools.lint.rules.d9d004_uncommitted_init import UncommittedInitRule
 from tools.lint.rules.d9d005_nondeterminism import NondeterminismRule
 from tools.lint.rules.d9d006_telemetry_names import TelemetryNamesRule
+from tools.lint.rules.d9d007_tracked_names import TrackedNamesRule
 
 ALL_RULES = (
     BareJitRule,
@@ -16,6 +17,7 @@ ALL_RULES = (
     UncommittedInitRule,
     NondeterminismRule,
     TelemetryNamesRule,
+    TrackedNamesRule,
 )
 
 RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}

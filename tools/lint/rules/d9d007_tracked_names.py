@@ -1,0 +1,116 @@
+"""D9D007: ``tracked_jit`` executable names must be unique per process.
+
+Invariant: the ``name=`` handed to ``tracked_jit`` keys every signal
+the wrapper emits — ``compile/{name}`` spans, ``hbm/{name}/*`` gauges,
+the executable-inventory rows, and the d9d-audit expectation table.
+Two call sites sharing a name last-write-wins blend their ``hbm/*``
+gauges and make their audit facts indistinguishable. Historical bug:
+PR 12 found the PipelinedOptimizer building its per-stage update pairs
+under ONE shared name, so stages of different sizes silently blended
+their HBM claims — fixed by per-stage ``pp_opt/s{S}/...`` names; this
+rule rejects the class statically.
+
+What is compared: the literal name, or for f-strings the *template*
+(``pp_opt/s{}/sq_norm``) — two distinct call sites with the same
+template collide for every formatted value, which is exactly the
+blended-gauge bug. One call site invoked many times with different
+formatted values (the lazily-built per-stage factories) is a single
+site and never flagged. A literal and a template that only collide for
+specific runtime values are out of static reach (documented
+not-in-scope).
+
+Cross-file by construction (names are process-wide), so this is the
+engine's first ``check_project`` rule: it sees every parsed file at
+once and flags EVERY site of a duplicated name — suppress each
+deliberate share inline with a reason (e.g. split_update's grads
+program deliberately reusing ``train_step`` so MFU dashboards keep
+working).
+"""
+
+import ast
+from typing import Iterable, Iterator
+
+from tools.lint.engine import FileContext, Finding, canonical_matches
+
+_TRACKED = (".tracked_jit",)
+
+
+def _name_template(node: ast.expr) -> str | None:
+    """The static identity of a ``name=`` argument: literal strings as
+    themselves, f-strings as templates with ``{}`` placeholders; None
+    for anything the rule cannot see through (a variable, a call)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            else:
+                parts.append("{}")
+        return "".join(parts)
+    return None
+
+
+class TrackedNamesRule:
+    rule_id = "D9D007"
+    summary = "tracked_jit executable names must be unique per process"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        # per-file pass is empty: uniqueness is process-wide, so the
+        # real check runs once over every file (check_project)
+        return iter(())
+
+    @classmethod
+    def check_project(
+        cls, contexts: Iterable[FileContext]
+    ) -> Iterator[Finding]:
+        sites: dict[str, list[tuple[FileContext, ast.Call]]] = {}
+        for ctx in contexts:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not canonical_matches(
+                    ctx.resolve_call(node), _TRACKED
+                ):
+                    continue
+                name_arg = next(
+                    (
+                        kw.value
+                        for kw in node.keywords
+                        if kw.arg == "name"
+                    ),
+                    None,
+                )
+                if name_arg is None:
+                    continue
+                template = _name_template(name_arg)
+                if template is None:
+                    continue  # dynamic name: out of static reach
+                sites.setdefault(template, []).append((ctx, node))
+        for template in sorted(sites):
+            locs = sorted(
+                sites[template], key=lambda cn: (cn[0].path, cn[1].lineno)
+            )
+            if len(locs) < 2:
+                continue
+            where = ", ".join(
+                f"{c.path}:{n.lineno}" for c, n in locs
+            )
+            for ctx, node in locs:
+                yield Finding(
+                    rule=cls.rule_id,
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"tracked_jit name {template!r} is built at "
+                        f"{len(locs)} call sites ({where}): shared "
+                        "names last-write-wins blend their hbm/* "
+                        "gauges and audit facts (the PR 12 "
+                        "PipelinedOptimizer bug class) — give each "
+                        "site a distinct name, or suppress with a "
+                        "reason if the share is deliberate"
+                    ),
+                )

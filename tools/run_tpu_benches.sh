@@ -45,7 +45,14 @@ PROBE_TIMEOUT="${D9D_PROBE_TIMEOUT:-120}"
 run_leg() {  # run_leg <name> <outfile> <cmd...>
   local name="$1" outfile="$2"; shift 2
   echo "== $name"
-  timeout -k 30 "$LEG_TIMEOUT" "$@" | tee -a "$outfile"
+  # per-leg audit context label (telemetry/audit_capture.py): chip-leg
+  # facts land under tpu:<leg> instead of all blending into 'default',
+  # so AUDIT_BASELINE.json can grow chip-specific expectation rows (the
+  # committed censuses pin the CPU SPMD backend's op mix and must NOT
+  # gate chip HLO)
+  local ctx="tpu:${name//[^A-Za-z0-9_.-]/_}"
+  timeout -k 30 "$LEG_TIMEOUT" env "D9D_AUDIT_CONTEXT=$ctx" "$@" \
+    | tee -a "$outfile"
   local rc=${PIPESTATUS[0]}
   if [[ $rc -ne 0 ]]; then
     echo "{\"leg\": \"$name\", \"error\": \"rc=$rc (124=timeout)\"}" \
@@ -103,6 +110,15 @@ cat bench_results/tiny.json
 # --perfetto merges the logs into one timeline
 export D9D_TELEMETRY_DIR="${D9D_TELEMETRY_DIR:-bench_results/telemetry}"
 mkdir -p "$D9D_TELEMETRY_DIR"
+# compiled-artifact capture (telemetry/audit_capture.py): every tracked
+# executable's collective census / donation coverage / baked constants /
+# dtype census rides the executable JSONL events, so the queued TPU legs
+# also emit artifact reports. Compile-time only (no per-step cost), but
+# each compile additionally renders the full optimized-HLO text — on
+# production-size programs that is seconds of wall and a transient host
+# memory spike per executable, so the flag is overridable
+# (D9D_AUDIT_CAPTURE=0) for tunnel-minute-critical reruns.
+export D9D_AUDIT_CAPTURE="${D9D_AUDIT_CAPTURE:-1}"
 
 # leg order = value-per-tunnel-minute: the default leg carries the whole
 # BENCH_r04 headline (dense+MoE+hybrid in one process), then the MoE
@@ -447,11 +463,16 @@ echo "== perf-regression compare vs BENCH_BASELINE.json (report-only)"
 python tools/bench_compare.py --from-bench-jsonl bench_results/bench.jsonl \
   | tee bench_results/bench_compare.txt || true
 
-echo "== telemetry introspection summary (compile/HBM inventory)"
+echo "== telemetry introspection summary (compile/HBM inventory + audit)"
 if compgen -G "$D9D_TELEMETRY_DIR/*.jsonl" > /dev/null; then
-  python tools/trace_summary.py "$D9D_TELEMETRY_DIR" \
+  python tools/trace_summary.py "$D9D_TELEMETRY_DIR" --audit \
     --perfetto bench_results/perfetto_trace.json \
     | tee bench_results/introspection_summary.txt || true
+  # compiled-artifact contract report for the chip legs (report-only,
+  # like the bench_compare chip summary: a violated contract must still
+  # finish the capture; the tier-1 gate is the enforcing run)
+  python tools/audit/cli.py --facts "$D9D_TELEMETRY_DIR"/*.jsonl \
+    | tee bench_results/audit_report.txt || true
 fi
 
 echo "== schedule-economics makespan sim (device-free, for the record)"

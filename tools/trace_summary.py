@@ -215,16 +215,73 @@ def print_numerics(numerics_events, *, top: int) -> None:
         print(f"first non-finite: {fn.get('site')}:{fn.get('name')}")
 
 
+def print_audit(executables, *, top: int) -> None:
+    """The --audit table: per-executable compiled-artifact facts
+    (telemetry/audit_capture.py ``audit`` blocks on executable events)
+    — collective counts, donated vs aliased buffers, largest baked
+    constant, dtype census — next to the inventory table."""
+    audited = [
+        (path, ev) for path, ev in executables if ev.get("audit")
+    ]
+    if not audited:
+        print(
+            "\nno audit facts in the logs (the producing run must "
+            "export D9D_AUDIT_CAPTURE=1 so compile-time artifact "
+            "capture is on)"
+        )
+        return
+    print(
+        f"\ncompiled-artifact audit facts ({len(audited)} captured "
+        "executable(s)):"
+    )
+    print(
+        f"{'collectives':>24}  {'donated':>8}  {'aliased':>8}  "
+        f"{'max_const':>10}  {'f64':>3}  {'f32mm':>5}  {'cb':>2}  "
+        "dtypes  ctx:name"
+    )
+    shown = audited[: top * 2]
+    for _path, ev in shown:
+        a = ev["audit"]
+        coll = a.get("collectives", {})
+        coll_s = (
+            ",".join(f"{k.replace('collective-', 'c-')}:{v}"
+                     for k, v in sorted(coll.items()))
+            if coll else "-"
+        )
+        consts = a.get("consts", [])
+        max_const = _fmt_bytes(consts[0]["bytes"]) if consts else "-"
+        dtypes = ",".join(
+            f"{k.replace('float', 'f').replace('bfloat', 'bf')}:{v}"
+            for k, v in sorted(a.get("dtype_ops", {}).items())
+        )
+        print(
+            f"{coll_s:>24}  {a.get('donated_declared', 0):>8}  "
+            f"{a.get('aliased_pairs', 0):>8}  {max_const:>10}  "
+            f"{len(a.get('f64_ops', [])):>3}  "
+            f"{a.get('f32_matmuls', 0):>5}  "
+            f"{len(a.get('callbacks', [])):>2}  "
+            f"{dtypes}  {a.get('context', '?')}:{ev['name']}"
+        )
+    if len(audited) > len(shown):
+        print(f"(+{len(audited) - len(shown)} more — raise --top)")
+    print(
+        "audit these facts against AUDIT_BASELINE.json with "
+        "`d9d-audit --facts <jsonl...>`"
+    )
+
+
 def summarize_telemetry(
-    files, *, top: int, perfetto=None, trace_id=None, numerics=False
+    files, *, top: int, perfetto=None, trace_id=None, numerics=False,
+    audit=False,
 ) -> None:
     """Telemetry-mode report: span aggregate, per-executable inventory,
     per-request trace summary (schema v3 ``request_trace``), final flush
     counters; optional merged Perfetto export. ``trace_id`` filters the
     request-trace section to one request's full milestone sequence;
     ``numerics`` prints the per-layer table of the last numerics window
-    (schema v4). Reads leniently — a crashed process's truncated log
-    must still report."""
+    (schema v4); ``audit`` prints the compiled-artifact facts table
+    (audit blocks on executable events). Reads leniently — a crashed
+    process's truncated log must still report."""
     from d9d_tpu.telemetry.trace_export import _read_events_lenient
 
     spans = collections.defaultdict(lambda: [0.0, 0])  # name → [Σs, n]
@@ -311,6 +368,8 @@ def summarize_telemetry(
             f"{len(executables)} executables, {recompiles} recompile(s) "
             "(R rows)"
         )
+    if audit:
+        print_audit(executables, top=top)
 
     # per-replica serve rollup (the serve/{label}/* namespacing — the
     # fleet assigns r{i}, embedders may use any path-free label):
@@ -379,6 +438,13 @@ def main():
         help="telemetry mode: print the per-layer numerics table of the "
         "last window (schema v4, worst offenders first)",
     )
+    ap.add_argument(
+        "--audit", action="store_true",
+        help="telemetry mode: print the compiled-artifact audit facts "
+        "table (collective counts, donation coverage, baked constants, "
+        "dtype census) from executable events captured under "
+        "D9D_AUDIT_CAPTURE=1",
+    )
     args = ap.parse_args()
 
     telemetry_files = collect_telemetry_files(args.logdir)
@@ -386,6 +452,7 @@ def main():
         summarize_telemetry(
             telemetry_files, top=args.top, perfetto=args.perfetto,
             trace_id=args.trace_id, numerics=args.numerics,
+            audit=args.audit,
         )
         return
     if args.perfetto:
@@ -398,6 +465,12 @@ def main():
             "--numerics needs telemetry JSONL inputs (schema-v4 "
             "numerics events from a TrainerConfig.numerics_every_steps "
             "run); none found among the given paths"
+        )
+    if args.audit:
+        raise SystemExit(
+            "--audit needs telemetry JSONL inputs (executable events "
+            "with audit blocks from a D9D_AUDIT_CAPTURE=1 run); none "
+            "found among the given paths"
         )
     if len(args.logdir) != 1:
         raise SystemExit("profiler mode takes exactly one logdir")
