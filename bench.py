@@ -818,6 +818,52 @@ def run_bench_serving(*, tiny: bool = False) -> dict:
     }
 
 
+def run_bench_pp_fused() -> dict:
+    """Fused-PP dispatch tax row (ISSUE 16): the tiny 1F1B schedule
+    through the legacy per-action interpreter vs the compiled-run
+    executor, counting real executable dispatches at the one point both
+    runtimes share — ``TrackedJit.__call__``.
+
+    Both counts are structural (what the host enqueues per step), not
+    wall-clock, so the row is exactly reproducible on any backend; the
+    same leg is pinned by tools/bench_compare.py's ``pp_micro.*`` gate
+    on CPU. What running it HERE adds is the chip-side proof that the
+    fused programs compile and execute on the real backend.
+    """
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.bench_compare import PP_MICRO, run_pp_micro
+
+    m = run_pp_micro()
+    return {
+        "metric": "pp/dispatches_per_step",
+        "value": m["pp_micro.dispatches_per_step"],
+        "unit": "dispatches",
+        "vs_baseline": 1.0,  # first recorded fused-PP row
+        "detail": {
+            "pp/fused_programs": m["pp_micro.fused_programs"],
+            "legacy_dispatches_per_step":
+                m["pp_micro.legacy_dispatches_per_step"],
+            "dispatch_reduction_x": m["pp_micro.dispatch_reduction_x"],
+            "exact_vs_legacy": m["pp_micro.exact_vs_legacy"],
+            "multirank_dispatches_per_step":
+                m["pp_micro.multirank_dispatches_per_step"],
+            "multirank_fused_programs":
+                m["pp_micro.multirank_fused_programs"],
+            "multirank_dispatch_reduction_x":
+                m["pp_micro.multirank_dispatch_reduction_x"],
+            "multirank_exact_vs_legacy":
+                m["pp_micro.multirank_exact_vs_legacy"],
+            "num_microbatches": PP_MICRO["num_microbatches"],
+            "stages_per_rank": PP_MICRO["stages_per_rank"],
+            "multirank_pp": PP_MICRO["multirank_pp"],
+            "device": __import__("jax").devices()[0].device_kind,
+        },
+    }
+
+
 # rows finished before a watchdog fire; the watchdog folds them into its
 # error line so a wedge mid-MoE still delivers the dense number
 _partial_results: dict = {}
@@ -923,6 +969,21 @@ def main():
             **srv["detail"],
         }
         _partial_results["serving"] = out["detail"]["serving"]
+    # fused-PP dispatch row (ISSUE 16: the single-controller dispatch
+    # tax) — structural counts, cheap even on the tunnel
+    try:
+        pp = run_bench_pp_fused()
+    except Exception as e:  # noqa: BLE001 — any chip-side failure
+        out["detail"]["pp_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    else:
+        out["detail"]["pp"] = {
+            "metric": pp["metric"],
+            "value": pp["value"],
+            "unit": pp["unit"],
+            "vs_baseline": pp["vs_baseline"],
+            **pp["detail"],
+        }
+        _partial_results["pp"] = out["detail"]["pp"]
     print(json.dumps(out))
 
 

@@ -29,6 +29,7 @@ from d9d_tpu.loop.control.providers import ModelProvider
 from d9d_tpu.loop.control.task import PipelineTrainTask
 from d9d_tpu.loop.model_factory import init_sharded_from_fn
 from d9d_tpu.pipelining import (
+    FusedPipelineExecutor,
     PipelineScheduleExecutor,
     PipelineStageInfo,
     PipelineStageRuntime,
@@ -260,13 +261,28 @@ class PipelineTrainEngine:
             num_stages=self.num_stages,
             stage_owner=self.stage_owner,
         )
-        self.executor = PipelineScheduleExecutor(
-            stages=self.stages,
-            program=program,
-            stage_owner=self.stage_owner,
-            num_microbatches=self.num_microbatches,
-            train=True,
-        )
+        # "fused" (default): the compiled-run executor — a handful of
+        # device-resident programs per step. "legacy" keeps the
+        # per-action interpreter as the bit-exact parity oracle for one
+        # release (runtime/fused.py documents the contract).
+        self._runtime = getattr(schedule, "runtime", "fused")
+        if self._runtime == "fused":
+            self.executor = FusedPipelineExecutor(
+                stages=self.stages,
+                program=program,
+                stage_owner=self.stage_owner,
+                num_microbatches=self.num_microbatches,
+                train=True,
+                numerics=numerics,
+            )
+        else:
+            self.executor = PipelineScheduleExecutor(
+                stages=self.stages,
+                program=program,
+                stage_owner=self.stage_owner,
+                num_microbatches=self.num_microbatches,
+                train=True,
+            )
         self._eval_executor = None
         self.anomaly_policy = anomaly_policy
         from d9d_tpu.core.mesh import AXIS_DP_REPLICATE
@@ -338,7 +354,12 @@ class PipelineTrainEngine:
                 num_stages=self.num_stages,
                 stage_owner=self.stage_owner,
             )
-            self._eval_executor = PipelineScheduleExecutor(
+            executor_cls = (
+                FusedPipelineExecutor
+                if self._runtime == "fused"
+                else PipelineScheduleExecutor
+            )
+            self._eval_executor = executor_cls(
                 stages=self.stages,
                 program=program,
                 stage_owner=self.stage_owner,
@@ -358,16 +379,38 @@ class PipelineTrainEngine:
         flat vectors into the metric dict as ``numerics/s{S}`` —
         off-cadence steps add zero dispatches to the controller loop.
         """
-        result = self.executor.step(microbatches)
+        if self._runtime == "fused" and self.numerics:
+            # the stats assembly is traced INTO each rank's last fused
+            # program behind a cond flag, so the program signature is
+            # fixed: the second-moment trees ride along every step (a
+            # host-side tree selection, no dispatch), and off-cadence
+            # steps compute a NaN fill instead of the stats
+            from d9d_tpu.telemetry.numerics import find_second_moments
+
+            moments = {
+                s: find_second_moments(self.opt_states[s], rt.params)
+                for s, rt in self.stages.items()
+            }
+            result = self.executor.step(
+                microbatches,
+                numerics_on=numerics,
+                numerics_moments=moments,
+            )
+        else:
+            result = self.executor.step(microbatches)
         params = {s: rt.params for s, rt in self.stages.items()}
         numerics_metrics = {}
         if numerics and self.numerics:
-            for s in sorted(params):
-                numerics_metrics[f"numerics/s{s}"] = (
-                    self.optimizer.stage_numerics(
-                        s, params[s], result.grads[s], self.opt_states[s]
+            if self._runtime == "fused":
+                for s in sorted(result.numerics):
+                    numerics_metrics[f"numerics/s{s}"] = result.numerics[s]
+            else:
+                for s in sorted(params):
+                    numerics_metrics[f"numerics/s{s}"] = (
+                        self.optimizer.stage_numerics(
+                            s, params[s], result.grads[s], self.opt_states[s]
+                        )
                     )
-                )
         guard_metrics = {}
         if self.anomaly_policy is not None:
             (new_params, self.opt_states, grad_norm, guard_metrics,
@@ -448,6 +491,7 @@ class PipelineInferenceEngine:
         init_rng: jax.Array,
         stages_per_rank: int = 1,
         stage_params: dict[int, PyTree] | None = None,
+        runtime: str = "fused",
     ):
         from d9d_tpu.pipelining.program import InferenceProgramBuilder
 
@@ -471,7 +515,12 @@ class PipelineInferenceEngine:
             num_stages=self.num_stages,
             stage_owner=self.stage_owner,
         )
-        self.executor = PipelineScheduleExecutor(
+        executor_cls = (
+            FusedPipelineExecutor
+            if runtime == "fused"
+            else PipelineScheduleExecutor
+        )
+        self.executor = executor_cls(
             stages=self.stages,
             program=program,
             stage_owner=self.stage_owner,

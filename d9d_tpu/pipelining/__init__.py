@@ -10,6 +10,7 @@ from d9d_tpu.pipelining.factory import (
     build_program_builder,
 )
 from d9d_tpu.pipelining.runtime import (
+    FusedPipelineExecutor,
     PipelineExecutionResult,
     PipelineScheduleExecutor,
     PipelineStageRuntime,
@@ -22,6 +23,7 @@ from d9d_tpu.pipelining.stage_info import (
 
 __all__ = [
     "DualPipeVScheduleConfig",
+    "FusedPipelineExecutor",
     "GPipeScheduleConfig",
     "Interleaved1F1BScheduleConfig",
     "InferenceScheduleConfig",

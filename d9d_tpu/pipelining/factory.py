@@ -33,23 +33,35 @@ __all__ = [
 ]
 
 
-class GPipeScheduleConfig(pydantic.BaseModel):
+class _RuntimeChoice(pydantic.BaseModel):
+    """Executor selection, shared by every schedule config.
+
+    "fused" is the compiled-run MPMD executor (runtime/fused.py): a few
+    device-resident programs per step. "legacy" keeps the per-action
+    interpreter (runtime/executor.py) — the bit-exact parity oracle,
+    scheduled for removal one release after the fused default landed.
+    """
+
+    runtime: Literal["fused", "legacy"] = "fused"
+
+
+class GPipeScheduleConfig(_RuntimeChoice):
     kind: Literal["gpipe"] = "gpipe"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
 
 
-class InferenceScheduleConfig(pydantic.BaseModel):
+class InferenceScheduleConfig(_RuntimeChoice):
     kind: Literal["inference"] = "inference"
     stages_per_rank: int = 1
 
 
-class LoopedBFSScheduleConfig(pydantic.BaseModel):
+class LoopedBFSScheduleConfig(_RuntimeChoice):
     kind: Literal["looped_bfs"] = "looped_bfs"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
     stages_per_rank: int = 1
 
 
-class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
+class Interleaved1F1BScheduleConfig(_RuntimeChoice):
     kind: Literal["interleaved_1f1b"] = "interleaved_1f1b"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "remat"
     stages_per_rank: int = 1
@@ -69,18 +81,18 @@ class Interleaved1F1BScheduleConfig(pydantic.BaseModel):
 # the I and W jits is measured on chip (queued in run_tpu_benches.sh).
 
 
-class ZeroBubble1PScheduleConfig(pydantic.BaseModel):
+class ZeroBubble1PScheduleConfig(_RuntimeChoice):
     kind: Literal["zero_bubble_1p"] = "zero_bubble_1p"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
     stages_per_rank: int = 1
 
 
-class ZeroBubbleVScheduleConfig(pydantic.BaseModel):
+class ZeroBubbleVScheduleConfig(_RuntimeChoice):
     kind: Literal["zero_bubble_v"] = "zero_bubble_v"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
 
 
-class DualPipeVScheduleConfig(pydantic.BaseModel):
+class DualPipeVScheduleConfig(_RuntimeChoice):
     kind: Literal["dual_pipe_v"] = "dual_pipe_v"
     residual_policy: Literal["remat", "cache_full", "cache_acts"] = "cache_full"
 

@@ -2,9 +2,11 @@ from d9d_tpu.pipelining.runtime.executor import (
     PipelineExecutionResult,
     PipelineScheduleExecutor,
 )
+from d9d_tpu.pipelining.runtime.fused import FusedPipelineExecutor
 from d9d_tpu.pipelining.runtime.stage import PipelineStageRuntime, StageTask
 
 __all__ = [
+    "FusedPipelineExecutor",
     "PipelineExecutionResult",
     "PipelineScheduleExecutor",
     "PipelineStageRuntime",

@@ -6,8 +6,9 @@ This is the standing certification harness the tier-1 gate
 every executable shape the repo dispatches in production is compiled
 here once, at tiny config, with artifact capture on — non-PP train
 step, ZeRO dp_replicate>1 train step, the serving fused-K and legacy
-step paths, the speculative-decode round, and the PipelinedOptimizer
-per-stage update programs. Each leg runs under its own capture context
+step paths, the speculative-decode round, the PipelinedOptimizer
+per-stage update programs, and the fused MPMD pipeline runs
+(``pp_fused/r{R}/run{K}``). Each leg runs under its own capture context
 so the manifest can pre-register per-configuration contracts (the same
 ``train_step`` name carries "no collectives" plain and the exact
 reduce-scatter/all-gather schedule under ZeRO).
@@ -255,12 +256,96 @@ def leg_pp_opt() -> None:
     jax.block_until_ready(guard)
 
 
+def leg_pp_fused() -> None:
+    """The fused MPMD pipeline runtime (pipelining/runtime/fused.py):
+    every compiled run (``pp_fused/r{R}/run{K}``) certified for the
+    zero-collective contract and donation coverage. Two partitions:
+    the tiny single-program 1F1B config (the bench.py / bench_compare
+    acceptance row) and the zero-bubble cache_acts pp=2 schedule,
+    whose dI/dW split plus cross-rank run boundaries produce the
+    richest run structure the partitioner emits."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from d9d_tpu.pipelining import (
+        FusedPipelineExecutor,
+        PipelineStageInfo,
+        PipelineStageRuntime,
+    )
+    from d9d_tpu.pipelining.program import add_communication_ops
+    from d9d_tpu.pipelining.program.builders import (
+        Interleaved1F1BProgramBuilder,
+    )
+
+    hid = 8
+
+    class _Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return jnp.tanh(nn.Dense(hid, use_bias=True)(x))
+
+    class _Task:
+        def split_microbatch(self, micro):
+            return micro["x"], {}, {"y": micro["y"], "w": micro["w"]}
+
+        def stage_forward(self, module, params, carry, kwargs):
+            return module.apply(params, carry)
+
+        def last_stage_loss(self, module, params, carry, kwargs, state):
+            out = module.apply(params, carry)
+            err = ((out - state["y"]) ** 2).sum(-1)
+            return (err * state["w"]).sum(), state["w"].sum(), {}
+
+    def run(builder, m, residual_policy):
+        key = jax.random.PRNGKey(0)
+        stages = {}
+        for s in range(builder.num_stages):
+            key, sub = jax.random.split(key)
+            module = _Stage()
+            stages[s] = PipelineStageRuntime(
+                info=PipelineStageInfo(
+                    stage_index=s, num_stages=builder.num_stages
+                ),
+                module=module,
+                params=module.init(sub, jnp.zeros((1, hid))),
+                task=_Task(),
+                residual_policy=residual_policy,
+            )
+        program = add_communication_ops(
+            builder.compose(m), num_stages=builder.num_stages,
+            stage_owner=builder.stage_owner,
+        )
+        ex = FusedPipelineExecutor(
+            stages=stages, program=program,
+            stage_owner=builder.stage_owner, num_microbatches=m,
+        )
+        mb_key = jax.random.PRNGKey(1)
+        mbs = []
+        for _ in range(m):
+            mb_key, k1, k2 = jax.random.split(mb_key, 3)
+            mbs.append({
+                "x": jax.random.normal(k1, (4, hid)),
+                "y": jax.random.normal(k2, (4, hid)),
+                "w": jnp.ones((4,)),
+            })
+        res = ex.step(list(mbs))
+        jax.block_until_ready(res.loss_sum)
+
+    run(Interleaved1F1BProgramBuilder(1, 2), 4, "remat")
+    run(
+        Interleaved1F1BProgramBuilder(2, zero_bubble=True), 4,
+        "cache_acts",
+    )
+
+
 LEGS: dict[str, Callable[[], None]] = {
     "train": leg_train,
     "train_zero": leg_train_zero,
     "serve": leg_serve,
     "spec_decode": leg_spec_decode,
     "pp_opt": leg_pp_opt,
+    "pp_fused": leg_pp_fused,
 }
 
 

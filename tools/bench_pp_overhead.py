@@ -14,6 +14,14 @@ jitted add per microbatch for the loss statistics — and reports
 steady-state step time for both. Device compute is identical (same
 jitted stage executables), so the delta isolates host dispatch cost.
 
+The fused MPMD rewrite made the whole ladder three rungs: a third
+``fused`` row runs the same schedule through the compiled-run executor
+(``runtime/fused.py``, its own engine — the naive VM shares the legacy
+executor's internals, so the legacy pair pins ``runtime="legacy"``),
+and the summary adds ``precompiled_over_fused`` /
+``dispatch_tax_removed_pct`` — the tax the schedule compiler removes
+on top of the per-action mitigations.
+
 Smoke on CPU mesh:  JAX_PLATFORMS=cpu python tools/bench_pp_overhead.py --tiny
 CPU rig number:     python tools/bench_pp_overhead.py --cpu
 TPU chip:           python tools/bench_pp_overhead.py
@@ -196,11 +204,17 @@ def main():
         dtype = jnp.bfloat16
 
     batch = microbatch * args.microbatches
+    # the naive VM shares the LEGACY executor's internals (handlers +
+    # _StepState), so this engine must pin runtime="legacy"; the fused
+    # compiled-run engine is measured as its own third row below
     if args.schedule == "1f1b":
-        schedule_cfg = Interleaved1F1BScheduleConfig(stages_per_rank=2)
+        schedule_cfg = Interleaved1F1BScheduleConfig(
+            stages_per_rank=2, runtime="legacy"
+        )
     elif args.schedule == "zb1p":
         schedule_cfg = ZeroBubble1PScheduleConfig(
-            stages_per_rank=2, residual_policy="cache_full"
+            stages_per_rank=2, residual_policy="cache_full",
+            runtime="legacy",
         )
     else:
         raise SystemExit(f"unknown --schedule {args.schedule!r}")
@@ -208,10 +222,17 @@ def main():
         schedule_cfg, cfg=cfg, seq_len=seq_len, batch=batch,
         microbatch=microbatch, dtype=dtype,
     )
+    fused_engine = build_engine(
+        schedule_cfg.model_copy(update={"runtime": "fused"}),
+        cfg=cfg, seq_len=seq_len, batch=batch,
+        microbatch=microbatch, dtype=dtype,
+    )
 
-    executors = {
-        "precompiled": engine.executor,
-        "naive": build_naive(engine.executor),
+    # label -> (engine that owns the params, executor to install)
+    legs = {
+        "precompiled": (engine, engine.executor),
+        "naive": (engine, build_naive(engine.executor)),
+        "fused": (fused_engine, fused_engine.executor),
     }
     rows = {}
     # two passes per executor, first discarded: the first measured pass
@@ -219,20 +240,21 @@ def main():
     # tiny config showed the first round inflated ~2x for both sides);
     # only the warm second pass is recorded
     for recorded in (False, True):
-        for label, executor in executors.items():
-            engine.executor = executor
+        for label, (eng, executor) in legs.items():
+            eng.executor = executor
             s = measure(
-                engine, batch=batch, microbatch=microbatch,
+                eng, batch=batch, microbatch=microbatch,
                 seq_len=seq_len, vocab=cfg.vocab_size, warmup=warmup,
                 steps=steps,
             )
             if recorded:
                 rows[label] = s
-                print(json.dumps(
-                    {"executor": label, "step_s": round(s, 4),
-                     "schedule": args.schedule,
-                     "microbatches": args.microbatches}
-                ), flush=True)
+                row = {"executor": label, "step_s": round(s, 4),
+                       "schedule": args.schedule,
+                       "microbatches": args.microbatches}
+                if label == "fused":
+                    row["fused_programs"] = executor.num_fused_programs
+                print(json.dumps(row), flush=True)
 
     print(json.dumps({"summary": {
         "naive_over_precompiled": round(
@@ -240,6 +262,13 @@ def main():
         ),
         "overhead_removed_pct": round(
             100.0 * (rows["naive"] - rows["precompiled"]) / rows["naive"], 2
+        ),
+        "precompiled_over_fused": round(
+            rows["precompiled"] / rows["fused"], 4
+        ),
+        "dispatch_tax_removed_pct": round(
+            100.0 * (rows["precompiled"] - rows["fused"])
+            / rows["precompiled"], 2
         ),
     }}), flush=True)
 

@@ -41,6 +41,10 @@ HOT_SYNC_SCOPES: tuple[tuple[str, str], ...] = (
     # PP per-microbatch executor: the single-controller dispatch loop
     ("d9d_tpu/pipelining/runtime/executor.py",
      r"PipelineScheduleExecutor\.(step|_act_.*|_put|_stage_kwargs)"),
+    # fused MPMD runtime: the whole dispatch loop is a handful of
+    # compiled runs — any host sync between them stalls every rank
+    ("d9d_tpu/pipelining/runtime/fused.py",
+     r"FusedPipelineExecutor\.(step|_stage_ext|_mesh_scope)"),
     # PP stage runtime: per-action jit surfaces
     ("d9d_tpu/pipelining/runtime/stage.py", r"PipelineStageRuntime\..*"),
     # PP optimizer step path (scalar hops must stay in XLA's stream)
@@ -82,6 +86,30 @@ ARRAY_PRODUCER_PREFIXES: tuple[str, ...] = (
     "jax.numpy.",
     "jax.random.",
     "jax.device_put",
+)
+
+# -- D9D008: per-action stage dispatch in the pipeline runtime ----------
+# Path prefixes under the fused-runtime dispatch discipline: host code
+# here must not call the PipelineStageRuntime per-action jit wrappers
+# (one TrackedJit dispatch per schedule action — the single-controller
+# tax runtime/fused.py removed); fused runs trace the raw ``_*_impl``
+# bodies under one jit instead. The legacy interpreter's call sites
+# carry inline suppressions naming the parity-oracle debt.
+PER_ACTION_DISPATCH_PATHS: tuple[str, ...] = (
+    "d9d_tpu/pipelining/runtime/",
+)
+# the per-action jit surfaces of PipelineStageRuntime (stage.py)
+PER_ACTION_DISPATCH_ATTRS: tuple[str, ...] = (
+    "forward",
+    "forward_loss",
+    "forward_out",
+    "backward_full",
+    "backward_input",
+    "backward_weight",
+    "backward_input_acts",
+    "backward_weight_acts",
+    "accumulate",
+    "cast_grads",
 )
 
 # -- D9D004: state init under jit ---------------------------------------

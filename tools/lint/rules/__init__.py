@@ -9,6 +9,9 @@ from tools.lint.rules.d9d004_uncommitted_init import UncommittedInitRule
 from tools.lint.rules.d9d005_nondeterminism import NondeterminismRule
 from tools.lint.rules.d9d006_telemetry_names import TelemetryNamesRule
 from tools.lint.rules.d9d007_tracked_names import TrackedNamesRule
+from tools.lint.rules.d9d008_per_action_dispatch import (
+    PerActionDispatchRule,
+)
 
 ALL_RULES = (
     BareJitRule,
@@ -18,6 +21,7 @@ ALL_RULES = (
     NondeterminismRule,
     TelemetryNamesRule,
     TrackedNamesRule,
+    PerActionDispatchRule,
 )
 
 RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}

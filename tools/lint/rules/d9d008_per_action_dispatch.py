@@ -1,0 +1,67 @@
+"""D9D008: per-action stage dispatch in the pipeline runtime.
+
+Invariant (the fused-MPMD rewrite): the pipeline runtime dispatches a
+handful of fused compiled runs per step — not one TrackedJit program
+per schedule action. Calling a ``PipelineStageRuntime`` per-action jit
+wrapper (``.forward``, ``.backward_full``, ``.accumulate``, …) from
+host code under ``d9d_tpu/pipelining/runtime/`` reintroduces the
+single-controller dispatch tax that rewrite removed: every such call
+is one host→device dispatch per schedule action, and at real
+microbatch counts the host falls behind the chip (39 dispatches/step
+vs 1 at the tiny 1F1B config — BENCH_BASELINE.json's ``pp_micro.*``
+rows pin the gap). Fused runs trace the raw ``_*_impl`` bodies under
+ONE jit instead (runtime/fused.py ``_trace_op``); the legacy
+interpreter's call sites carry inline suppressions naming the
+parity-oracle debt until that path is deleted.
+
+The heuristic is attribute-name-based (no type inference): inside the
+registered paths, *any* ``x.forward(...)``-class call is treated as a
+stage dispatch. That is the point — the runtime package is exactly the
+surface where those names mean the per-action jit wrappers, and a new
+helper that wants one must say why out loud in a suppression.
+"""
+
+import ast
+from typing import Iterator
+
+from tools.lint import config
+from tools.lint.engine import FileContext, Finding
+
+
+class PerActionDispatchRule:
+    rule_id = "D9D008"
+    summary = "per-action stage dispatch in the pipeline runtime"
+
+    @classmethod
+    def check(cls, ctx: FileContext) -> Iterator[Finding]:
+        if not any(
+            ctx.path.startswith(p)
+            for p in config.PER_ACTION_DISPATCH_PATHS
+        ):
+            return
+        for info in ctx.functions:
+            for node in ctx.walk_scope(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                attr = node.func.attr
+                if attr not in config.PER_ACTION_DISPATCH_ATTRS:
+                    continue
+                yield Finding(
+                    rule=cls.rule_id,
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"per-action stage dispatch in "
+                        f"{info.qualname!r}: .{attr}() is one TrackedJit "
+                        "dispatch per schedule action — the "
+                        "single-controller tax the fused runtime "
+                        "removed. Trace the raw _*_impl body into a "
+                        "fused run instead (runtime/fused.py), or "
+                        "suppress with the reason this host-side "
+                        "dispatch must exist"
+                    ),
+                )

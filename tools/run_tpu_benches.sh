@@ -439,9 +439,18 @@ run_leg "kernel latency harness" bench_results/kernels.jsonl \
 run_leg "pipeline schedule microbench" bench_results/pp.jsonl \
   python tools/bench_pp.py
 
+# fused-PP dispatch ladder: naive VM -> mitigated per-action interpreter
+# -> fused compiled-run executor (runtime/fused.py), plain 1F1B and the
+# zero-bubble schedule (the dI/dW split produces the richest fused-run
+# partition). Each leg's D9D_AUDIT_CAPTURE facts carry the on-chip
+# pp_fused/r{R}/run{K} collective census + donation coverage into the
+# audit report below.
 : > bench_results/pp_overhead.jsonl
-run_leg "executor dispatch-overhead A/B (precompiled vs naive)" \
+run_leg "pp dispatch ladder 1f1b (naive vs precompiled vs fused)" \
   bench_results/pp_overhead.jsonl python tools/bench_pp_overhead.py
+run_leg "pp dispatch ladder zb1p (naive vs precompiled vs fused)" \
+  bench_results/pp_overhead.jsonl \
+  python tools/bench_pp_overhead.py --schedule zb1p
 
 echo "== monitoring-plane overhead leg (exporter-enabled microbench + scrape)"
 # the 2% exporter budget, measured ON CHIP: the exporter-enabled leg
