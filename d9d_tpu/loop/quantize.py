@@ -1,0 +1,136 @@
+"""Int8 weight stream for serving (docs/design/generation.md
+"Low-precision serving").
+
+Decode is HBM-bandwidth-bound: roofline attributes most of a decode
+step to streaming the parameters, so halving (vs bf16; quartering vs
+f32) the bytes of the big matmul kernels is the largest single-chip
+serving lever after the paged KV pool. The shape of the pass follows
+from the install contract (loop/serve.py): weights are TRACED
+arguments of the decode executables, so a quantized tree must be a
+pytree ``ContinuousBatcher.install_weights`` accepts unchanged —
+:func:`quantize_for_serving` replaces each eligible 2-D kernel leaf
+with a sub-dict
+
+    {"qvalue": int8 [in, out], "scale": <orig dtype> [out]}
+
+(symmetric per-out-channel absmax/127 — each output channel's scale
+rides in the layout the dequantizing matmul consumes, the
+block-native-scales argument), and :func:`dequantize_params` — called
+INSIDE the traced ``_model_step`` — widens ``qvalue * scale`` back so
+XLA streams int8 from HBM and fuses the rescale into the matmul's
+operand read. Installing a quantized tree changes the executable's
+dtype signature exactly once (tree structure + dtypes differ from the
+bf16 tree), then every later quantized publish reuses the compiled
+program: zero steady-state recompiles, the same contract as any other
+publish.
+
+What stays wide, and why: embeddings and the logits head set output
+quality disproportionately (and the head's matmul feeds sampling
+directly); norms/biases/scalars are bandwidth-trivial. Eligibility is
+therefore *2-D leaves named ``kernel``* outside embed/head scopes —
+everything else passes through untouched.
+
+Cold-path module: runs once per publish on the host/donor side, never
+inside a hot loop — deliberately no jit here (D9D001 keeps
+``d9d_tpu/loop/`` free of untracked jits; tracing happens where the
+consuming executable already traces).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+__all__ = [
+    "dequantize_params",
+    "is_quantized_tree",
+    "quantize_for_serving",
+]
+
+QVALUE_LEAF = "qvalue"
+SCALE_LEAF = "scale"
+
+# module scopes whose kernels stay wide: token embedding (often tied),
+# and the logits head — the two ends of the network where quantization
+# error lands directly on the sampled distribution
+_SKIP_SCOPES = ("embed", "lm_head", "logits", "head")
+
+
+def _eligible(path: tuple, leaf) -> bool:
+    if path[-1] != "kernel" or getattr(leaf, "ndim", 0) != 2:
+        return False
+    return not any(
+        any(tok in part for tok in _SKIP_SCOPES)
+        for part in path[:-1]
+    )
+
+
+def quantize_for_serving(params, *, skip: Optional[tuple] = None):
+    """Per-out-channel symmetric int8 quantization of the big matmul
+    kernels of a served tree; returns a NEW tree (input untouched) in
+    which each eligible leaf became ``{"qvalue", "scale"}``.
+
+    ``skip``: extra scope-name substrings to leave wide (on top of the
+    embed/head defaults). Zero columns quantize to scale 0 — dequant
+    reproduces the exact zeros, no epsilon drift.
+
+    Partitioning boxes (``LogicallyPartitioned``) are unboxed first:
+    the quantized tree's structure differs from the training tree
+    anyway (that's the one-time recompile), and serving placement
+    comes from the actual device buffers, not the logical axis
+    metadata."""
+    from flax.core import meta
+
+    params = meta.unbox(params)
+    flat = flatten_dict(params)
+    out = {}
+    for path, leaf in flat.items():
+        if not _eligible(path, leaf) or (
+            skip and any(
+                any(tok in part for tok in skip) for part in path[:-1]
+            )
+        ):
+            out[path] = leaf
+            continue
+        amax = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=0)
+        scale = amax / 127.0
+        q = jnp.where(
+            scale > 0.0,
+            jnp.round(leaf.astype(jnp.float32) / jnp.where(
+                scale > 0.0, scale, 1.0
+            )),
+            0.0,
+        )
+        out[path + (QVALUE_LEAF,)] = jnp.clip(q, -127, 127).astype(jnp.int8)
+        out[path + (SCALE_LEAF,)] = scale.astype(leaf.dtype)
+    return unflatten_dict(out)
+
+
+def _quantized_paths(flat) -> list:
+    # a leaf named "scale" alone is NOT a marker (norm params are
+    # literally named scale); only the qvalue sibling makes the pair a
+    # quantized kernel
+    return [p for p in flat if p[-1] == QVALUE_LEAF]
+
+
+def is_quantized_tree(params) -> bool:
+    """True if the tree carries at least one quantized kernel."""
+    return bool(_quantized_paths(flatten_dict(params)))
+
+
+def dequantize_params(params):
+    """Widen every quantized kernel back to ``qvalue * scale`` (the
+    scale's dtype). Trace-safe and intended to run traced: under jit
+    the int8 leaf is the program input and the widening fuses into the
+    consuming matmul. Structural no-op on unquantized trees (returns
+    the input object, so non-quantized callers pay nothing)."""
+    flat = flatten_dict(params)
+    qpaths = _quantized_paths(flat)
+    if not qpaths:
+        return params
+    for qpath in qpaths:
+        kernel_path = qpath[:-1]
+        scale = flat.pop(kernel_path + (SCALE_LEAF,))
+        q = flat.pop(qpath)
+        flat[kernel_path] = q.astype(scale.dtype) * scale[None, :]
+    return unflatten_dict(flat)

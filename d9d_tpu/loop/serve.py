@@ -123,6 +123,7 @@ from jax.sharding import NamedSharding
 from d9d_tpu.core.tracing import annotate
 from d9d_tpu.core.tree_sharding import replicate_uncommitted
 from d9d_tpu.core.types import Array
+from d9d_tpu.loop.quantize import dequantize_params, is_quantized_tree
 from d9d_tpu.telemetry import get_telemetry, tracked_jit
 
 # slot-occupancy fraction per chunk/step: 20 linear bins over [0, 1]
@@ -390,6 +391,7 @@ class ContinuousBatcher:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
+        kv_quant: Optional[str] = None,
     ):
         """Degraded-mode knobs (docs/design/resilience.md): ``max_queue``
         bounds the admission queue — ``submit()`` past it raises
@@ -427,7 +429,18 @@ class ContinuousBatcher:
         recurrent state (GDN/conv tails: their state summarizes the
         whole prefix and cannot be restored from KV pages); True forces
         (raising if unsound), False disables. Greedy decoding is
-        token-identical to the contiguous layout either way."""
+        token-identical to the contiguous layout either way.
+
+        ``kv_quant="int8"`` (paged mode only — the page is the
+        quantization granule, docs/design/generation.md "Low-precision
+        serving") stores the KV pools as int8 with f32
+        per-(page, slot[, head]) scale pools riding next to them as
+        sibling cache leaves. Writes quantize at the per-row scatter,
+        reads dequantize in the decode-attention gather/kernel; the
+        prefix cache and continuation handoff are unchanged (scale
+        pages share the value pages' page table). Decoding is no longer
+        bit-identical to bf16/f32 — it is drift-bounded, gated by the
+        parity tests and the autopilot canary."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature > 0 needs an rng key")
         if chunk_size is not None and chunk_size < 1:
@@ -477,6 +490,13 @@ class ContinuousBatcher:
             raise ValueError(
                 "num_pages/prefix_cache need paged mode (set page_size)"
             )
+        if kv_quant is not None and not self._paged:
+            raise ValueError("kv_quant needs paged mode (set page_size)")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {kv_quant!r}"
+            )
+        self._kv_quant = kv_quant
 
         self._slots = [_Slot() for _ in range(batch_size)]
         self._queue: collections.deque[_Request] = collections.deque()
@@ -574,6 +594,13 @@ class ContinuousBatcher:
                 _zero_row, name="serve/reset_row", donate_argnums=0
             )
         self._cache = self._init_cache()
+        if self._paged:
+            # static per-batcher fact, but exported so dashboards (and
+            # the bench accounting) can tell quantized pools apart
+            # without reverse-engineering bytes-per-page
+            self._gauge_set(
+                "serve/kv_quant_enabled", 0.0 if kv_quant is None else 1.0
+            )
         # KV residency accounting (serve/kv_* gauges + the bench's
         # hbm_bytes_per_request): peaks over the measurement window
         self._peak_running = 0
@@ -739,6 +766,7 @@ class ContinuousBatcher:
         from d9d_tpu.nn.decode_flags import (
             PAGE_TABLE_LEAF,
             PAGED_CACHE_LEAVES,
+            PAGED_SCALE_SUFFIX,
         )
 
         z = jnp.zeros((self._b, 1), jnp.int32)
@@ -778,11 +806,23 @@ class ContinuousBatcher:
                         f"{s.shape[axis]}, expected decode_max_length="
                         f"{self._dml}"
                     )
-                pool = jnp.zeros(
+                pool_shape = (
                     (self._num_pages,) + s.shape[1:axis]
-                    + (self._page_size,) + s.shape[axis + 1:],
-                    s.dtype,
+                    + (self._page_size,) + s.shape[axis + 1:]
                 )
+                if self._kv_quant is not None:
+                    # int8 pool + f32 per-(page, slot[, head]) scale
+                    # pool: the scale leaf drops only the trailing
+                    # feature dim, so one scale covers one slot's
+                    # feature vector (the finest granule the one-token
+                    # scatter can maintain) and the scale pool indexes
+                    # through the SAME page table as its value pool
+                    pool = jnp.zeros(pool_shape, jnp.int8)
+                    scale = jnp.zeros(pool_shape[:-1], jnp.float32)
+                    out[p[:-1] + (p[-1] + PAGED_SCALE_SUFFIX,)] = scale
+                    self._page_bytes += scale.nbytes // self._num_pages
+                else:
+                    pool = jnp.zeros(pool_shape, s.dtype)
                 out[p] = pool
                 # one table per module scope (identical contents; a few
                 # ints per layer) so the module reads its own sibling
@@ -803,7 +843,16 @@ class ContinuousBatcher:
         argument, never a closure constant: that is what lets
         :meth:`install_weights` swap trees without retracing — the
         executable's signature (shapes/dtypes/placements) is identical
-        across publishes, so ``tracked_jit`` sees the same fingerprint."""
+        across publishes, so ``tracked_jit`` sees the same fingerprint.
+
+        A quantized tree (``loop/quantize.py``: int8 ``qvalue`` +
+        per-channel ``scale`` sub-leaves) dequantizes HERE, inside the
+        traced program: XLA streams the int8 bytes from HBM and widens
+        per-tile at the matmul, which is the whole point — the weight
+        stream halves while the compiled signature stays a pure
+        function of the (quantized) tree's shapes/dtypes. On an
+        unquantized tree this is a structural no-op."""
+        params = dequantize_params(params)
         kwargs = {"mask": None}
         if self._step_pad is not None:
             kwargs["padding_mask"] = self._step_pad
@@ -1152,12 +1201,23 @@ class ContinuousBatcher:
             dropped = self._kv.invalidate_prefix_cache()
             if dropped:
                 self._count("serve/prefix_cache_invalidated", dropped)
+            # stamp the invalidation with the weights generation that
+            # caused it: a canary rollback's re-invalidation is then
+            # distinguishable from the publish invalidation it undoes
+            # (both drop entries; only the stamp tells them apart)
+            self._gauge_set("serve/prefix_cache_invalidated_version", version)
             self._note_pages()
         self._count("serve/weight_publish")
         self._observe(
             "serve/weight_publish_s", time.perf_counter() - t0
         )
         self._gauge_set("serve/weights_version", version)
+        if is_quantized_tree(params):
+            # generation stamp of the last QUANTIZED tree installed (a
+            # rollback to full precision leaves it at the rolled-back
+            # generation — the gauge answers "which quantizer output is
+            # live / was last live", not "is the live tree quantized")
+            self._gauge_set("serve/weight_quant_version", version)
 
     # ------------------------------------------------------------------
     # fleet support (resilience/elastic.ServingFleet)

@@ -115,6 +115,35 @@ def _paged_slot(page_table, start, page_size: int):
     return page, start % page_size
 
 
+def _paged_scale_var(module: nn.Module, name: str):
+    """The sibling scale-pool variable of pool leaf ``name`` if the
+    serving loop seeded one (``kv_quant`` mode, loop/serve.py), else
+    None. Like the page table, presence of the leaf IS the mode flag:
+    the loop creates int8 pools and their scale pools in the same
+    pass, so the two cannot disagree."""
+    from d9d_tpu.nn.decode_flags import PAGED_SCALE_SUFFIX
+
+    scale_name = name + PAGED_SCALE_SUFFIX
+    if not module.has_variable("cache", scale_name):
+        return None
+    return module.variable("cache", scale_name, lambda: None)
+
+
+def _quantize_rows(v):
+    """Symmetric int8 quantization of feature vectors: ``v [..., D]`` →
+    ``(int8 [..., D], f32 scale [...])`` with scale = absmax/127 per
+    leading index. An all-zero vector gets scale 0 and quantizes to
+    exact zeros (dequant reproduces them — garbage-page writes stay
+    harmless)."""
+    vf = v.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(vf), axis=-1) / 127.0
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(
+        jnp.round(vf / safe[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
 def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
                          start, page_table=None):
     """Append ``value [B, T, ...]`` at cache slot ``start`` (scalar, or
@@ -142,8 +171,20 @@ def _decode_cache_append(module: nn.Module, value, name: str, s_max: int,
         pool = ref.value  # [P, ps, ...]
         ps = pool.shape[1]
         page, off = _paged_slot(page_table, start, ps)
-        ref.value = pool.at[page, off].set(value[:, 0])
-        g = ref.value[page_table]  # [B, n_pages, ps, ...]
+        sref = _paged_scale_var(module, name)
+        if sref is not None:
+            # int8 pool (kv_quant): quantize the one new row at the
+            # scatter, dequantize the whole gathered view at the read —
+            # consumers see the value dtype either way
+            qv, sc = _quantize_rows(value[:, 0])
+            ref.value = pool.at[page, off].set(qv)
+            sref.value = sref.value.at[page, off].set(sc)
+            g = ref.value[page_table]       # [B, n, ps, ...] int8
+            gs = sref.value[page_table]     # [B, n, ps] f32
+            g = (g.astype(jnp.float32) * gs[..., None]).astype(value.dtype)
+        else:
+            ref.value = pool.at[page, off].set(value[:, 0])
+            g = ref.value[page_table]  # [B, n_pages, ps, ...]
         return g.reshape((b, -1) + g.shape[3:])
     ref = module.variable(
         "cache", name,
@@ -190,6 +231,16 @@ def _decode_cache_append_heads_major(module: nn.Module, value, name: str,
         pool = ref.value  # [P, H, ps, D]
         ps = pool.shape[2]
         page, off = _paged_slot(page_table, start, ps)
+        sref = _paged_scale_var(module, name)
+        if sref is not None:
+            # int8 pool (kv_quant): per-(row, head) scales land in the
+            # [P, H, ps] scale pool at the same (page, offset); readers
+            # (the flash kernel's scale BlockSpec / the quantized eager
+            # gather) dequantize — the raw int8 pool is returned
+            qv, sc = _quantize_rows(value[:, 0])  # [B,H,D] i8, [B,H] f32
+            ref.value = pool.at[page, :, off, :].set(qv)
+            sref.value = sref.value.at[page, :, off].set(sc)
+            return ref.value
         ref.value = pool.at[page, :, off, :].set(value[:, 0])
         return ref.value
     ref = module.variable(
@@ -217,6 +268,19 @@ def _gather_pages_heads_major(pool, page_table):
     g = pool[page_table]  # [B, n, H, ps, D]
     b, n, h, ps, d = g.shape
     return g.transpose(0, 2, 1, 3, 4).reshape(b, h, n * ps, d)
+
+
+def _gather_pages_heads_major_quant(pool, scale_pool, page_table, dtype):
+    """Quantized sibling of :func:`_gather_pages_heads_major`: gather
+    the int8 pool AND its ``[P, H, ps]`` scale pool through the same
+    table, widen ``int8 * scale`` per slot, return the dense view in
+    the module compute dtype. This is the CPU-tier parity anchor: the
+    flash kernel's in-VMEM rescale must match this eager math."""
+    g = pool[page_table]            # [B, n, H, ps, D] int8
+    gs = scale_pool[page_table]     # [B, n, H, ps] f32
+    b, n, h, ps, d = g.shape
+    wide = (g.astype(jnp.float32) * gs[..., None]).astype(dtype)
+    return wide.transpose(0, 2, 1, 3, 4).reshape(b, h, n * ps, d)
 
 
 def _check_slot_mask(mask, s_max: int):
@@ -507,6 +571,12 @@ class GroupedQueryAttention(nn.Module):
                 page_table=page_table,
             )
             idx.value = start + t
+            # kv_quant mode (loop/serve.py): the appends above wrote
+            # int8 + per-slot scales; both read paths dequantize
+            k_scale = v_scale = None
+            if self.has_variable("cache", "cached_key_scale"):
+                k_scale = self.get_variable("cache", "cached_key_scale")
+                v_scale = self.get_variable("cache", "cached_value_scale")
             rows = (self.num_heads // self.num_kv_heads) * t
             if (
                 decode_attention_backend() == "pallas"
@@ -519,9 +589,19 @@ class GroupedQueryAttention(nn.Module):
                     window_size=self.window_size,
                     sinks=sinks,
                     page_table=page_table,
+                    k_scale=k_scale,
+                    v_scale=v_scale,
                 )
-            keys = _gather_pages_heads_major(k_pool, page_table)
-            values = _gather_pages_heads_major(v_pool, page_table)
+            if k_scale is not None:
+                keys = _gather_pages_heads_major_quant(
+                    k_pool, k_scale, page_table, self.dtype
+                )
+                values = _gather_pages_heads_major_quant(
+                    v_pool, v_scale, page_table, self.dtype
+                )
+            else:
+                keys = _gather_pages_heads_major(k_pool, page_table)
+                values = _gather_pages_heads_major(v_pool, page_table)
             s_virt = keys.shape[2]
             return eager_sdpa(
                 q,

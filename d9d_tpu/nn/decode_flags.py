@@ -72,6 +72,19 @@ PAGED_CACHE_LEAVES = {
 
 PAGE_TABLE_LEAF = "page_table"
 
+# quantized-KV sibling leaves (docs/design/generation.md "Low-precision
+# serving"): when a pool leaf is stored int8, a second pool named
+# ``<leaf>_scale`` rides next to it holding the per-(page, slot[, head])
+# dequantization scales — just more paged cache leaves sharing the SAME
+# page table, so the allocator, prefix cache and continuation handoff
+# treat value pages and scale pages identically. Attention modules
+# detect quantization by the presence of the sibling scale leaf.
+PAGED_SCALE_SUFFIX = "_scale"
+PAGED_SCALE_LEAVES = {
+    name + PAGED_SCALE_SUFFIX: axis
+    for name, axis in PAGED_CACHE_LEAVES.items()
+}
+
 
 def map_page_table(cache, fn):
     """Apply ``fn`` to every ``page_table`` leaf of a cache pytree (the
@@ -98,7 +111,9 @@ def zero_rows_skip_paged(cache, row_mask):
     import jax.numpy as jnp
     from flax.traverse_util import flatten_dict, unflatten_dict
 
-    skip = set(PAGED_CACHE_LEAVES) | {PAGE_TABLE_LEAF}
+    skip = (
+        set(PAGED_CACHE_LEAVES) | set(PAGED_SCALE_LEAVES) | {PAGE_TABLE_LEAF}
+    )
     flat = flatten_dict(cache)
     for path, x in list(flat.items()):
         if path[-1] in skip:

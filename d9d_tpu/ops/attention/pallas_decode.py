@@ -85,16 +85,23 @@ class _DecodeConfig:
     block_kv: int
     has_valid: bool
     interpret: bool
+    # int8 KV pools with per-slot scale pools riding behind k/v (paged
+    # mode only; never combines with has_valid — the serving loop's
+    # paged rows are never left-padded)
+    quant: bool = False
 
 
 def _decode_kernel(*refs, cfg: _DecodeConfig):
-    if cfg.has_valid:
+    ks_ref = vs_ref = valid_ref = None
+    if cfg.quant:
+        offs_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref = refs[:6]
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[6:]
+    elif cfg.has_valid:
         offs_ref, q_ref, k_ref, v_ref, valid_ref = refs[:5]
         o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[5:]
     else:
         offs_ref, q_ref, k_ref, v_ref = refs[:4]
         o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[4:]
-        valid_ref = None
     # per-batch-row write index (continuous batching: rows fill at
     # independent rates; a shared index is just the broadcast case)
     start = offs_ref[pl.program_id(0)]
@@ -123,6 +130,13 @@ def _decode_kernel(*refs, cfg: _DecodeConfig):
         q = q_ref[0, 0, :, :].astype(jnp.float32)
         k = k_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
+        if cfg.quant:
+            # int8 block × per-slot scale column [bkv, 1] broadcast
+            # over the feature dim: the dequant rides the same in-VMEM
+            # f32 math the kernel already does — HBM streamed the int8
+            # bytes, the rescale is free next to the MXU dot
+            k = k * ks_ref[0, 0, :, :]
+            v = v * vs_ref[0, 0, :, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -184,16 +198,34 @@ def _paged_decode_kernel(offs_ref, pt_ref, *refs, cfg: _DecodeConfig):
 # d9d-lint: disable=D9D001 — standalone-use decorator; serving traces this inside the tracked serve/step program (a TrackedJit cannot be called under a trace)
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
-                       offsets, page_table):
+                       offsets, page_table, k_scale=None, v_scale=None):
     """``q_rows [B, Hkv, rows_pad, D]`` vs page pools
     ``k/v [P, Hkv, page_size, D]`` gathered through
     ``page_table [B, n_pages]`` → same outputs as :func:`_decode_call`
     on the contiguous equivalent. The kv-block index map generalizes
     from ``block = ki`` to ``block = page_table[bi, ki]`` — the paging
     claim in one line: the kernel needs a different INDEX, not a
-    different algorithm. ``block_kv == page_size`` by construction."""
+    different algorithm. ``block_kv == page_size`` by construction.
+
+    ``cfg.quant``: k/v pools are int8 and ``k/v_scale [P, Hkv, ps]``
+    carry the per-slot dequantization scales — reshaped to a trailing
+    unit lane and streamed through the SAME gathering index map as
+    their pools (a scale page is just a narrower page), rescaled in
+    the kernel's existing in-VMEM f32 accumulation."""
     b, hkv, rp, d = q_rows.shape
     n_pages = page_table.shape[1]
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, cfg.block_kv, d),
+        lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0),
+    )
+    scale_specs, scale_bufs = (), ()
+    if cfg.quant:
+        scale_specs = (
+            pl.BlockSpec((1, 1, cfg.block_kv, 1),
+                         lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0)),
+        ) * 2
+        scale_bufs = (k_scale[..., None], v_scale[..., None])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # offsets, page_table
@@ -201,10 +233,9 @@ def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
         in_specs=[
             pl.BlockSpec((1, 1, rp, d),
                          lambda bi, hi, ki, offs, pt: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, cfg.block_kv, d),
-                         lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0)),
-            pl.BlockSpec((1, 1, cfg.block_kv, d),
-                         lambda bi, hi, ki, offs, pt: (pt[bi, ki], hi, 0, 0)),
+            kv_spec,
+            kv_spec,
+            *scale_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, rp, d),
@@ -231,7 +262,7 @@ def _paged_decode_call(cfg: _DecodeConfig, q_rows, k_pool, v_pool,
             )
         ),
         interpret=cfg.interpret,
-    )(offsets, page_table, q_rows, k_pool, v_pool)
+    )(offsets, page_table, q_rows, k_pool, v_pool, *scale_bufs)
     return o, lse[..., 0]
 
 
@@ -302,6 +333,8 @@ def flash_decode_attention(
     sinks: Array | None = None,
     kv_valid: Array | None = None,
     page_table: Array | None = None,
+    k_scale: Array | None = None,
+    v_scale: Array | None = None,
     block_kv: int = 512,
     interpret: bool | None = None,
 ) -> Array:
@@ -336,6 +369,15 @@ def flash_decode_attention(
     softmax — is unchanged, which is exactly why paging is an indexing
     generalization of this kernel rather than a new one. ``kv_valid``
     does not compose with paging (the serving loop never passes it).
+
+    QUANTIZED paged mode (``k_scale``/``v_scale [P, Hkv, page_size]``
+    set): the pools are int8 and each slot's feature vector carries a
+    f32 scale; the scale pools stream through the same gathering index
+    map (one narrow block per page) and the kernel widens
+    ``int8 * scale`` inside its existing f32 accumulation — HBM
+    traffic per slot drops to D int8 bytes + one f32 scale. Note int8
+    TPU tiles are (32, 128): on-chip (non-interpret) runs need
+    ``page_size >= 32``; the CPU interpret tier has no such floor.
     """
     b, t, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
@@ -368,6 +410,8 @@ def flash_decode_attention(
                 "paged decode does not take kv_valid (the serving loop's "
                 "paged rows are never left-padded)"
             )
+        if (k_scale is None) != (v_scale is None):
+            raise ValueError("k_scale and v_scale must be set together")
         page_size = k_cache.shape[2]
         n_pages = page_table.shape[1]
         cfg = _DecodeConfig(
@@ -380,12 +424,19 @@ def flash_decode_attention(
             block_kv=page_size,
             has_valid=False,
             interpret=interpret,
+            quant=k_scale is not None,
         )
         o, lse = _paged_decode_call(
             cfg, q_rows, k_cache, v_cache, offsets,
             page_table.astype(jnp.int32),
+            k_scale=k_scale, v_scale=v_scale,
         )
     else:
+        if k_scale is not None or v_scale is not None:
+            raise NotImplementedError(
+                "k_scale/v_scale are paged-mode arguments (quantized "
+                "pools need a page_table)"
+            )
         bkv = min(block_kv, s + _pad_to(s, LANES))
         s_pad = s + _pad_to(s, bkv)
 

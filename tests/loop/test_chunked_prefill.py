@@ -72,6 +72,7 @@ def test_dense_chunked_matches_unchunked(chunk):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_windowed_chunked_matches_unchunked():
     """Sliding window crossing chunk boundaries: the slot path must
     apply the window by global position, not within-chunk position."""

@@ -277,6 +277,150 @@ def test_weight_publish_invalidates_prefix_cache():
     assert b._kv.prefix_hits == 1
 
 
+def test_quant_kv_serving_exact_on_toy():
+    """``kv_quant`` plumbing end to end on a model with NO poolable
+    leaves (ToyDecodeLM's ``mem`` is per-row): the quantized paged
+    batcher must run the identical schedule and emit exact tokens —
+    nothing to quantize means nothing may drift."""
+    from tests.resilience.conftest import ToyDecodeLM, toy_expected
+
+    model = ToyDecodeLM()
+    z = jnp.zeros((2, 1), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), z, z, z).get("params", {})
+    b = ContinuousBatcher(model, params, batch_size=2, chunk_size=4,
+                          page_size=4, num_pages=9, kv_quant="int8")
+    r1 = b.submit([3], max_new_tokens=6)
+    r2 = b.submit([7], max_new_tokens=6)
+    out = b.drain()
+    assert out[r1] == toy_expected([3], 6)
+    assert out[r2] == toy_expected([7], 6)
+    b._kv.check_invariants()
+    # and the mode is misuse-proof: int8 pools need a page table
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(model, params, batch_size=2, chunk_size=4,
+                          kv_quant="int8")
+
+
+def test_quant_prefix_hit_shares_scale_pages_token_identical():
+    """Prefix-hit sharing on QUANTIZED pages: a hit row reads the same
+    int8 pages AND the same sibling scale pages through its table (full
+    shared pages are read-only; writers append into their own pages),
+    so the hit serving must emit EXACTLY the quantized batcher's own
+    cold tokens. Lossiness cancels out — both servings attend the same
+    quantized bytes."""
+    from flax.traverse_util import flatten_dict
+
+    from d9d_tpu.nn.decode_flags import PAGED_SCALE_SUFFIX
+
+    model = _dense()
+    params = _params(model)
+    prompt = _prompts(42, 1, lo=18, hi=19)[0]  # 2 full pages + tail
+    b = _batcher(model, params, paged=True, num_pages=9, kv_quant="int8")
+    # the cache really is quantized: int8 pools with f32 scale siblings
+    flat = flatten_dict(b._cache)
+    scale_paths = [
+        p for p in flat if p[-1].endswith(PAGED_SCALE_SUFFIX)
+    ]
+    assert scale_paths
+    for p in scale_paths:
+        assert flat[p].dtype == jnp.float32
+        pool = flat[p[:-1] + (p[-1][: -len(PAGED_SCALE_SUFFIX)],)]
+        assert pool.dtype == jnp.int8
+    r1 = b.submit(prompt, max_new_tokens=5)
+    cold = b.drain()[r1]
+    assert b._kv.prefix_hits == 0 and b._kv.prefix_misses == 1
+    r2 = b.submit(prompt, max_new_tokens=5)
+    assert b.drain()[r2] == cold
+    assert b._kv.prefix_hits == 1 and b._kv.prefix_hit_tokens == 2 * PAGE
+    b._kv.check_invariants()
+    # two rows sharing the quantized prefix concurrently
+    r3 = b.submit(prompt, max_new_tokens=5)
+    r4 = b.submit(prompt, max_new_tokens=5)
+    out = b.drain()
+    assert out[r3] == cold and out[r4] == cold
+    assert b._kv.prefix_hits == 3
+    b._kv.check_invariants()
+
+
+def test_canary_rollback_invalidation_stamp_distinct_from_publish():
+    """Both a canary install AND its rollback invalidate the prefix
+    cache (each swaps the weights the cached pages were computed
+    under); the ``serve/prefix_cache_invalidated_version`` gauge stamps
+    each with the generation that caused it — the rollback's FRESH
+    stamp (3) is distinguishable from the canary publish it undoes (2),
+    which is the only way an operator can tell the two apart on a
+    dashboard (both just drop entries)."""
+    from d9d_tpu.resilience import WeightPublisher
+    from d9d_tpu.telemetry import Telemetry
+
+    model = _dense()
+    params = _params(model)
+    bad = jax.tree.map(lambda x: x * 1.03, params)
+    prompt = _prompts(45, 1, lo=18, hi=19)[0]
+    tele = Telemetry()
+    b = _batcher(model, params, paged=True, num_pages=9, telemetry=tele)
+    pub = WeightPublisher(telemetry=tele)
+    pub.attach(b)
+    pub.publish(params)  # generation 1: the retained rollback target
+    r1 = b.submit(prompt, max_new_tokens=5)
+    oracle = b.drain()[r1]
+    assert b.weights_version == 1
+    gauge = tele.registry.gauge("serve/prefix_cache_invalidated_version")
+    assert gauge.value == 1
+    assert b._kv._entries  # the prefix is cached under generation 1
+    # canary publish: the apply at the next boundary must invalidate
+    # and stamp with the canary's generation
+    assert pub.publish_canary(bad) == 2
+    r2 = b.submit(prompt, max_new_tokens=5)
+    b.drain()
+    assert b.weights_version == 2
+    assert gauge.value == 2
+    assert b._kv.prefix_hits == 0  # no stale hit under the canary
+    # rollback: a FRESH generation, and a FRESH invalidation stamp —
+    # the re-invalidation is auditable as the rollback, not a replay
+    # of the publish
+    assert pub.rollback_canary() == 3
+    r3 = b.submit(prompt, max_new_tokens=5)
+    out = b.drain()[r3]
+    assert b.weights_version == 3
+    assert gauge.value == 3
+    assert out == oracle  # back on the retained tree, exactly
+    b._kv.check_invariants()
+    del r2
+
+
+@pytest.mark.slow  # full-model quantized compile on top of the wide one
+def test_quant_qwen3_logits_drift_bounded():
+    """Per-channel int8 weights round-tripped through the serving
+    dequant must reproduce the wide logits within a tight relative
+    bound on the tiny qwen3 config — the weight-stream half of the
+    low-precision contract, pinned at the logits (the argmax consumer
+    sees this surface)."""
+    from d9d_tpu.loop.quantize import (
+        dequantize_params,
+        is_quantized_tree,
+        quantize_for_serving,
+    )
+
+    model = _dense()
+    params = _params(model)
+    q = quantize_for_serving(params)
+    assert is_quantized_tree(q) and not is_quantized_tree(params)
+    tokens = jnp.asarray([_prompts(46, 1, lo=8, hi=9)[0]], jnp.int32)
+    pos = jnp.broadcast_to(
+        jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+    )
+    eval_model = model.clone(decode_max_length=0)
+    w = np.asarray(eval_model.apply(
+        {"params": params}, tokens, pos, method="logits"
+    ))
+    g = np.asarray(eval_model.apply(
+        {"params": dequantize_params(q)}, tokens, pos, method="logits"
+    ))
+    drift = np.abs(g - w).max() / max(np.abs(w).max(), 1e-9)
+    assert drift < 0.02, drift
+
+
 def test_paged_deferred_release_flushes_at_next_boundary():
     """White-box: a host-side expiry while a chunk is IN FLIGHT defers
     the page free (the device twin may still write); the next clean

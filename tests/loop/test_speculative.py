@@ -54,6 +54,7 @@ def _prompt(b, p, seed=0):
     )
 
 
+@pytest.mark.slow  # ~8s/param compile-bound on the 2-core rig
 @pytest.mark.parametrize("k", [1, 3, 5])
 def test_perfect_draft_matches_generate(k):
     """draft == target: every proposal accepted, output still exact."""

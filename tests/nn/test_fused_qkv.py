@@ -23,6 +23,7 @@ def _cfg(fused):
     )
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_fused_qkv_matches_unfused_params_and_outputs():
     from d9d_tpu.core import MeshParameters
 

@@ -100,6 +100,7 @@ class TestFusedParity:
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
 
+    @pytest.mark.slow  # >10s compile-bound on the 2-core rig
     def test_gather_variant_gradients(self, monkeypatch):
         """The gather variant shares the reference backward; its custom
         fwd must still produce exact grads end to end."""

@@ -195,6 +195,7 @@ class TestAttentionBlock:
         (4, {"window_size": 13}),
         (2, {"causal": False}),
     ])
+    @pytest.mark.slow  # ~10s/param compile-bound on the 2-core rig
     def test_chunked_matches_full(self, n_chunks, kw):
         from d9d_tpu.ops.attention.pallas_flash import flash_attention_block
 

@@ -468,6 +468,137 @@ def test_second_canary_while_pending_raises(tmp_path):
     ap.publish_canary(GOOD)  # resolvable again
 
 
+class ToyQuantLM(nn.Module):
+    """ToyShiftLM with a REAL 2-D matmul kernel so the serving weight
+    quantizer (loop/quantize.py) has something to quantize: ``logits =
+    one_hot(tok) @ kernel`` with ``kernel = 20 * shift-by-1
+    permutation``. Per-column absmax quantization is EXACT on it (every
+    column's single nonzero hits qvalue 127), so a healthy quantized
+    publish is token-identical to full precision — and a broken
+    quantizer (zeroed scales) flattens the logits to all-zero, greedy
+    decode emits token 0 forever, EOS never lands, and the
+    serve/request_tokens distribution jumps to the budget ceiling on
+    the canary replica."""
+
+    vocab: int = SHIFT_VOCAB
+    decode_max_length: int = 32
+
+    @nn.compact
+    def __call__(self, tokens, positions, labels=None, mask=None):
+        b = tokens.shape[0]
+        kernel = self.param(
+            "kernel",
+            lambda k: 20.0 * jnp.eye(self.vocab, dtype=jnp.float32),
+        )
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        mem = self.variable(
+            "cache", "mem",
+            lambda: jnp.zeros((b, self.decode_max_length), jnp.int32),
+        )
+        i = jnp.broadcast_to(idx.value, (b,))
+        mem.value = mem.value.at[
+            jnp.arange(b), jnp.clip(i, 0, self.decode_max_length - 1)
+        ].set(tokens[:, 0])
+        idx.value = idx.value + 1
+        return jax.nn.one_hot(tokens, self.vocab) @ kernel
+
+    def logits(self, tokens, positions, mask=None):
+        return self(tokens, positions)
+
+
+# kernel[t, (t+1) % vocab] = 20: greedy next token == (t + 1) % vocab,
+# the same walk as ToyShiftLM's GOOD shift, so shift_expected() is the
+# oracle for the quantized model too
+GOOD_Q = {
+    "kernel": 20.0 * jnp.eye(SHIFT_VOCAB, dtype=jnp.float32)[
+        :, (jnp.arange(SHIFT_VOCAB) - 1) % SHIFT_VOCAB
+    ]
+}
+
+
+def make_quant_batcher(params, **kw):
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("chunk_size", 4)
+    return ContinuousBatcher(ToyQuantLM(), params, eos_id=SHIFT_EOS, **kw)
+
+
+def test_broken_quantizer_canary_rolls_back(tmp_path):
+    """The low-precision deployment contract end to end
+    (docs/design/generation.md "Low-precision serving"): the fleet
+    serves a HEALTHY int8-quantized generation; a deliberately broken
+    re-quantization (zeroed scales — the classic all-zero-logits
+    quantizer bug) goes out as an autopilot canary, the canary
+    replica's serve/request_tokens distribution hits the budget
+    ceiling, and the autopilot rolls back to the retained quantized
+    tree under a fresh stamp with a flight-recorder dump and a
+    decision-log entry — no human input, no fleet-wide damage."""
+    from d9d_tpu.loop.quantize import (
+        is_quantized_tree,
+        quantize_for_serving,
+    )
+
+    hub = get_telemetry()
+    hub.configure_flight_recorder(tmp_path / "flight")
+    clock = FakeClock()
+    good_q = quantize_for_serving(GOOD_Q)
+    assert is_quantized_tree(good_q)
+    pub = WeightPublisher()
+    pub.publish(good_q)  # generation 1: the healthy quantized tree
+    fleet = ServingFleet(publisher=pub)
+    for _ in range(2):
+        fleet.add_replica(make_quant_batcher(good_q))
+    monitor = SloMonitor(
+        [SloPolicy(name="gen_len_p50", metric="serve/request_tokens",
+                   quantile=0.5, target=6.0, window_s=30.0,
+                   burn_rate=1e18)],
+        clock=clock,
+    ).attach(hub)
+    ap = FleetAutopilot(
+        fleet, monitor,
+        replica_factory=lambda p: make_quant_batcher(p),
+        config=AutopilotConfig(
+            scale_policies=(), canary_policies=("gen_len_p50",),
+            canary_window_s=10.0, canary_tolerance=1.25,
+            canary_min_samples=2, canary_max_wait_s=30.0,
+            eval_interval_s=1.0,
+        ),
+        decision_log=tmp_path / "decisions.jsonl",
+        clock=clock,
+    ).attach()
+
+    # healthy quantized serving IS token-exact on this model (the
+    # permutation kernel quantizes losslessly)
+    f = fleet.submit([3], max_new_tokens=10)
+    assert fleet.drain()[f] == shift_expected([3], 10) == [4, 5, 6]
+
+    # the broken quantizer: same tree, every scale zeroed — dequant
+    # yields all-zero kernels, greedy argmax pins to token 0, EOS never
+    bad_q = jax.tree.map(jnp.zeros_like, good_q)
+    v = ap.publish_canary(bad_q)
+    assert v == 2 and pub.canary is not None
+    canary_b = fleet._replicas[max(fleet.live_replicas)]
+    _serve_rounds(fleet, clock, [[3], [5], [1]] * 4)
+
+    decs = read_decisions(tmp_path / "decisions.jsonl")
+    assert [d["action"] for d in decs] == ["canary_start",
+                                           "canary_rollback"]
+    verdicts = decs[-1]["detail"]["verdicts"]["gen_len_p50"]
+    assert verdicts["bad"] is True and verdicts["canary"] == 10.0
+    assert pub.canary is None
+    # rollback re-installs the RETAINED quantized tree, fresh stamp
+    assert canary_b.weights_version == 3
+    assert pub.latest_version == 1
+    assert (tmp_path / "flight"
+            / "flight_recorder_autopilot_rollback.json").exists()
+    snap = hub.registry.snapshot()
+    assert snap["counters"]["autopilot/canary_rollbacks"] == 1
+    # token-exact again everywhere after the rollback
+    f2 = fleet.submit([3], max_new_tokens=10)
+    assert fleet.drain()[f2] == [4, 5, 6]
+
+
 def test_removed_policy_stops_driving_decisions():
     """A policy retired via monitor.remove() while violating must drop
     out of the autopilot's cached statuses — a stale violating status

@@ -147,6 +147,29 @@ def test_kill_mid_drain_recovers_paged_requests_token_identically():
     assert fleet._kv_pages("pages_free") == survivor._kv.pages_free
 
 
+def test_kill_mid_drain_quantized_fleet_token_identical():
+    """The chaos leg for LOW-PRECISION serving: a fleet running int8 KV
+    pages (``kv_quant="int8"``), one replica killed mid-drain — the
+    continuation handoff re-prefills the committed tokens into the
+    survivor's own quantized pages, so recovery must be token-identical
+    to the fleet's own quantized baseline (the toy's margins make that
+    baseline the exact oracle)."""
+    fleet = _fleet(2, page_size=4, num_pages=17, kv_quant="int8")
+    prompts = [[3], [7], [12], [1]]
+    frids = [fleet.submit(p, max_new_tokens=10) for p in prompts]
+    fleet.step()
+    shrink_at_step(fleet, 0, step=2)
+    kill_replica_mid_drain(fleet, 0, after_chunks=1)
+    out = fleet.drain()
+    assert 0 in fleet.dead
+    for frid, p in zip(frids, prompts):
+        assert out[frid] == toy_expected(p, 10), frid
+    survivor = fleet._replicas[1]
+    survivor._kv.check_invariants()
+    assert survivor._kv.pages_in_use == 0
+    assert fleet._kv_pages("pages_free") == survivor._kv.pages_free
+
+
 def test_submit_validation_error_leaves_no_ghost():
     """A replica-side validation error must not strand an unplaceable
     fleet request that wedges every later drain()."""

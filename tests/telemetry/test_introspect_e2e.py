@@ -66,6 +66,7 @@ class _Data(DatasetProvider):
             yield {"input_ids": rng.randint(0, VOCAB, size=(BATCH, SEQ + 1))}
 
 
+@pytest.mark.slow  # >15s compile-bound on the 2-core rig; e2e tier covers it
 def test_train_introspection_steady_state_and_recompile_pin(
     tmp_path, caplog
 ):

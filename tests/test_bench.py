@@ -20,6 +20,7 @@ def _load_bench():
     return load_repo_module("bench", "bench.py")
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_tiny_runs(devices, tmp_path, monkeypatch):
     # the bench leg emits the telemetry JSONL alongside its row when
     # D9D_TELEMETRY_DIR is set (docs/design/observability.md)
@@ -94,6 +95,7 @@ def test_pp_makespan_simulator():
     assert by[("zb1p", "remat")]["total_compute"] > f1["total_compute"]
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_moe_tiny_runs(devices):
     bench = _load_bench()
     result = bench.run_bench_moe(tiny=True)
@@ -122,6 +124,7 @@ def test_bench_kernels_tiny_runs(devices):
     assert {"sdpa_fwd", "linear_ce_fwd", "rms_norm", "stochastic_round"} <= benches
 
 
+@pytest.mark.slow  # compile-bound on the 2-core rig; e2e tier covers it
 def test_bench_input_pipeline_tiny_runs(devices):
     """run_bench_input_pipeline (VERDICT r3 item 4): all three variants
     produce positive step times on the CPU rig (overlap itself is a

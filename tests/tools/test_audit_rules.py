@@ -223,6 +223,29 @@ class TestDtypeDiscipline:
         )
         assert report.violations == []
 
+    def test_require_dtypes_fires_when_census_widens(self):
+        # the low-precision serving contract: a quantized program whose
+        # census lost int8 silently resurrected wide pools
+        exp = {"ctx": {"x/step": {"require_dtypes": ["int8", "float32"]}}}
+        report = run_rules(
+            [fact(dtype_ops={"float32": 40, "int32": 3})], manifest(exp)
+        )
+        assert rules_of(report) == ["D9D103"]
+        v = report.violations[0]
+        assert "int8" in v.message and v.key == "require_dtypes:int8"
+
+    def test_require_dtypes_clean_when_present(self):
+        exp = {"ctx": {"x/step": {"require_dtypes": ["int8", "float32"]}}}
+        report = run_rules(
+            [fact(dtype_ops={"int8": 4, "float32": 40, "int32": 3})],
+            manifest(exp),
+        )
+        assert report.violations == []
+        # and without an expectation the census is unconstrained
+        assert run_rules(
+            [fact(dtype_ops={"float32": 40})], manifest()
+        ).violations == []
+
 
 # -- D9D104 host callbacks -----------------------------------------------
 

@@ -174,6 +174,40 @@ def leg_serve() -> None:
     legacy.drain()
 
 
+def leg_serve_quant() -> None:
+    """The low-precision serving path: int8 weight stream (per-channel
+    qvalue+scale tree dequantized inside the traced step) over int8
+    paged KV pools with sibling scale pages. The manifest requires the
+    fused decode programs' dtype census to carry BOTH int8 (pool/weight
+    loads actually narrow) and float32 (accumulation stays wide) —
+    certifying no silent bf16/f32 pool resurrection — on top of the
+    serve plane's zero-collective and donation contracts."""
+    from tools.bench_serve import build_model
+
+    from d9d_tpu.loop.quantize import quantize_for_serving
+    from d9d_tpu.loop.serve import ContinuousBatcher
+
+    model, params, cfg = build_model(tiny=True)
+    qparams = quantize_for_serving(params)
+    fused = ContinuousBatcher(
+        model, qparams, batch_size=2, chunk_size=4,
+        overlap=True, page_size=4, num_pages=33, kv_quant="int8",
+    )
+    fused.submit([1, 2, 3], max_new_tokens=10)
+    fused.drain()
+
+    # the legacy per-token paged path is the only one that dispatches
+    # the standalone row-reset program (the fused path folds the reset
+    # into fused_k*_paged_admit) — run it so serve/reset_row_paged and
+    # the legacy quantized decode step are certified too
+    legacy = ContinuousBatcher(
+        model, qparams, batch_size=2, chunk_size=None,
+        page_size=4, num_pages=33, kv_quant="int8",
+    )
+    legacy.submit([1, 2, 3], max_new_tokens=4)
+    legacy.drain()
+
+
 def leg_spec_decode() -> None:
     """The fused speculative round (serve/spec_round): draft + verify
     as one executable, zero collectives."""
@@ -343,6 +377,7 @@ LEGS: dict[str, Callable[[], None]] = {
     "train": leg_train,
     "train_zero": leg_train_zero,
     "serve": leg_serve,
+    "serve_quant": leg_serve_quant,
     "spec_decode": leg_spec_decode,
     "pp_opt": leg_pp_opt,
     "pp_fused": leg_pp_fused,

@@ -234,6 +234,29 @@ def _check_dtypes(fact: dict, exp: Optional[dict]) -> list[Violation]:
             ),
             key=f"f32_matmuls={fact['f32_matmuls']}",
         ))
+    # positive dtype certification (the low-precision serving leg): an
+    # expectation may REQUIRE dtypes to be present in the census —
+    # e.g. ["int8", "float32"] certifies the quantized decode program
+    # still loads int8 pools and accumulates f32. A quantization path
+    # silently reverting to wide pools drops int8 from the census and
+    # fails here, the inverse failure mode of the f64/f32 bans above.
+    required = (exp or {}).get("require_dtypes", ())
+    census = fact.get("dtype_ops", {})
+    missing = [dt for dt in required if not census.get(dt)]
+    if missing:
+        out.append(Violation(
+            rule="D9D103",
+            context=fact["context"],
+            executable=fact["name"],
+            message=(
+                f"required dtype(s) {missing} absent from the compiled "
+                f"program's census (present: {sorted(census)}): the "
+                "expectation certifies these widths are actually in "
+                "play — a quantized path that silently widened its "
+                "storage no longer is"
+            ),
+            key="require_dtypes:" + ",".join(missing),
+        ))
     return out
 
 

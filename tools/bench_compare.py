@@ -277,6 +277,31 @@ def run_micro() -> dict:
     pg_window_records = introspect.inventory()[mark_pg:]
     paged_exact = int(pg.outputs == batcher.outputs)
 
+    # -- quant leg: same workload, int8 KV pages + int8 weight stream --
+    # identical schedule to the paged leg (no eos_id → every request
+    # runs its full budget, so lossy logits cannot perturb the step
+    # clock): the structural counts must be BYTE-identical to the bf16
+    # paged leg's — quantization adds zero host interactions and zero
+    # steady-state compiles — while the dtype-honest per-request HBM
+    # accounting (int8 pools + f32 scale pages vs wide pools) gates the
+    # ≥2× page-capacity win. The mid-bench publish installs the
+    # QUANTIZED tree, so a publish-induced recompile on the int8 weight
+    # stream would trip the same exact-count gates
+    from d9d_tpu.loop.quantize import quantize_for_serving
+
+    qparams = quantize_for_serving(params)
+    qt = ContinuousBatcher(
+        model, qparams, batch_size=MICRO["batch_size"],
+        chunk_size=k, overlap=True, page_size=16, prefix_cache=False,
+        kv_quant="int8",
+    )
+    qt.submit(workload[0][1], max_new_tokens=2 * k + 2)
+    qt.drain()
+    qt.reset_measurement()
+    mark_qt = len(introspect.inventory())
+    _drive_micro(qt, workload, qparams)
+    qt_window_records = introspect.inventory()[mark_qt:]
+
     # -- prefix leg: shared system prompt through the prefix cache -----
     shared = make_shared_prefix_workload(
         vocab=cfg.vocab_size, requests=MICRO["requests"], seed=0,
@@ -431,6 +456,29 @@ def run_micro() -> dict:
                 pg.stats.host_dispatches - st.host_dispatches
             ),
             "serve_micro.paged_exact_vs_contiguous": paged_exact,
+            # quant leg: int8 KV + int8 weights must keep the paged
+            # leg's structural counts byte-identical and at least halve
+            # the per-request KV HBM claim (docs/design/generation.md
+            # "Low-precision serving")
+            "serve_micro.quant_emitted_tokens": qt.stats.emitted_tokens,
+            "serve_micro.quant_host_dispatches": qt.stats.host_dispatches,
+            "serve_micro.quant_readbacks": qt.stats.readbacks,
+            "serve_micro.quant_steady_state_compiles": len(
+                qt_window_records
+            ),
+            "serve_micro.quant_added_dispatches": (
+                qt.stats.host_dispatches - pg.stats.host_dispatches
+            ),
+            # dtype-honest per-request KV bytes (int8 pool + f32 scale
+            # pages) against the wide paged leg under the SAME schedule
+            "serve_micro.quant_kv_hbm_frac_vs_paged": round(
+                qt.hbm_bytes_per_request()
+                / max(pg.hbm_bytes_per_request(), 1e-9), 4
+            ),
+            # requests a fixed HBM pool budget holds, vs wide pages
+            "serve_micro.quant_kv_capacity_x": round(
+                pg._page_bytes / qt._page_bytes, 2
+            ),
             # prefix leg: the shared-system-prompt economics, all
             # deterministic accounting (exact thresholds)
             "serve_micro.prefix_host_dispatches": px.stats.host_dispatches,
@@ -925,9 +973,25 @@ def default_thresholds(metrics: dict) -> dict:
             # the 2% monitoring-plane budget is the CONTRACT value, not
             # the measured one (CI noise can even make it negative); the
             # wide rel_tol makes the CI gate a 20% collapse floor — the
-            # strict 2% check is the chip leg's job
+            # strict 2% check is the chip leg's job. On the 2-core CI
+            # rig the exporter's endpoint thread contends with the
+            # serving loop for the same cores, so a breach here is
+            # flaky-by-construction: re-run this leg in ISOLATION
+            # (nothing else on the box) before reading it as real
             specs[name] = {
                 "value": 0.02, "direction": "lower", "rel_tol": 9.0,
+            }
+        elif name.endswith(".quant_kv_hbm_frac_vs_paged"):
+            # the CONTRACT value (int8+scales must at least halve the
+            # per-request KV bytes), not the measured one — robust to
+            # head-dim drift in the tiny model config
+            specs[name] = {
+                "value": 0.5, "direction": "lower", "rel_tol": 0.0,
+            }
+        elif name.endswith(".quant_kv_capacity_x"):
+            # contract: a fixed HBM pool budget holds ≥2× the requests
+            specs[name] = {
+                "value": 2.0, "direction": "higher", "rel_tol": 0.0,
             }
         elif name.endswith((
             ".exporter_scrape_ok",

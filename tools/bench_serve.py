@@ -227,6 +227,11 @@ def main():
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--ks", type=int, nargs="*", default=[1, 8, 16])
     ap.add_argument(
+        "--quant", action="store_true",
+        help="add the low-precision serving rows (int8 KV pages, then "
+        "int8 weights + int8 KV) against the wide paged leg",
+    )
+    ap.add_argument(
         "--telemetry-out", default=os.environ.get("D9D_TELEMETRY_DIR"),
         help="directory for the schema-versioned telemetry JSONL event "
         "log (TTFT/TPOT/queue-wait/slot-util histograms per mode); "
@@ -342,6 +347,59 @@ def main():
             "hbm_reduction_x": round(
                 contig_row["hbm_bytes_per_request"]
                 / max(paged_row["hbm_bytes_per_request"], 1e-9), 2
+            ),
+        }
+    }), flush=True)
+
+    if not args.quant:
+        return
+
+    # -- low-precision rows (docs/design/generation.md "Low-precision
+    # serving"): the SAME shared workload, first with int8 KV pages
+    # only (wide weights isolate the KV attribution), then with the
+    # int8 weight stream on top. Structural counts must match the wide
+    # paged leg exactly; tokens are compared per request (int8 KV is
+    # lossy, greedy argmax usually survives it). On chip the int8 TPU
+    # tile is (32, 128), so the non-tiny page_size of 64 is required —
+    # the tiny CPU rig runs the kernel in interpret mode where 16 is
+    # fine.
+    from d9d_tpu.loop.quantize import quantize_for_serving
+
+    quant_rows = {}
+    for label, quant_params in (
+        ("quant_kv_only", params),
+        ("quant_weights_kv", quantize_for_serving(params)),
+    ):
+        row, out = run_mode(
+            model, quant_params, shared, batch_size=args.batch_size,
+            chunk_size=k, overlap=True, page_size=page_size,
+            kv_quant="int8",
+        )
+        row["token_match_frac_vs_paged"] = sum(
+            out[i] == paged_out[i] for i in out
+        ) / max(len(out), 1)
+        quant_rows[label] = row
+        print(json.dumps({"mode": label, **{
+            kk: (round(v, 3) if isinstance(v, float) else v)
+            for kk, v in row.items()
+        }}), flush=True)
+    full = quant_rows["quant_weights_kv"]
+    print(json.dumps({
+        "quant_summary": {
+            "kv_hbm_frac_vs_paged": round(
+                full["hbm_bytes_per_request"]
+                / max(paged_row["hbm_bytes_per_request"], 1e-9), 4
+            ),
+            "added_dispatches_vs_paged": full["host_dispatches"]
+            - paged_row["host_dispatches"],
+            "added_readbacks_vs_paged": full["readbacks"]
+            - paged_row["readbacks"],
+            "steady_state_compiles": full["steady_state_compiles"],
+            "token_match_frac_vs_paged": round(
+                full["token_match_frac_vs_paged"], 3
+            ),
+            "speedup_vs_paged": round(
+                full["tok_per_s"] / max(paged_row["tok_per_s"], 1e-9), 3
             ),
         }
     }), flush=True)

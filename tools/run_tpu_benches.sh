@@ -366,6 +366,18 @@ run_leg "serving paged KV + prefix cache (shared-prefix workload)" \
   bench_results/serve_paged.jsonl \
   python tools/bench_serve.py --batch-size 4 --ks 8
 
+# r17: low-precision serving ON CHIP — int8 KV pages (f32 scale pages
+# riding the same page table) and the int8 weight stream, through the
+# non-interpret quantized pallas BlockSpec path (the non-tiny page
+# size of 64 satisfies the int8 (32,128) Mosaic tile). The quant rows
+# record structural-count parity with the wide paged leg, the
+# hbm-bytes-per-request fraction (CPU tier gates <= 0.5; this leg
+# prices it on real HBM), per-request token agreement under lossy KV,
+# and the decode tok/s delta the halved weight/KV stream buys.
+run_leg "serving low-precision (int8 weights + int8 KV pages)" \
+  bench_results/serve_quant.jsonl \
+  python tools/bench_serve.py --batch-size 4 --ks 8 --quant
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
