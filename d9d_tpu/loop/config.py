@@ -118,6 +118,20 @@ class TrainerConfig(pydantic.BaseModel):
     # train_slo/* gauges on /metrics. Active only with numerics enabled.
     numerics_drift: bool = True
 
+    # pipeline timeline cadence (pipelining/runtime/fused.py,
+    # docs/design/observability.md "Pipeline timeline & profiling"):
+    # every this-many steps the fused PP executor blocks per fused run,
+    # records each run's wall, and apportions it across the run's op
+    # manifest by kind-weighted shares — restoring the legacy
+    # interpreter's pp/s{S}/busy_s|bubble_s|bubble_frac gauges (plus the
+    # pp/bubble_frac rollup and per-run pp/run/r{R}/k{K}/wall_s) under
+    # runtime="fused". Cadence steps serialize the dispatch loop (the
+    # per-run block IS the measurement), so keep this sparse; off-cadence
+    # steps are structurally byte-identical (bench-gated: zero added
+    # dispatches/readbacks). None = compiled out (seed behavior). No-op
+    # under runtime="legacy", which always attributes.
+    pp_timeline_every_steps: int | None = pydantic.Field(default=None, ge=1)
+
     # ZeRO-style optimizer-state sharding (parallel/zero.py,
     # docs/design/zero_sharding.md): partition fp32 masters + Adam
     # moments across the dp_replicate mesh axis — grads reduce-scattered

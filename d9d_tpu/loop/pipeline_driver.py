@@ -370,7 +370,13 @@ class PipelineTrainEngine:
         with compat.set_mesh(self.ctx.stage_mesh(self.stage_owner[self.num_stages - 1])):
             return result.loss_sum / jnp.maximum(result.weight_sum, 1e-8)
 
-    def step(self, microbatches: list[PyTree], *, numerics: bool = False) -> dict:
+    def step(
+        self,
+        microbatches: list[PyTree],
+        *,
+        numerics: bool = False,
+        timeline: bool = False,
+    ) -> dict:
         """One optimizer step over the microbatch list → device metrics.
 
         ``numerics=True`` (cadence steps only, trainer-driven) dispatches
@@ -378,24 +384,32 @@ class PipelineTrainEngine:
         update donates params/grads/opt_state buffers) and folds the
         flat vectors into the metric dict as ``numerics/s{S}`` —
         off-cadence steps add zero dispatches to the controller loop.
-        """
-        if self._runtime == "fused" and self.numerics:
-            # the stats assembly is traced INTO each rank's last fused
-            # program behind a cond flag, so the program signature is
-            # fixed: the second-moment trees ride along every step (a
-            # host-side tree selection, no dispatch), and off-cadence
-            # steps compute a NaN fill instead of the stats
-            from d9d_tpu.telemetry.numerics import find_second_moments
 
-            moments = {
-                s: find_second_moments(self.opt_states[s], rt.params)
-                for s, rt in self.stages.items()
-            }
-            result = self.executor.step(
-                microbatches,
-                numerics_on=numerics,
-                numerics_moments=moments,
-            )
+        ``timeline=True`` (fused runtime only, trainer cadence
+        ``pp_timeline_every_steps``) serializes the fused dispatch loop
+        to attribute per-run wall and restore the ``pp/s{S}/*``
+        busy/bubble gauges; the legacy interpreter already attributes on
+        every step, so the flag is dropped there.
+        """
+        if self._runtime == "fused":
+            kwargs: dict = {"timeline": timeline}
+            if self.numerics:
+                # the stats assembly is traced INTO each rank's last
+                # fused program behind a cond flag, so the program
+                # signature is fixed: the second-moment trees ride along
+                # every step (a host-side tree selection, no dispatch),
+                # and off-cadence steps compute a NaN fill instead of
+                # the stats
+                from d9d_tpu.telemetry.numerics import find_second_moments
+
+                moments = {
+                    s: find_second_moments(self.opt_states[s], rt.params)
+                    for s, rt in self.stages.items()
+                }
+                kwargs.update(
+                    numerics_on=numerics, numerics_moments=moments
+                )
+            result = self.executor.step(microbatches, **kwargs)
         else:
             result = self.executor.step(microbatches)
         params = {s: rt.params for s, rt in self.stages.items()}

@@ -310,6 +310,18 @@ class Trainer:
             self.telemetry.configure_flight_recorder(
                 Path(config.telemetry_dir).parent
             )
+            # flight-recorder capture hook: an SLO-burn/anomaly dump
+            # kicks off a 1s on-demand profile (device trace + folded
+            # host stacks) into the telemetry dir's captures/ next to
+            # the metric windows — JobProfiler.capture degrades to None
+            # when the profiler is busy, and the recorder treats that
+            # as "no capture", never a failed dump
+            captures_dir = Path(config.telemetry_dir) / "captures"
+            recorder = self.telemetry.flight_recorder
+            if recorder is not None:
+                recorder.capture_hook = (
+                    lambda event: self.profiler.capture(1.0, captures_dir)
+                )
         # saving-mesh block for checkpoint manifests (elastic restore);
         # built lazily at the first save — placement is stable by then
         self._mesh_spec = None
@@ -452,9 +464,24 @@ class Trainer:
         nxt = self.stepper.step + 1
         return nxt % k == 0 or self._fetches_metrics(nxt)
 
+    def _pp_timeline_on(self) -> bool:
+        """Whether THIS step runs the fused pipeline timeline cadence
+        (``pp_timeline_every_steps``). Strictly the config cadence — NOT
+        folded with :meth:`_fetches_metrics` like numerics, because a
+        timeline step serializes the fused dispatch loop and that cost
+        should land only where the user asked for it."""
+        k = self.config.pp_timeline_every_steps
+        if k is None or self.pp_engine is None:
+            return False
+        return (self.stepper.step + 1) % k == 0
+
     def _optimizer_step(self, batch: PyTree) -> dict:
         if self.pp_engine is not None:
-            return self.pp_engine.step(batch, numerics=self._numerics_on())
+            return self.pp_engine.step(
+                batch,
+                numerics=self._numerics_on(),
+                timeline=self._pp_timeline_on(),
+            )
         rng = jax.random.fold_in(self.step_rng, self.stepper.step)
         self.step_fn.numerics_next = self._numerics_on()
         self.params, self.opt_state, metrics = self.step_fn(
@@ -629,8 +656,24 @@ class Trainer:
             # failure (port taken) must still run the finally that
             # detaches the sinks attached above
             if self.config.metrics_port is not None:
+                from pathlib import Path
+
                 from d9d_tpu.telemetry import MetricsServer
 
+                # /debug/profile backend: one-shot captures land in the
+                # telemetry dir's captures/ (falling back to the profile
+                # dir when no telemetry dir is configured); None when
+                # neither exists — the endpoint then answers 404
+                cap_base = (
+                    self.config.telemetry_dir or self.config.profile_dir
+                )
+                profile_backend = (
+                    (lambda d: self.profiler.capture(
+                        d, Path(cap_base) / "captures"
+                    ))
+                    if cap_base is not None
+                    else None
+                )
                 self.metrics_server = MetricsServer(
                     tele,
                     port=self.config.metrics_port,
@@ -640,6 +683,7 @@ class Trainer:
                         {"session_steps": self._session_steps},
                     ),
                     health=lambda: {"step": self.stepper.step},
+                    profile=profile_backend,
                 ).start()
             self.data_loader = self.dataset_provider.build()
             self.events.emit(ev.EVENT_DATA_LOADER_READY, trainer=self)

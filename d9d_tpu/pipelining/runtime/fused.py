@@ -119,6 +119,27 @@ __all__ = ["FusedPipelineExecutor"]
 # donating those would invalidate the caller's buffers mid-step
 _DONATABLE_KINDS = ("in", "fo", "cot", "gin", "g", "saved", "aux")
 
+# relative compute weight per op kind, used to apportion a fused run's
+# measured wall across its stages on timeline-cadence steps (and carried
+# per-op in the RunManifest so offline consumers can do the same with the
+# run's XLA cost_analysis FLOPs as the absolute anchor). bwd_full ≈ one
+# forward + one backward in a single VJP (the last stage additionally
+# folds its fwd_loss in under train); dI/dW splits are each ≈ one unit;
+# renames, aux summation and the numerics cond are ~free.
+_OP_WEIGHTS = {
+    "fwd": 1.0,
+    "fwd_loss": 1.0,
+    "fwd_out": 1.0,
+    "bwd_full": 2.0,
+    "bwd_dI": 1.0,
+    "bwd_dW": 1.0,
+    "bwd_dI_acts": 1.0,
+    "bwd_dW_acts": 1.0,
+    "send": 0.0,
+    "sum_aux": 0.0,
+    "numerics": 0.0,
+}
+
 
 @dataclasses.dataclass
 class _Op:
@@ -140,7 +161,7 @@ class _Run:
     __slots__ = (
         "rank", "index", "ops", "param_stages", "input_keys",
         "output_keys", "donate_keys", "drop_after", "fn", "label",
-        "_writes", "_reads",
+        "stage_shares", "_writes", "_reads",
     )
 
     def __init__(self, rank: int, index: int):
@@ -154,6 +175,7 @@ class _Run:
         self.drop_after: list[tuple] = []
         self.fn = None
         self.label = f"pp.run.r{rank}.{index}"
+        self.stage_shares: dict[int, float] = {}
         self._writes: set[tuple] = set()
         self._reads: set[tuple] = set()
 
@@ -556,6 +578,41 @@ class FusedPipelineExecutor:
             name=f"pp_fused/r{run.rank}/run{run.index}",
             donate_argnums=donate,
         )
+        # RunManifest: the run's ordered op descriptors, persisted on the
+        # program's ExecutableRecord (and therefore the `executable` JSONL
+        # sidecar + introspect inventory) at first compile. Offline
+        # consumers re-derive the same per-stage apportionment from the
+        # per-op `weight` column, anchored to the record's absolute
+        # cost_analysis FLOPs.
+        run.fn.manifest = {
+            "rank": run.rank,
+            "index": run.index,
+            "ops": [
+                {
+                    "kind": op.kind,
+                    "stage": op.stage,
+                    "mb": op.mb,
+                    "weight": _OP_WEIGHTS.get(op.kind, 1.0),
+                    "reads": [list(k) for k in op.reads],
+                    "writes": [list(k) for k in op.writes],
+                }
+                for op in run.ops
+            ],
+        }
+        # per-stage wall shares for the timeline cadence: kind-weighted
+        # op counts, normalized within the run (uniform over the run's
+        # param stages when every op is weightless)
+        weights: dict[int, float] = {}
+        for op in run.ops:
+            w = _OP_WEIGHTS.get(op.kind, 1.0)
+            if w > 0.0 and op.stage >= 0:
+                weights[op.stage] = weights.get(op.stage, 0.0) + w
+        total_w = sum(weights.values())
+        if total_w > 0.0:
+            run.stage_shares = {s: w / total_w for s, w in weights.items()}
+        elif run.param_stages:
+            u = 1.0 / len(run.param_stages)
+            run.stage_shares = {s: u for s in run.param_stages}
 
     def _trace_op(self, op: _Op, params: dict, env: dict) -> None:
         m = op.meta
@@ -713,12 +770,42 @@ class FusedPipelineExecutor:
         # (identity when no sharding is declared) carry over unchanged
         return put_compat(tree, sharding)
 
+    def _emit_timeline(self, run_walls, total: float, tele) -> None:
+        """Timeline-cadence attribution: apportion each fused run's
+        blocked wall across its stages by the run's kind-weighted op
+        shares, then emit the legacy interpreter's exact per-stage gauge
+        and counter set (executor.py's host-attributed block) plus the
+        ``pp/bubble_frac`` rollup and per-run ``pp/run/r{R}/k{K}/wall_s``.
+        Boundary transfers are not timed — their wall reads as bubble on
+        every stage, matching the MPMD convention that comm off the
+        critical path is idle time."""
+        busy = [0.0] * self.num_stages
+        for ent, wall in run_walls:
+            for s, frac in ent.stage_shares.items():
+                busy[s] += wall * frac
+            tele.gauge(
+                f"pp/run/r{ent.rank}/k{ent.index}/wall_s"
+            ).set(wall)
+        fracs = []
+        for s in range(self.num_stages):
+            bubble = max(total - busy[s], 0.0)
+            frac = bubble / total if total > 0 else 0.0
+            tele.gauge(f"pp/s{s}/busy_s").set(busy[s])
+            tele.gauge(f"pp/s{s}/bubble_s").set(bubble)
+            tele.gauge(f"pp/s{s}/bubble_frac").set(frac)
+            tele.counter(f"pp/s{s}/busy_total_s").add(busy[s])
+            tele.counter(f"pp/s{s}/bubble_total_s").add(bubble)
+            fracs.append(frac)
+        if fracs:
+            tele.gauge("pp/bubble_frac").set(sum(fracs) / len(fracs))
+
     def step(
         self,
         microbatches: list[PyTree],
         *,
         numerics_on: bool = False,
         numerics_moments: dict[int, PyTree] | None = None,
+        timeline: bool = False,
     ) -> PipelineExecutionResult:
         if len(microbatches) != self.num_microbatches:
             raise ValueError(
@@ -763,6 +850,7 @@ class FusedPipelineExecutor:
                     )
 
         dispatches = 0
+        run_walls: list[tuple[_Run, float]] = []
         for pos, ent in enumerate(self._seq):
             for k in self._stage_before[pos]:
                 env[k] = self._stage_ext(
@@ -771,8 +859,20 @@ class FusedPipelineExecutor:
             if isinstance(ent, _Run):
                 args = [self.stages[s].params for s in ent.param_stages]
                 args += [env[k] for k in ent.input_keys]
+                # timeline cadence: serialize the dispatch loop (block per
+                # run) so each run's wall is attributable. Off-cadence the
+                # only delta is this false host branch — zero added
+                # dispatches, transfers, or readbacks (bench-gate pinned).
+                t_run = time.perf_counter() if timeline else 0.0
                 with annotate(ent.label), self._mesh_scope(ent.rank):
                     outs = ent.fn(*args)
+                if timeline:
+                    # the timeline plane's one deliberate sync: only on
+                    # pp_timeline_every_steps cadence steps (timeline=False
+                    # skips it), where serializing the loop IS the measurement
+                    # d9d-lint: disable=D9D003 — cadence-only attribution sync
+                    jax.block_until_ready(outs)
+                    run_walls.append((ent, time.perf_counter() - t_run))
                 dispatches += 1
                 for k, v in zip(ent.output_keys, outs):
                     env[k] = v
@@ -803,6 +903,8 @@ class FusedPipelineExecutor:
         tele.gauge("pp/fused_dispatches").set(dispatches)
         tele.gauge("pp/fused_transfers").set(self.num_transfers)
         tele.gauge("pp/fused_programs").set(self.num_fused_programs)
+        if timeline:
+            self._emit_timeline(run_walls, total, tele)
 
         return PipelineExecutionResult(
             grads=(

@@ -79,6 +79,8 @@ __all__ = [
     "SloPolicy",
     "StreamingQuantileDigest",
     "FlightRecorder",
+    # host sampling profiler (telemetry/host_sampler.py)
+    "HostSampler",
     # training numerics plane (telemetry/numerics.py)
     "DriftPolicy",
     "NumericsMonitor",
@@ -196,6 +198,14 @@ class Telemetry:
         for sink in self.sinks:
             sink.on_numerics(record)
 
+    def record_host_stacks(self, record: dict[str, Any]) -> None:
+        """Stream one folded controller-stack window (schema v5
+        ``host_stacks``, telemetry/host_sampler.py) to every sink —
+        emitted once per profiling capture window, never on the step
+        path."""
+        for sink in self.sinks:
+            sink.on_host_stacks(record)
+
     def flush(self, step: int | None = None) -> dict[str, Any]:
         """Snapshot every instrument and hand it to each sink; returns
         the snapshot (callers fold headline values into their own logs).
@@ -298,6 +308,7 @@ from d9d_tpu.telemetry.export import (  # noqa: E402
     render_prometheus,
 )
 from d9d_tpu.telemetry.flight_recorder import FlightRecorder  # noqa: E402
+from d9d_tpu.telemetry.host_sampler import HostSampler  # noqa: E402
 from d9d_tpu.telemetry.slo import (  # noqa: E402
     SloMonitor,
     SloPolicy,

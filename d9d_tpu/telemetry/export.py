@@ -49,6 +49,8 @@ import logging
 import math
 import re
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
@@ -168,6 +170,14 @@ class MetricsServer:
     dict (per-replica status for a fleet). Both run inside scrape
     handling — keep them host-only and cheap. Exceptions in either
     degrade to unhealthy/unready responses, never to a dead endpoint.
+
+    ``profile`` is the optional on-demand capture backend behind
+    ``GET /debug/profile?duration_s=``: a callable taking the duration
+    and returning the capture path, or ``None`` while a capture is
+    already live (``JobProfiler.capture``'s exact contract). Without a
+    backend the endpoint answers 404; requests are rate-limited to one
+    per ``profile_min_interval_s`` (429), errors degrade to 500 — the
+    endpoint never raises and never touches the step path.
     """
 
     def __init__(
@@ -178,6 +188,8 @@ class MetricsServer:
         host: str = "127.0.0.1",
         readiness: Callable[[], Any] | None = None,
         health: Callable[[], dict] | None = None,
+        profile: Callable[[float], Any] | None = None,
+        profile_min_interval_s: float = 30.0,
         prefix: str = "d9d",
     ):
         if telemetry is None:
@@ -189,6 +201,9 @@ class MetricsServer:
         self._want_port = int(port)
         self._readiness = readiness
         self._health = health
+        self.profile = profile
+        self.profile_min_interval_s = profile_min_interval_s
+        self._profile_last_t = -math.inf
         self._prefix = prefix
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -225,6 +240,42 @@ class MetricsServer:
         )
         return (200 if ready else 503), {"ready": bool(ready), **detail}
 
+    def profile_body(self, query: str) -> tuple[int, dict]:
+        """The /debug/profile body. Status codes are the operator
+        contract: 404 no backend wired, 400 bad duration, 429 rate
+        limited, 503 a capture is already live, 500 backend error, 200
+        with the capture path on success."""
+        if self.profile is None:
+            return 404, {"error": "no profiling backend wired"}
+        try:
+            params = urllib.parse.parse_qs(query)
+            duration = float(params.get("duration_s", ["2.0"])[0])
+        except (ValueError, TypeError):
+            return 400, {"error": "duration_s must be a number"}
+        if not (0.0 < duration <= 60.0):
+            return 400, {
+                "error": "duration_s must be in (0, 60]",
+                "duration_s": duration,
+            }
+        now = time.monotonic()
+        if now - self._profile_last_t < self.profile_min_interval_s:
+            return 429, {
+                "error": "rate limited",
+                "retry_after_s": round(
+                    self.profile_min_interval_s
+                    - (now - self._profile_last_t), 1
+                ),
+            }
+        try:
+            out = self.profile(duration)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            logger.exception("on-demand profile capture failed")
+            return 500, {"error": repr(e)}
+        if out is None:
+            return 503, {"busy": True, "error": "a capture is live"}
+        self._profile_last_t = now
+        return 200, {"capture": str(out), "duration_s": duration}
+
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "MetricsServer":
@@ -244,7 +295,7 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         self._send(
@@ -259,6 +310,12 @@ class MetricsServer:
                         )
                     elif path == "/readyz":
                         code, body = outer.ready_body()
+                        self._send(
+                            code, json.dumps(body).encode(),
+                            "application/json",
+                        )
+                    elif path == "/debug/profile":
+                        code, body = outer.profile_body(query)
                         self._send(
                             code, json.dumps(body).encode(),
                             "application/json",

@@ -66,6 +66,14 @@ class FlightRecorder:
         self.span_tail = int(span_tail)
         self.min_interval_s = float(min_interval_s)
         self._last_dump: dict[str, float] = {}
+        # optional profiling capture hook (``(event) -> Path | None``,
+        # trainer-wired to ``JobProfiler.capture``): when set, each dump
+        # additionally kicks off a short on-demand profile so an
+        # SLO-burn or anomaly record carries device+host timeline
+        # evidence, not just metric windows. Best-effort like everything
+        # here — a hook failure or a busy profiler degrades to "no
+        # capture", never to a failed dump.
+        self.capture_hook = None
 
     def dump(
         self,
@@ -83,6 +91,15 @@ class FlightRecorder:
             return None
         self._last_dump[event] = now
         try:
+            capture = None
+            if self.capture_hook is not None:
+                try:
+                    capture = self.capture_hook(event)
+                except Exception:  # noqa: BLE001 — the dump proceeds
+                    logger.exception(
+                        "flight recorder: capture hook for %r failed",
+                        event,
+                    )
             spans = list(registry.spans)[-self.span_tail:]
             try:
                 from d9d_tpu.telemetry.introspect import inventory
@@ -111,6 +128,10 @@ class FlightRecorder:
                 # flush ring's "when"
                 **({"numerics": _jsonable(numerics)} if numerics else {}),
                 **({"extra": _jsonable(extra)} if extra else {}),
+                **(
+                    {"profile_capture": str(capture)}
+                    if capture is not None else {}
+                ),
             }
             self.directory.mkdir(parents=True, exist_ok=True)
             path = self.directory / f"flight_recorder_{event}.json"

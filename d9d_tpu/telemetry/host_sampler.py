@@ -1,0 +1,133 @@
+"""Host sampling profiler: folded controller-thread stacks, stdlib only.
+
+Single-controller JAX means one Python thread owns every dispatch, every
+``block_until_ready``, every input-pipeline wait — when a step is slow
+for host reasons (the dispatch tax the fused PP runtime attacks, data
+stalls, stray Python overhead) the evidence is the controller's call
+stack over time, and no device trace shows it. This module is the
+in-process answer: a daemon thread polls ``sys._current_frames()`` for
+the target thread at a fixed interval during capture windows (the
+``JobProfiler`` one-shots driven by ``/debug/profile`` and the
+``FlightRecorder`` capture hook), folds each sample into a
+``outer;...;leaf`` stack string, and emits one schema-v5 ``host_stacks``
+event per window. ``trace_export`` renders the window as a
+``host_sampler`` Perfetto track next to the fused-run spans, so
+data_wait vs dispatch vs Python overhead is attributable in the same
+timeline without py-spy or any external tooling.
+
+Cost model: off-window the sampler does not exist (nothing is started).
+In-window it is one daemon thread doing a dict lookup + frame walk per
+interval (default 10 ms → ~100 folds/s); ``sys._current_frames()`` holds
+the GIL only for the snapshot, so the controller is perturbed by at most
+the fold time. Sample counts, not wall time, are the fidelity unit:
+``stacks`` maps folded stack → hit count, and consumers scale by
+``dur_s / samples``.
+"""
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from pathlib import Path
+from typing import Any
+
+__all__ = ["HostSampler"]
+
+# frames from these files are the sampler observing itself (the target
+# thread is never this thread, but a stack can end inside threading
+# internals when the controller is between frames) — kept, not filtered:
+# honesty beats cosmetics, and the fold depth bound below is the only
+# shaping we do
+_MAX_DEPTH = 64
+
+
+def _fold(frame) -> str:
+    """``outer;...;leaf`` fold of a frame chain (Brendan Gregg folded
+    format, the flamegraph/Perfetto lingua franca), innermost last."""
+    parts: list[str] = []
+    depth = 0
+    while frame is not None and depth < _MAX_DEPTH:
+        code = frame.f_code
+        parts.append(
+            f"{Path(code.co_filename).name}:{code.co_name}:"
+            f"{frame.f_lineno}"
+        )
+        frame = frame.f_back
+        depth += 1
+    parts.reverse()
+    return ";".join(parts) if parts else "<no frames>"
+
+
+class HostSampler:
+    """Sample one thread's Python stack on a fixed cadence.
+
+    ``start()`` spawns the daemon sampler thread; ``stop()`` joins it and
+    returns the window's ``host_stacks`` event dict (also handed to
+    ``telemetry.record_host_stacks`` by the callers that own a capture
+    window). Re-startable; never raises from the sampling loop — a
+    target thread that exits mid-window simply stops accumulating.
+    """
+
+    def __init__(
+        self,
+        *,
+        target_tid: int | None = None,
+        interval_s: float = 0.01,
+        thread_name: str = "controller",
+    ):
+        if target_tid is None:
+            target_tid = threading.main_thread().ident
+        self.target_tid = target_tid
+        self.interval_s = interval_s
+        self.thread_name = thread_name
+        self._stacks: Counter[str] = Counter()
+        self._samples = 0
+        self._t0 = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stacks = Counter()
+        self._samples = 0
+        self._stop.clear()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._loop, name="d9d-host-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                frame = sys._current_frames().get(self.target_tid)
+                if frame is None:
+                    continue
+                self._stacks[_fold(frame)] += 1
+                self._samples += 1
+            except Exception:  # pragma: no cover — observability never
+                # takes the job down; a single bad sample is dropped
+                traceback.clear_frames(sys.exc_info()[2])
+
+    def stop(self) -> dict[str, Any]:
+        """Stop sampling and return the window's ``host_stacks`` event
+        body (no ``kind`` key — the sink adds it)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        dur = time.perf_counter() - self._t0
+        return {
+            "t0": self._t0,
+            "dur_s": dur,
+            "interval_s": self.interval_s,
+            "samples": self._samples,
+            "thread": self.thread_name,
+            "stacks": dict(self._stacks),
+        }

@@ -85,6 +85,12 @@ class ExecutableRecord:
     # populated when audit capture is opted in — collective census,
     # donation coverage, baked consts, dtype census, host callbacks
     audit: dict[str, Any] | None = None
+    # schedule manifest (optional, set by the producing component before
+    # first call — the fused PP executor attaches its per-run op list:
+    # rank, run index, ordered ops with stage/kind/microbatch and
+    # declared read/write value keys). Rides the same executable event
+    # as ``audit`` — optional fields need no schema bump.
+    manifest: dict[str, Any] | None = None
 
     @property
     def hbm_peak_bytes(self) -> int | None:
@@ -135,6 +141,8 @@ class ExecutableRecord:
             ev["hbm"] = hbm
         if self.audit is not None:
             ev["audit"] = self.audit
+        if self.manifest is not None:
+            ev["manifest"] = self.manifest
         return ev
 
 
@@ -305,6 +313,12 @@ class TrackedJit:
         # kept for the audit-capture donation check (declared donated
         # buffers are counted against the concrete call arguments)
         self._jit_kwargs = dict(jit_kwargs)
+        # schedule manifest (ExecutableRecord.manifest): producers that
+        # know the program's internal structure (the fused PP executor's
+        # per-run op list) set this BEFORE the first call; every record
+        # this wrapper files then carries it into the JSONL sidecar and
+        # the introspection inventory
+        self.manifest: dict[str, Any] | None = None
         self._compiled: dict[Any, Any] = {}
         self._records: dict[Any, ExecutableRecord] = {}
         self._fallback = False
@@ -394,6 +408,8 @@ class TrackedJit:
                 "generated_code_size_in_bytes"
             )
             record.alias_bytes = ma.get("alias_size_in_bytes")
+        if self.manifest is not None:
+            record.manifest = self.manifest
 
         if capture:
             try:

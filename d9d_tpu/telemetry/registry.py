@@ -51,7 +51,10 @@ __all__ = [
 # v4: adds the ``numerics`` event kind (per-layer training tensor
 # statistics windows from telemetry/numerics.py); v1-v3 files remain
 # readable.
-SCHEMA_VERSION = 4
+# v5: adds the ``host_stacks`` event kind (folded controller-thread
+# stack samples from telemetry/host_sampler.py, one event per capture
+# window); v1-v4 files remain readable.
+SCHEMA_VERSION = 5
 
 
 def exp_edges(lo: float, hi: float, bins: int) -> tuple[float, ...]:

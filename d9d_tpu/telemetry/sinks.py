@@ -54,6 +54,8 @@ class TelemetrySink:
 
     def on_numerics(self, record: dict[str, Any]) -> None: ...
 
+    def on_host_stacks(self, record: dict[str, Any]) -> None: ...
+
     def close(self) -> None: ...
 
 
@@ -133,6 +135,13 @@ class JsonlSink(TelemetrySink):
         # per-layer numerics windows (schema v4): one event per cadence
         # window, buffered like spans (the flush cadence bounds loss)
         self._write({"kind": "numerics", **record})
+
+    def on_host_stacks(self, record: dict[str, Any]) -> None:
+        # folded controller-stack windows (schema v5): captures are rare
+        # operator actions — flush immediately so a crash right after a
+        # capture still leaves its samples on disk
+        self._write({"kind": "host_stacks", **record})
+        self._fh.flush()
 
     def on_flush(self, snapshot: dict[str, Any], step: int | None) -> None:
         self._file()  # ensure the meta header exists even for span-free runs
@@ -272,6 +281,7 @@ _REQUIRED = {
     "executable": ("name", "signature", "lower_s", "compile_s"),
     "request_trace": ("trace_id", "event", "t"),
     "numerics": ("step", "rows"),
+    "host_stacks": ("t0", "dur_s", "stacks"),
 }
 
 
@@ -279,8 +289,9 @@ def validate_event(event: dict[str, Any]) -> None:
     """Raise ``ValueError`` if ``event`` is not a well-formed telemetry
     event (the contract bench harness tests pin). Files written by any
     schema version up to the current one stay readable — v2 added the
-    ``executable`` kind, v3 the ``request_trace`` kind and v4 the
-    ``numerics`` kind, which older files simply never contain."""
+    ``executable`` kind, v3 the ``request_trace`` kind, v4 the
+    ``numerics`` kind and v5 the ``host_stacks`` kind, which older
+    files simply never contain."""
     kind = event.get("kind")
     if kind not in _REQUIRED:
         raise ValueError(f"unknown event kind {kind!r}")

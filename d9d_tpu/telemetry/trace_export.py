@@ -34,6 +34,12 @@ Perfetto JSON (``{"traceEvents": [...]}``) in which:
   one ``numerics/{layer}/grad_rms`` series per parameter row, so a
   layer's gradient drifting away from its siblings is visible as a
   diverging counter lane next to the ``train/step`` spans;
+- **host_stacks** events (schema v5 — folded controller-thread stack
+  samples from ``telemetry/host_sampler.py``, one per profiling capture
+  window) become a ``host_sampler`` track: the window is tiled with one
+  ``"X"`` span per distinct stack, width proportional to its sample
+  count, so host time (data_wait vs dispatch vs Python overhead) reads
+  as a flamegraph-like lane next to the fused-run spans;
 - process/thread ``"M"`` metadata events name every lane.
 
 The output ordering is deterministic (sorted by timestamp, then pid,
@@ -234,6 +240,38 @@ def merge_to_chrome_trace(paths: Iterable[str | Path]) -> dict[str, Any]:
                         "cat": "numerics",
                         "args": {"value": value},
                     })
+            elif kind == "host_stacks":
+                # folded controller-stack window (schema v5,
+                # telemetry/host_sampler.py): render the window as one
+                # "X" span per distinct stack on a host_sampler lane,
+                # widths proportional to hit counts laid end to end
+                # (heaviest first), named by the leaf frame with the
+                # full fold in args — a poor man's flamegraph that sits
+                # time-aligned next to the fused-run spans
+                samples = ev.get("samples", 0)
+                stacks = ev.get("stacks", {})
+                if samples and stacks:
+                    tid = tid_of(
+                        f"host_sampler/{ev.get('thread', 'thread')}"
+                    )
+                    per_sample = ev["dur_s"] / samples
+                    cursor = ev["t0"]
+                    order = sorted(
+                        stacks.items(), key=lambda kv: (-kv[1], kv[0])
+                    )
+                    for fold, count in order:
+                        dur = count * per_sample
+                        leaf = fold.rsplit(";", 1)[-1]
+                        trace_events.append({
+                            "ph": "X", "pid": pid, "tid": tid,
+                            "ts": wall_us(cursor), "dur": dur * 1e6,
+                            "name": leaf, "cat": "host_stacks",
+                            "args": {
+                                "stack": fold, "samples": count,
+                                "frac": count / samples,
+                            },
+                        })
+                        cursor += dur
             elif kind == "executable":
                 # no per-event timestamp: pin to the compile span's lane
                 # at the file's own meta time + accumulated order is not

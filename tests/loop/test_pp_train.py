@@ -166,6 +166,45 @@ def test_pp_virtual_stages_and_export(devices):
     assert any("lm_head" in n for n in names)
 
 
+def test_pp_timeline_cadence_populates_stage_gauges(devices):
+    """`pp_timeline_every_steps` wires trainer → driver → fused executor
+    (docs/design/observability.md "Pipeline timeline & profiling"):
+    cadence steps populate every per-stage busy/bubble gauge, the
+    `pp/bubble_frac` rollup, and per-run walls."""
+    from d9d_tpu.telemetry import Telemetry, get_telemetry, set_telemetry
+
+    set_telemetry(Telemetry())  # executors cache the hub at build time
+    ctx = MeshParameters(pp=4, dp_shard=2).build(devices)
+    trainer = Trainer(
+        ctx=ctx,
+        config=TrainerConfig(
+            global_batch_size=16,
+            microbatch_size=4,
+            seq_len=16,
+            total_steps=STEPS,
+            log_every=1,
+            pipeline={"kind": "interleaved_1f1b"},
+            pp_timeline_every_steps=2,
+            learning_rate=1e-2,
+        ),
+        model_provider=Provider(False),
+        dataset_provider=Data(),
+        task=CausalLMTask(),
+        optimizer_provider=AdamWProvider(),
+    )
+    hist = trainer.train()
+    assert all(np.isfinite(h["loss"]) for h in hist)
+    gauges = get_telemetry().registry.snapshot()["gauges"]
+    for s in range(4):
+        assert gauges[f"pp/s{s}/busy_s"] > 0.0, f"stage {s}"
+        assert gauges[f"pp/s{s}/bubble_s"] >= 0.0
+        assert 0.0 <= gauges[f"pp/s{s}/bubble_frac"] <= 1.0
+    assert 0.0 <= gauges["pp/bubble_frac"] <= 1.0
+    assert any(
+        k.startswith("pp/run/") and k.endswith("/wall_s") for k in gauges
+    )
+
+
 def test_pp_checkpoint_resume_bitwise(devices, tmp_path):
     """Mid-run crash + resume reproduces the uninterrupted run exactly."""
     from d9d_tpu.loop import StatefulDataLoader

@@ -182,6 +182,83 @@ def test_readiness_exception_reads_as_not_ready():
         server.close()
 
 
+def test_debug_profile_endpoint_contract(tmp_path):
+    """/debug/profile status ladder (the operator contract from
+    docs/design/observability.md): 404 without a backend; with one —
+    400 on a bad duration (never reaching the backend), 200 carrying
+    the capture dir, 429 inside the rate-limit window."""
+    hub = Telemetry()
+    server = MetricsServer(hub, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/debug/profile"))
+        assert exc.value.code == 404
+    finally:
+        server.close()
+
+    calls = []
+
+    def backend(duration_s):
+        calls.append(duration_s)
+        return tmp_path / "cap0"
+
+    server = MetricsServer(
+        hub, port=0, profile=backend, profile_min_interval_s=30.0
+    ).start()
+    try:
+        for bad in ("0", "100", "nope"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(server.url(f"/debug/profile?duration_s={bad}"))
+            assert exc.value.code == 400
+        assert calls == []  # bad requests never reach the backend
+        code, body = _get(server.url("/debug/profile?duration_s=1.5"))
+        assert code == 200
+        got = json.loads(body)
+        assert got["capture"].endswith("cap0")
+        assert got["duration_s"] == 1.5
+        assert calls == [1.5]
+        # inside the rate-limit window: 429, backend untouched
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/debug/profile"))
+        assert exc.value.code == 429
+        assert calls == [1.5]
+    finally:
+        server.close()
+
+
+def test_debug_profile_busy_and_failure_codes():
+    """A live capture (backend returns None) answers 503; a raising
+    backend answers 500 — neither takes down the server, and neither
+    consumes the rate-limit budget (last_t moves only on success)."""
+    hub = Telemetry()
+    server = MetricsServer(
+        hub, port=0, profile=lambda d: None, profile_min_interval_s=0.0
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/debug/profile"))
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["busy"] is True
+    finally:
+        server.close()
+
+    def broken(duration_s):
+        raise RuntimeError("boom")
+
+    server = MetricsServer(
+        hub, port=0, profile=broken, profile_min_interval_s=0.0
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/debug/profile"))
+        assert exc.value.code == 500
+        # the server survives the backend failure
+        code, _ = _get(server.url("/metrics"))
+        assert code == 200
+    finally:
+        server.close()
+
+
 def test_scrape_evaluates_attached_slo_monitor():
     """Polling only /metrics must still refresh burn rates — the scrape
     evaluates the hub's SLO monitor before rendering."""

@@ -48,7 +48,7 @@ def test_trace_summary_attribution_helpers():
 
 
 def _write_proc_log(path, *, process_index, unix_time, perf_counter,
-                    spans, counters=None):
+                    spans, counters=None, gauges=None):
     """Synthetic JsonlSink file: meta header (the clock pair the merge
     rebases on) + spans on that process's PRIVATE monotonic clock."""
     events = [{
@@ -61,10 +61,11 @@ def _write_proc_log(path, *, process_index, unix_time, perf_counter,
             "kind": "span", "name": name, "t0": t0, "dur_s": dur_s,
             "step": step,
         })
-    if counters:
+    if counters or gauges:
         events.append({
             "kind": "flush", "step": 0, "unix_time": unix_time + 1.0,
-            "counters": counters, "gauges": {}, "histograms": {},
+            "counters": counters or {}, "gauges": gauges or {},
+            "histograms": {},
         })
     with open(path, "w") as fh:
         for ev in events:
@@ -412,6 +413,109 @@ def test_cli_numerics_errors_without_telemetry_inputs(tmp_path):
     )
     assert out.returncode != 0
     assert "--numerics needs telemetry JSONL inputs" in out.stderr
+
+
+def test_trace_summary_pp_timeline_tables(tmp_path, capsys):
+    """--pp-timeline prints the per-stage busy/bubble table and the
+    per-run wall table from the final flush's pipeline-timeline gauges
+    (the fused runtime's pp_timeline_every_steps cadence surface)."""
+    from tests.conftest import load_repo_module
+
+    ts = load_repo_module("trace_summary", "tools/trace_summary.py")
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "ppt_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0, spans=[],
+        gauges={
+            "pp/s0/busy_s": 0.6, "pp/s0/bubble_s": 0.4,
+            "pp/s0/bubble_frac": 0.4,
+            "pp/s1/busy_s": 0.3, "pp/s1/bubble_s": 0.7,
+            "pp/s1/bubble_frac": 0.7,
+            "pp/bubble_frac": 0.55,
+            "pp/run/r0/k0/wall_s": 0.8,
+            "pp/run/r1/k2/wall_s": 0.2,
+            "train/mfu": 0.4,  # unrelated gauge must not leak in
+        },
+    )
+    ts.summarize_telemetry([path], top=10, pp_timeline=True)
+    out = capsys.readouterr().out
+    assert "pp timeline — per-stage attribution" in out
+    assert "pp timeline — per-run wall" in out
+    assert "rollup pp/bubble_frac = 0.550" in out
+    # stage table carries the busy/bubble values; the unrelated gauge
+    # stays out of the timeline section
+    assert "0.6000" in out and "0.7000" in out
+    assert "train/mfu" not in out.split("final flush")[0]
+    # run table sorted by (rank, run)
+    r0 = out.index("   0     0      0.8000")
+    r1 = out.index("   1     2      0.2000")
+    assert r0 < r1
+    # empty logs explain how to enable the plane instead of crashing
+    ts.print_pp_timeline({})
+    assert "pp_timeline_every_steps" in capsys.readouterr().out
+
+
+def test_cli_pp_timeline_errors_without_telemetry_inputs(tmp_path):
+    """--pp-timeline against a dir with no telemetry JSONL must fail
+    loudly (the --numerics/--audit shape), not silently fall through to
+    profiler mode."""
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(root / "tools" / "trace_summary.py"),
+         str(tmp_path), "--pp-timeline"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode != 0
+    assert "--pp-timeline needs telemetry JSONL inputs" in out.stderr
+
+
+def test_perfetto_renders_host_stacks_track(tmp_path):
+    """Schema-v5 host_stacks windows become a host_sampler lane tiled
+    with per-stack spans, widths proportional to sample counts,
+    heaviest stack first, leaf-frame names with the full fold in args."""
+    from d9d_tpu.telemetry.trace_export import merge_to_chrome_trace
+
+    wall = 1_700_000_000.0
+    path = _write_proc_log(
+        tmp_path / "hs_proc0.jsonl", process_index=0,
+        unix_time=wall, perf_counter=0.0, spans=[],
+    )
+    with open(path, "a") as fh:
+        fh.write(json.dumps({
+            "kind": "host_stacks", "t0": 2.0, "dur_s": 1.0,
+            "interval_s": 0.01, "samples": 100, "thread": "controller",
+            "stacks": {
+                "train.py:loop:10;api.py:block_until_ready:99": 75,
+                "train.py:loop:10;loader.py:next_batch:42": 25,
+            },
+        }) + "\n")
+    trace = merge_to_chrome_trace([path])
+    lanes = {
+        e["tid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    hs_tids = [t for t, n in lanes.items() if n == "host_sampler/controller"]
+    assert len(hs_tids) == 1
+    xs = sorted(
+        (e for e in trace["traceEvents"]
+         if e["ph"] == "X" and e["tid"] == hs_tids[0]),
+        key=lambda e: e["ts"],
+    )
+    assert [e["name"] for e in xs] == [
+        "api.py:block_until_ready:99", "loader.py:next_batch:42",
+    ]
+    # tiles the window: heaviest first at t0, widths ∝ sample counts
+    assert xs[0]["ts"] == 2_000_000.0
+    assert xs[0]["dur"] == 750_000.0
+    assert xs[1]["ts"] == 2_750_000.0
+    assert xs[1]["dur"] == 250_000.0
+    assert xs[0]["args"]["frac"] == 0.75
+    assert "block_until_ready" in xs[0]["args"]["stack"]
 
 
 def test_perfetto_merge_rejects_headerless_files(tmp_path):

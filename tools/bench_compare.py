@@ -528,6 +528,78 @@ def run_micro() -> dict:
     }
 
 
+EXPORTER_CONTENTION_CAVEAT = (
+    "note serve_micro.exporter_overhead_frac breached: on the 2-core CI "
+    "rig the exporter's endpoint thread contends with the serving loop "
+    "for the same cores, so this wall-clock leg is flaky-by-construction "
+    "under load — re-running the plain+exporter timing legs once in "
+    "isolation before failing the gate"
+)
+
+
+def rerun_exporter_overhead() -> float:
+    """Isolated re-measure of ``serve_micro.exporter_overhead_frac``:
+    the plain and exporter timing legs only, back to back, with nothing
+    else from the microbench running. ``main`` calls this exactly once
+    when the full-run gate fails on this metric ALONE — by the time it
+    runs, every other leg's batchers/servers/threads are closed, so the
+    contention that makes the in-run number flaky is gone. Structural
+    exporter metrics are NOT re-derived (they are deterministic and not
+    contention-sensitive; a structural failure is real)."""
+    from tools.bench_serve import build_model, make_workload
+
+    from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.telemetry import (
+        MetricsServer,
+        SloMonitor,
+        SloPolicy,
+        get_telemetry,
+    )
+
+    model, params, cfg = build_model(tiny=True)
+    workload = make_workload(
+        vocab=cfg.vocab_size, requests=MICRO["requests"], seed=0,
+        prompt_lo=2, prompt_hi=6, gen_lo=MICRO["gen_lo"],
+        gen_hi=MICRO["gen_hi"],
+        mean_interarrival=MICRO["gen_hi"] / MICRO["batch_size"],
+    )
+    k = MICRO["chunk_k"]
+    batcher = ContinuousBatcher(
+        model, params, batch_size=MICRO["batch_size"],
+        chunk_size=k, overlap=True,
+    )
+    batcher.submit(workload[0][1], max_new_tokens=2 * k + 2)
+    batcher.drain()
+    batcher.reset_measurement()
+    dt = _drive_micro(batcher, workload, params)
+
+    # same always-on monitoring plane as the in-run exporter leg (labels,
+    # SLO observers, live endpoint thread); the mid-run scrape lands
+    # outside the timed window there, so it is not replicated here
+    exp = ContinuousBatcher(
+        model, params, batch_size=MICRO["batch_size"],
+        chunk_size=k, overlap=True, replica_label="r0",
+    )
+    monitor = SloMonitor([
+        SloPolicy(name="bench_ttft_p99", metric="serve/ttft_s",
+                  quantile=0.99, target=60.0, window_s=60.0),
+        SloPolicy(name="bench_miss_rate", kind="rate",
+                  bad="serve/expired", good=("serve/requests_finished",),
+                  target=0.01, window_s=60.0),
+    ]).attach(get_telemetry())
+    server = MetricsServer(port=0).start()
+    try:
+        exp.submit(workload[0][1], max_new_tokens=2 * k + 2)
+        exp.drain()
+        exp.reset_measurement()
+        dt_exp = _drive_micro(exp, workload, params)
+    finally:
+        server.close()
+        monitor.detach()
+        exp.close()
+    return round((dt_exp - dt) / dt, 4)
+
+
 TRAIN_MICRO = dict(steps=6, cadence=3, num_microbatches=2)
 
 
@@ -673,8 +745,12 @@ def run_pp_micro() -> dict:
     dispatches at the one point both runtimes share —
     ``TrackedJit.__call__``. Gated facts: the tiny 1F1B step fuses into
     ONE program, dispatches drop ≥5× (the measured ratio is pinned
-    exactly — both counts are structural, not wall-clock), and the
-    fused loss/grads are BIT-identical to the legacy executor's.
+    exactly — both counts are structural, not wall-clock), the fused
+    loss/grads are BIT-identical to the legacy executor's, and a
+    ``timeline=True`` step (the pp timeline plane's cadence step,
+    docs/design/observability.md "Pipeline timeline & profiling")
+    dispatches EXACTLY the same programs as a plain step — the
+    attribution is pure host-side timing, zero added executables.
     """
     import flax.linen as nn
     import jax
@@ -780,14 +856,22 @@ def run_pp_micro() -> dict:
             counter["n"] = 0
             fused.step(list(mbs))
             fused_n = counter["n"]
+            # a timeline (cadence) step times runs on the host and
+            # blocks between them — it must dispatch the SAME programs
+            counter["n"] = 0
+            fused.step(list(mbs), timeline=True)
+            timeline_extra = counter["n"] - fused_n
         finally:
             TrackedJit.__call__ = orig_call
-        return legacy_n, fused_n, fused.num_fused_programs, exact
+        return (
+            legacy_n, fused_n, fused.num_fused_programs, exact,
+            timeline_extra,
+        )
 
     tiny = Interleaved1F1BProgramBuilder(1, PP_MICRO["stages_per_rank"])
-    legacy_n, fused_n, programs, exact = drive(tiny)
+    legacy_n, fused_n, programs, exact, timeline_extra = drive(tiny)
     multi = Interleaved1F1BProgramBuilder(PP_MICRO["multirank_pp"])
-    ml_n, mf_n, m_programs, m_exact = drive(multi)
+    ml_n, mf_n, m_programs, m_exact, _ = drive(multi)
     return {
         "pp_micro.dispatches_per_step": fused_n,
         "pp_micro.fused_programs": programs,
@@ -800,6 +884,10 @@ def run_pp_micro() -> dict:
             ml_n / max(mf_n, 1), 2
         ),
         "pp_micro.multirank_exact_vs_legacy": m_exact,
+        # timeline-on step vs plain step: the per-run wall attribution
+        # is host-side only, so a cadence step adds ZERO dispatches
+        # (zero-baseline at rel_tol 0 — any positive count fails)
+        "pp_micro.timeline_extra_dispatches": timeline_extra,
     }
 
 
@@ -946,6 +1034,23 @@ def main(argv=None) -> int:
         return 2
 
     ok, lines = compare(current, baseline)
+    exporter_rerun = False
+    if not ok and args.run_micro:
+        # the one known-flaky wall-clock leg: when it is the ONLY
+        # failure, re-measure it once in isolation instead of failing
+        # (docs/design/observability.md "Perf-regression gate").
+        # --current snapshots never re-run — their rc must stay a pure
+        # function of the file's contents.
+        failing = [ln for ln in lines if ln.startswith("FAIL")]
+        if failing and all(
+            "serve_micro.exporter_overhead_frac" in ln for ln in failing
+        ):
+            print(EXPORTER_CONTENTION_CAVEAT)
+            current["metrics"]["serve_micro.exporter_overhead_frac"] = (
+                rerun_exporter_overhead()
+            )
+            exporter_rerun = True
+            ok, lines = compare(current, baseline)
     for line in lines:
         print(line)
     print(json.dumps({
@@ -953,6 +1058,7 @@ def main(argv=None) -> int:
             "ok": ok,
             "baseline": str(args.baseline),
             "gated_metrics": len(baseline.get("metrics", {})),
+            "exporter_rerun": exporter_rerun,
         }
     }))
     return 0 if ok else 1
@@ -973,11 +1079,11 @@ def default_thresholds(metrics: dict) -> dict:
             # the 2% monitoring-plane budget is the CONTRACT value, not
             # the measured one (CI noise can even make it negative); the
             # wide rel_tol makes the CI gate a 20% collapse floor — the
-            # strict 2% check is the chip leg's job. On the 2-core CI
-            # rig the exporter's endpoint thread contends with the
-            # serving loop for the same cores, so a breach here is
-            # flaky-by-construction: re-run this leg in ISOLATION
-            # (nothing else on the box) before reading it as real
+            # strict 2% check is the chip leg's job. A breach under
+            # --run-micro triggers ONE automatic isolated re-measure
+            # (rerun_exporter_overhead) before the gate fails: the
+            # 2-core-contention flake is the tool's problem, not the
+            # operator's
             specs[name] = {
                 "value": 0.02, "direction": "lower", "rel_tol": 9.0,
             }

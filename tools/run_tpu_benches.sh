@@ -464,6 +464,156 @@ run_leg "pp dispatch ladder zb1p (naive vs precompiled vs fused)" \
   bench_results/pp_overhead.jsonl \
   python tools/bench_pp_overhead.py --schedule zb1p
 
+# fused pp timeline plane on chip: a cadence (timeline=True) step through
+# the fused runtime for 1f1b and zb1p — per-stage busy/bubble attribution
+# plus per-run walls (docs/design/observability.md "Pipeline timeline &
+# profiling"). ZB's bubble_frac vs 1F1B's at the same shape is the
+# evidence row the ZB-default flip (ROADMAP item 1) asks for. Off-cadence
+# byte-identity is the tier-1 bench gate's job (pp_micro.timeline_extra_
+# dispatches), not this leg's.
+: > bench_results/pp_timeline.jsonl
+run_leg "fused pp timeline (1f1b + zb1p, cadence on)" \
+  bench_results/pp_timeline.jsonl python - <<'PYEOF'
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.bench_pp import build_engine
+
+from d9d_tpu.loop import CausalLMTask
+from d9d_tpu.loop.components.batch_staging import split_microbatches
+from d9d_tpu.models.qwen3 import Qwen3DenseConfig
+from d9d_tpu.pipelining.factory import (
+    Interleaved1F1BScheduleConfig,
+    ZeroBubble1PScheduleConfig,
+)
+from d9d_tpu.telemetry import Telemetry, get_telemetry, set_telemetry
+
+cfg = Qwen3DenseConfig(
+    vocab_ranges=(("default", 4096),), hidden_size=256, num_layers=4,
+    num_heads=8, num_kv_heads=4, head_dim=32, intermediate_size=1024,
+    remat=False,
+)
+SEQ, BATCH, MICRO_B = 256, 16, 2
+
+
+def run(name, schedule_cfg):
+    set_telemetry(Telemetry())  # fresh gauges per schedule
+    eng = build_engine(
+        schedule_cfg, cfg=cfg, seq_len=SEQ, batch=BATCH,
+        microbatch=MICRO_B, dtype=jnp.bfloat16,
+    )
+    task = CausalLMTask()
+    rng = np.random.RandomState(0)
+
+    def mbs():
+        prepared = task.prepare_batch({
+            "input_ids": rng.randint(
+                0, cfg.vocab_size, size=(BATCH, SEQ + 1)
+            ),
+        })
+        return split_microbatches(
+            prepared, num_microbatches=BATCH // MICRO_B,
+            microbatch_size=MICRO_B,
+        )
+
+    eng.step(mbs())  # warmup: compiles land outside the timed step
+    m = eng.step(mbs(), timeline=True)
+    float(m["loss"])
+    gauges = get_telemetry().registry.snapshot()["gauges"]
+    print(json.dumps({
+        "metric": f"pp_timeline_bubble_frac_{name}",
+        "value": gauges.get("pp/bubble_frac"),
+        "detail": {
+            k: round(v, 6) for k, v in sorted(gauges.items())
+            if k.startswith(("pp/s", "pp/run/", "pp/bubble"))
+        },
+    }), flush=True)
+
+
+run("1f1b", Interleaved1F1BScheduleConfig(
+    stages_per_rank=2, runtime="fused"))
+run("zb1p", ZeroBubble1PScheduleConfig(
+    stages_per_rank=2, residual_policy="cache_full", runtime="fused"))
+PYEOF
+
+# /debug/profile smoke: the operator capture path end to end on chip —
+# GET starts a one-shot jax.profiler capture with the host sampler,
+# the JSONL sidecar gains a schema-v5 host_stacks event, a re-request
+# inside the window answers busy
+: > bench_results/profile_smoke.jsonl
+run_leg "/debug/profile smoke (one-shot capture + host stacks)" \
+  bench_results/profile_smoke.jsonl python - <<'PYEOF'
+import json
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from d9d_tpu.loop.components.job_profiler import JobProfiler
+from d9d_tpu.telemetry import (
+    JsonlSink,
+    MetricsServer,
+    Telemetry,
+    iter_events,
+    set_telemetry,
+)
+
+with tempfile.TemporaryDirectory() as d:
+    tele = Telemetry()
+    set_telemetry(tele)
+    tele.add_sink(JsonlSink(d, run_name="profile_smoke"))
+    profiler = JobProfiler()
+    caps = Path(d) / "captures"
+    server = MetricsServer(
+        port=0, profile=lambda s: profiler.capture(s, caps),
+        profile_min_interval_s=30.0,
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            server.url("/debug/profile?duration_s=1"), timeout=30
+        ) as r:
+            body = json.loads(r.read().decode())
+        # inside the window a second request must answer busy/limited
+        try:
+            urllib.request.urlopen(
+                server.url("/debug/profile?duration_s=1"), timeout=30
+            )
+            second = 200
+        except urllib.error.HTTPError as e:
+            second = e.code
+        time.sleep(1.6)  # let the timer stop the trace
+        profiler.close()
+        tele.flush()
+        cap_dir = Path(body["capture"])
+        trace_files = sum(1 for _ in cap_dir.rglob("*") if _.is_file())
+        stacks = [
+            ev
+            for log in Path(d).glob("profile_smoke_proc*.jsonl")
+            for ev in iter_events(log)
+            if ev.get("kind") == "host_stacks"
+        ]
+        print(json.dumps({
+            "metric": "debug_profile_smoke_ok",
+            "value": int(
+                trace_files > 0 and len(stacks) == 1
+                and second in (429, 503)
+            ),
+            "detail": {
+                "capture": str(cap_dir), "trace_files": trace_files,
+                "host_stacks_events": len(stacks),
+                "host_stacks_samples": (
+                    stacks[0]["samples"] if stacks else 0
+                ),
+                "second_request_code": second,
+            },
+        }), flush=True)
+    finally:
+        server.close()
+PYEOF
+
 echo "== monitoring-plane overhead leg (exporter-enabled microbench + scrape)"
 # the 2% exporter budget, measured ON CHIP: the exporter-enabled leg
 # re-runs the serving microbench with the /metrics endpoint + SLO

@@ -215,6 +215,62 @@ def print_numerics(numerics_events, *, top: int) -> None:
         print(f"first non-finite: {fn.get('site')}:{fn.get('name')}")
 
 
+_PP_STAGE_RE = re.compile(r"^pp/s(\d+)/(busy_s|bubble_s|bubble_frac)$")
+_PP_RUN_RE = re.compile(r"^pp/run/r(\d+)/k(\d+)/wall_s$")
+
+
+def print_pp_timeline(last_flush) -> None:
+    """The --pp-timeline tables: per-stage busy/bubble attribution and
+    per-run wall from each log's FINAL flush gauges — the fused
+    runtime's ``pp_timeline_every_steps`` cadence (or the legacy
+    interpreter, which attributes every step)."""
+    printed = False
+    for path, ev in last_flush.items():
+        gauges = {
+            k: v for k, v in ev.get("gauges", {}).items() if v is not None
+        }
+        stages = collections.defaultdict(dict)  # stage → {metric: v}
+        runs = {}  # (rank, run) → wall_s
+        for k, v in gauges.items():
+            m = _PP_STAGE_RE.match(k)
+            if m:
+                stages[int(m.group(1))][m.group(2)] = v
+                continue
+            m = _PP_RUN_RE.match(k)
+            if m:
+                runs[(int(m.group(1)), int(m.group(2)))] = v
+        if not stages and not runs:
+            continue
+        printed = True
+        if stages:
+            print(f"\npp timeline — per-stage attribution [{path.name}]:")
+            print(f"{'stage':>5}  {'busy_s':>10}  {'bubble_s':>10}  "
+                  f"{'bubble_frac':>11}")
+            for s in sorted(stages):
+                row = stages[s]
+                print(
+                    f"{s:>5}  {row.get('busy_s', float('nan')):>10.4f}  "
+                    f"{row.get('bubble_s', float('nan')):>10.4f}  "
+                    f"{row.get('bubble_frac', float('nan')):>11.3f}"
+                )
+            rollup = gauges.get("pp/bubble_frac")
+            if rollup is not None:
+                print(f"rollup pp/bubble_frac = {rollup:.3f}")
+        if runs:
+            print(f"\npp timeline — per-run wall [{path.name}]:")
+            print(f"{'rank':>4}  {'run':>4}  {'wall_s':>10}")
+            for (rank, run), wall in sorted(runs.items()):
+                print(f"{rank:>4}  {run:>4}  {wall:>10.4f}")
+    if not printed:
+        print(
+            "\nno pp timeline gauges in the logs (enable "
+            "TrainerConfig.pp_timeline_every_steps on a "
+            "runtime=\"fused\" pipeline run, or use the legacy "
+            "interpreter, and make sure a flush follows the cadence "
+            "step)"
+        )
+
+
 def print_audit(executables, *, top: int) -> None:
     """The --audit table: per-executable compiled-artifact facts
     (telemetry/audit_capture.py ``audit`` blocks on executable events)
@@ -272,7 +328,7 @@ def print_audit(executables, *, top: int) -> None:
 
 def summarize_telemetry(
     files, *, top: int, perfetto=None, trace_id=None, numerics=False,
-    audit=False,
+    audit=False, pp_timeline=False,
 ) -> None:
     """Telemetry-mode report: span aggregate, per-executable inventory,
     per-request trace summary (schema v3 ``request_trace``), final flush
@@ -280,8 +336,10 @@ def summarize_telemetry(
     request-trace section to one request's full milestone sequence;
     ``numerics`` prints the per-layer table of the last numerics window
     (schema v4); ``audit`` prints the compiled-artifact facts table
-    (audit blocks on executable events). Reads leniently — a crashed
-    process's truncated log must still report."""
+    (audit blocks on executable events); ``pp_timeline`` prints the
+    per-stage busy/bubble + per-run wall tables from the final flush's
+    pipeline-timeline gauges. Reads leniently — a crashed process's
+    truncated log must still report."""
     from d9d_tpu.telemetry.trace_export import _read_events_lenient
 
     spans = collections.defaultdict(lambda: [0.0, 0])  # name → [Σs, n]
@@ -307,6 +365,8 @@ def summarize_telemetry(
     print(f"telemetry logs: {[str(f) for f in files]}")
     if numerics:
         print_numerics(numerics_events, top=top)
+    if pp_timeline:
+        print_pp_timeline(last_flush)
     if trace_id is not None:
         evs = sorted(requests.get(trace_id, []), key=lambda e: e["t"])
         if not evs:
@@ -445,6 +505,13 @@ def main():
         "dtype census) from executable events captured under "
         "D9D_AUDIT_CAPTURE=1",
     )
+    ap.add_argument(
+        "--pp-timeline", action="store_true",
+        help="telemetry mode: print the per-stage busy/bubble table and "
+        "the per-run wall table from the final flush's pipeline-timeline "
+        "gauges (TrainerConfig.pp_timeline_every_steps on the fused "
+        "runtime, or the legacy interpreter)",
+    )
     args = ap.parse_args()
 
     telemetry_files = collect_telemetry_files(args.logdir)
@@ -452,7 +519,7 @@ def main():
         summarize_telemetry(
             telemetry_files, top=args.top, perfetto=args.perfetto,
             trace_id=args.trace_id, numerics=args.numerics,
-            audit=args.audit,
+            audit=args.audit, pp_timeline=args.pp_timeline,
         )
         return
     if args.perfetto:
@@ -471,6 +538,13 @@ def main():
             "--audit needs telemetry JSONL inputs (executable events "
             "with audit blocks from a D9D_AUDIT_CAPTURE=1 run); none "
             "found among the given paths"
+        )
+    if args.pp_timeline:
+        raise SystemExit(
+            "--pp-timeline needs telemetry JSONL inputs (flush events "
+            "carrying pp/s{S}/* gauges from a "
+            "TrainerConfig.pp_timeline_every_steps run); none found "
+            "among the given paths"
         )
     if len(args.logdir) != 1:
         raise SystemExit("profiler mode takes exactly one logdir")
