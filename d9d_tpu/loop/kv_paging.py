@@ -345,6 +345,83 @@ class PagedKVAllocator:
         self._deferred.clear()
         return True
 
+    # -- cross-replica shipment (disaggregated serving) ----------------
+
+    def export_pages(self, rid: int) -> list:
+        """Ordered page ids of ``rid``'s live row allocation,
+        refcount-neutral (the caller only reads payloads; nothing moves
+        or changes hands). Raises ``KeyError`` when the request holds
+        no live row here — the caller falls back to re-prefill."""
+        for alloc in self._row_alloc.values():
+            if alloc.rid == rid:
+                return list(alloc.pages)
+        raise KeyError(f"rid {rid} has no live row allocation")
+
+    def export_prefix(self, tokens) -> list:
+        """Page ids of the leading READY prefix-cache run over
+        ``tokens``' full page-size blocks (refcount-neutral). Chains are
+        contiguous from block 0 by construction (leaf-first eviction),
+        so the run is directly shippable block-by-block."""
+        if not self.prefix_cache_enabled:
+            return []
+        full_blocks = len(tokens) // self.page_size
+        pages = []
+        for key in self._chain_keys(tokens, full_blocks):
+            e = self._entries.get(key)
+            if e is None or not e.ready:
+                break
+            pages.append(e.page)
+        return pages
+
+    def import_pages(self, tokens, n_blocks: int) -> Optional[list]:
+        """Install the first ``n_blocks`` full page-size blocks of
+        ``tokens`` as READY prefix entries backed by freshly allocated
+        pages — the receiving half of a cross-replica shipment. Leading
+        blocks already cached here are skipped (their payload is
+        already on-device); a mid-chain entry another row is still
+        FILLING stops the import early (never alias a page being
+        written). Returns ``[(block_idx, dest_page), ...]`` for the
+        blocks whose payloads the caller must copy into the device
+        pool BEFORE the next dispatch that could hit them, or None
+        when even LRU eviction cannot free enough pages — in which
+        case the allocator is left untouched (no partial import)."""
+        if not self.prefix_cache_enabled:
+            return None
+        ps = self.page_size
+        n_blocks = min(int(n_blocks), len(tokens) // ps)
+        if n_blocks <= 0:
+            return []
+        keys = self._chain_keys(tokens, n_blocks)
+        self._clock += 1
+        skip = 0
+        for key in keys:
+            e = self._entries.get(key)
+            if e is None:
+                break
+            if not e.ready:
+                return []  # filling mid-chain: nothing importable past it
+            e.last_use = self._clock
+            skip += 1
+        need = n_blocks - skip
+        if need > len(self._free):
+            self._evict_lru(need - len(self._free))
+        if need > len(self._free):
+            return None
+        placed = []
+        for i in range(skip, n_blocks):
+            page = self._alloc_page()
+            parent = keys[i - 1] if i > 0 else None
+            self._entries[keys[i]] = _PrefixEntry(
+                key=keys[i], parent=parent, page=page, depth=i,
+                last_use=self._clock, ready=True, owner_rid=None,
+            )
+            if parent is not None and parent in self._entries:
+                self._entries[parent].children.add(keys[i])
+            placed.append((i, page))
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+        return placed
+
     # -- invalidation --------------------------------------------------
 
     def invalidate_prefix_cache(self) -> int:

@@ -215,6 +215,60 @@ class _ChunkPlan:
     version: int = 0      # weights generation this chunk dispatched with
 
 
+# default per-transfer staging bound for KV page shipments: the same
+# order as elastic-restore's redistribute budget — big enough that a
+# whole tiny-model prefix ships in one chunk, small enough that a long
+# production prefix never stages the full run on the host at once
+_TRANSFER_BUDGET_BYTES = 64 << 20
+
+
+@dataclasses.dataclass
+class KVPageShipment:
+    """One cross-replica KV prefix shipment (host-side, self-checking).
+
+    ``payload`` maps each paged pool leaf path (values AND int8 scale
+    siblings) to a ``[n_pages, ...]`` host array stacked in block
+    order; ``checksums[i]`` is a crc32 over page ``i``'s bytes across
+    every leaf in sorted-path order, verified by the importer BEFORE
+    any allocator or pool mutation — a flipped byte or truncated
+    payload is detected, and the request falls back to re-prefill.
+    ``weights_version`` pins the generation the pages were computed
+    under: cached KV is weights-dependent, so an importer on any other
+    generation must reject (same invariant as ``install_weights``
+    prefix invalidation)."""
+
+    page_size: int
+    tokens: list            # the full-block token prefix the pages cover
+    n_pages: int
+    weights_version: int
+    kv_quant: Optional[str]
+    payload: dict
+    checksums: list
+    chunks: int = 0         # transfer chunks the export staged through
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.payload.values())
+
+
+def _page_checksums(payload: dict) -> list:
+    """Per-page crc32 across every payload leaf in sorted-path order."""
+    import zlib
+
+    if not payload:
+        return []
+    n = next(iter(payload.values())).shape[0]
+    out = []
+    for i in range(n):
+        c = 0
+        for name in sorted(payload):
+            c = zlib.crc32(
+                np.ascontiguousarray(payload[name][i]).tobytes(), c
+            )
+        out.append(c)
+    return out
+
+
 @dataclasses.dataclass
 class RequestTelemetry:
     """Host-clock milestones for one request, harvested at the same
@@ -1267,6 +1321,164 @@ class ContinuousBatcher:
                 self._gauge_set("serve/queued", len(self._queue))
                 return True
         return False
+
+    # ------------------------------------------------------------------
+    # cross-replica KV page shipment (docs/design/elasticity.md
+    # "Disaggregated serving"): a prefill replica exports the READY
+    # prefix pages covering a prompt; a decode replica imports them as
+    # ready prefix entries and copies the payloads into its own pool.
+    # Pure transfers at clean chunk boundaries — page pulls/pushes are
+    # untracked device array ops, never tracked_jit dispatches, so the
+    # steady-state executable census and the dispatch counts the bench
+    # gates are untouched. EVERY failure (dirty boundary, version skew,
+    # checksum mismatch, allocation shortfall) returns None/False and
+    # the caller falls back to plain continuation re-prefill — fallback,
+    # not failure, is the contract.
+
+    def _pool_leaves(self) -> dict:
+        """Paged pool leaves (values + int8 scale siblings) by path."""
+        from flax.traverse_util import flatten_dict
+
+        from d9d_tpu.nn.decode_flags import (
+            PAGED_CACHE_LEAVES,
+            PAGED_SCALE_SUFFIX,
+        )
+
+        return {
+            "/".join(p): leaf
+            for p, leaf in flatten_dict(self._cache).items()
+            if p[-1] in PAGED_CACHE_LEAVES
+            or p[-1].endswith(PAGED_SCALE_SUFFIX)
+        }
+
+    def export_kv_pages(
+        self,
+        tokens: Sequence[int],
+        *,
+        transfer_budget_bytes: int = _TRANSFER_BUDGET_BYTES,
+    ) -> Optional["KVPageShipment"]:
+        """Pull the READY prefix pages covering ``tokens``' leading
+        full blocks off the device pool, chunk-by-chunk under
+        ``transfer_budget_bytes`` (the ``_chunked_place`` discipline
+        from ``resilience/elastic.py`` — bounded host staging however
+        large the run). Returns None when not paged, mid-chunk (only a
+        clean boundary has an exact pool view), or nothing is cached —
+        the caller re-prefills instead."""
+        if not self._paged or self._pending:
+            return None
+        # same boundary discipline as import: a staged publish means the
+        # cache below is the OLD generation — apply it (invalidating the
+        # stale entries) rather than stamping dead pages with a version
+        # the importer would refuse anyway
+        self._apply_pending_weights()
+        tokens = [int(x) for x in tokens]
+        pages = self._kv.export_prefix(tokens)
+        if not pages:
+            return None
+        leaves = self._pool_leaves()
+        chunk_len = max(
+            1, int(transfer_budget_bytes) // max(1, self._page_bytes)
+        )
+        parts: dict[str, list] = {name: [] for name in leaves}
+        chunks = 0
+        for a in range(0, len(pages), chunk_len):
+            idx = jnp.asarray(np.asarray(pages[a:a + chunk_len], np.int32))
+            for name, pool in leaves.items():
+                # d9d-lint: disable=D9D003 — bounded page-payload pull at
+                # a clean boundary (a transfer, not a decode readback)
+                parts[name].append(np.asarray(pool[idx]))
+            chunks += 1
+        payload = {
+            name: np.concatenate(arrs, axis=0)
+            for name, arrs in parts.items()
+        }
+        ship = KVPageShipment(
+            page_size=self._page_size,
+            tokens=tokens[: len(pages) * self._page_size],
+            n_pages=len(pages),
+            weights_version=self.weights_version,
+            kv_quant=self._kv_quant,
+            payload=payload,
+            checksums=_page_checksums(payload),
+            chunks=chunks,
+        )
+        self._count("serve/handoff_exports")
+        self._count("serve/handoff_pages", len(pages))
+        self._count("serve/handoff_bytes", ship.nbytes)
+        self._count("serve/handoff_chunks", chunks)
+        return ship
+
+    def import_kv_pages(
+        self,
+        ship: "KVPageShipment",
+        *,
+        transfer_budget_bytes: int = _TRANSFER_BUDGET_BYTES,
+    ) -> bool:
+        """Install a shipment's pages as READY prefix entries and copy
+        the payloads into this replica's pool (chunked under the same
+        transfer budget). Checksums are verified BEFORE any allocator
+        or pool mutation — a corrupt/truncated shipment is detected and
+        rejected whole, never half-imported. A weights-generation
+        mismatch (or a publish staged here) rejects too: cached KV is
+        weights-dependent, the same invariant as ``install_weights``
+        prefix invalidation. Returns False on any rejection — the
+        caller falls back to continuation re-prefill."""
+        if not self._paged or self._pending:
+            return False
+        # an import IS a dispatch-boundary mutation: swap a staged
+        # publish in first, exactly as the next _dispatch_chunk would —
+        # otherwise a freshly-grown (idle) replica still reports the
+        # pre-publish generation and refuses every current-gen shipment
+        self._apply_pending_weights()
+        if (
+            ship.page_size != self._page_size
+            or ship.kv_quant != self._kv_quant
+            or not self._kv.prefix_cache_enabled
+        ):
+            return False
+        if (
+            ship.weights_version != self.weights_version
+            or self._pending_weights is not None
+        ):
+            self._count("serve/handoff_version_mismatch")
+            return False
+        leaves = self._pool_leaves()
+        if set(ship.payload) != set(leaves) or any(
+            ship.payload[n].shape[0] != ship.n_pages for n in ship.payload
+        ):
+            self._count("serve/handoff_checksum_failures")
+            return False
+        if _page_checksums(ship.payload) != list(ship.checksums):
+            self._count("serve/handoff_checksum_failures")
+            return False
+        placed = self._kv.import_pages(ship.tokens, ship.n_pages)
+        if placed is None:
+            return False
+        chunk_len = max(
+            1, int(transfer_budget_bytes) // max(1, self._page_bytes)
+        )
+        flat = None
+        for a in range(0, len(placed), chunk_len):
+            part = placed[a:a + chunk_len]
+            src = np.asarray([b for b, _ in part], np.int32)
+            dest = jnp.asarray(np.asarray([p for _, p in part], np.int32))
+            if flat is None:
+                from flax.traverse_util import flatten_dict
+
+                flat = flatten_dict(self._cache)
+            for name in leaves:
+                path = tuple(name.split("/"))
+                flat[path] = flat[path].at[dest].set(
+                    jnp.asarray(ship.payload[name][src])
+                )
+        if flat is not None:
+            from flax.traverse_util import unflatten_dict
+
+            self._cache = unflatten_dict(flat)
+        self._count("serve/handoff_imports")
+        self._count("serve/handoff_pages", len(placed))
+        self._note_pages()
+        return True
 
     # ------------------------------------------------------------------
     # paged KV bookkeeping (loop/kv_paging.py): all host work, all at

@@ -98,6 +98,16 @@ class AutopilotConfig:
     cooldown_s: float = 60.0
     min_replicas: int = 1
     max_replicas: int = 4
+    # disaggregated serving (docs/design/elasticity.md): policies named
+    # here steer burn-driven grows into a role pool — a burning TTFT
+    # policy should add PREFILL capacity and a burning TPOT policy
+    # DECODE capacity (the two pools bottleneck on different resources).
+    # Unlisted policies grow a unified replica, exactly as before roles
+    # existed. The per-role minimums floor idle shrink per pool.
+    prefill_policies: Optional[tuple[str, ...]] = None
+    decode_policies: Optional[tuple[str, ...]] = None
+    min_prefill_replicas: int = 0
+    min_decode_replicas: int = 0
     idle_after_s: float = 120.0
     idle_queue_depth: float = 0.0
     idle_slot_utilization: float = 0.25
@@ -118,7 +128,8 @@ class AutopilotConfig:
                 f"{self.min_replicas}, {self.max_replicas}"
             )
         for name in ("grow_after_s", "cooldown_s", "idle_after_s",
-                     "canary_max_wait_s", "eval_interval_s"):
+                     "canary_max_wait_s", "eval_interval_s",
+                     "min_prefill_replicas", "min_decode_replicas"):
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0")
         if self.canary_window_s <= 0:
@@ -461,13 +472,30 @@ class FleetAutopilot:
                 if self.publisher is not None
                 and self.publisher.canary is not None else None
             )
+            # idle shrink is per POOL when roles are in play: a role's
+            # last replicas above its floor are fair game, the floor
+            # itself is not — min_prefill/min_decode keep each side of
+            # a disaggregated fleet from shrinking to nothing while the
+            # other side's idleness drives the decision
+            role_counts: dict[str, int] = {}
+            for i in self.fleet._live:
+                r = self.fleet._role(i)
+                role_counts[r] = role_counts.get(r, 0) + 1
+            floors = {"prefill": cfg.min_prefill_replicas,
+                      "decode": cfg.min_decode_replicas}
             candidates = [
                 i for i in sorted(self.fleet._live, reverse=True)
                 if self.fleet._replicas[i] is not canary_b
+                and role_counts[self.fleet._role(i)]
+                > floors.get(self.fleet._role(i), 0)
             ]
             if not candidates:
-                return  # only the canary is left: decide it first
+                return  # only the canary / role floors are left
             idx = candidates[0]
+            role = self.fleet._role(idx)
+            action = {
+                "prefill": "shrink_prefill", "decode": "shrink_decode",
+            }.get(role, "shrink")
             # dump BEFORE the drain so the black box shows the fleet
             # the decision was made against
             self._tele.dump_flight_record(
@@ -480,13 +508,14 @@ class FleetAutopilot:
             self._idle_since = None
             self._tele.counter("autopilot/shrinks").add(1)
             self._decide(
-                "shrink",
+                action,
                 reason=(
                     f"idle {self.config.idle_after_s:g}s: queue_depth "
                     f"{depth:g} <= {cfg.idle_queue_depth:g}, utilization "
                     f"{util:.3f} <= {cfg.idle_slot_utilization:g}"
                 ),
-                detail={"replica": idx, "live_replicas": live - 1},
+                detail={"replica": idx, "role": role,
+                        "live_replicas": live - 1},
             )
 
     def _grow(self, now: float, burning: list[SloStatus]) -> None:
@@ -509,18 +538,29 @@ class FleetAutopilot:
                     detail={"burning": [s.policy.name for s in burning]},
                 )
             return
-        idx = self.fleet.grow(self.replica_factory)
+        cfg = self.config
+        worst = max(burning, key=lambda s: s.burn)
+        # role-aware capacity (disaggregated serving): the WORST burning
+        # policy picks the pool — a TTFT burn means prefill is the
+        # bottleneck, a TPOT burn means decode is; distinct decision
+        # kinds keep the audit log attributable per pool
+        role, action = "unified", "grow"
+        if cfg.prefill_policies and worst.policy.name in cfg.prefill_policies:
+            role, action = "prefill", "grow_prefill"
+        elif cfg.decode_policies and worst.policy.name in cfg.decode_policies:
+            role, action = "decode", "grow_decode"
+        idx = self.fleet.grow(self.replica_factory, role=role)
         self._last_scale_t = now
         self._tele.counter("autopilot/grows").add(1)
-        worst = max(burning, key=lambda s: s.burn)
         self._decide(
-            "grow",
+            action,
             reason=(
                 f"{worst.policy.name} burning {worst.burn:.2f}x for >= "
                 f"{self.config.grow_after_s:g}s"
             ),
             detail={
                 "replica": idx,
+                "role": role,
                 "live_replicas": len(self.fleet._live),
                 "weights_version": fleet_pub.latest_version,
                 "burning": {

@@ -27,6 +27,12 @@ timing races — so a chaos test asserts exact recovery behavior, not
 - :func:`kill_replica_mid_drain` — make a replica die partway through
   its shrink drain (after an exact number of grace chunks): the fleet
   must recover its unfinished requests onto survivors.
+- :func:`kill_prefill_mid_handoff` — make a prefill replica die at its
+  next handoff with pages exported but not yet imported: the shipment
+  is lost in flight, the request must recover via continuation.
+- :func:`corrupt_handoff_payload` — flip a byte of the next handoff
+  shipment so the per-page checksum must catch it: the import is
+  refused wholesale and the request re-prefills, token-identically.
 - :func:`ramp_arrivals` — a scripted arrival-rate ramp: phases of
   (steps, arrivals-per-step) compiled into an exact arrival schedule.
   Arrival *times* carry zero randomness (fractional rates are spread
@@ -252,6 +258,24 @@ def kill_replica_mid_drain(
     requests to survivors as continuation prompts (prompt + tokens
     already emitted), losing no committed work."""
     fleet._chaos_kill = (int(replica_idx), int(after_chunks))
+
+
+def kill_prefill_mid_handoff(fleet, replica_idx: int) -> None:
+    """Make ``replica_idx`` die at its NEXT prefill→decode handoff, at
+    the worst instant: pages exported but not yet imported anywhere.
+    The shipment is lost with the replica; the fleet must recover every
+    in-flight request (including the one mid-handoff) via the
+    continuation fallback — token-identically, with zero leaked pages
+    on every survivor (``check_invariants``)."""
+    fleet._chaos_kill_handoff = int(replica_idx)
+
+
+def corrupt_handoff_payload(fleet) -> None:
+    """Flip one byte of the NEXT handoff shipment's page payload after
+    export. The importer's per-page checksum must detect it and refuse
+    the import wholesale (no partially-written pool pages); the request
+    falls back to continuation re-prefill — fallback, not failure."""
+    fleet._chaos_corrupt_handoff = True
 
 
 def ramp_arrivals(

@@ -580,6 +580,12 @@ class _FleetRequest:
     # admission tier (higher = more important): what the autopilot's
     # burn-driven shedding orders on — see ServingFleet.shed_queued
     priority: int = 0
+    # disaggregated-serving stage: "direct" (unified fleet — the whole
+    # request runs where it lands), "prefill" (awaiting its prefill leg
+    # on a prefill-role replica: budget clamped to the first token),
+    # "decode" (post-handoff or post-fallback: the continuation runs
+    # out the remaining budget on a decode-capable replica)
+    stage: str = "direct"
 
 
 class ServingFleet:
@@ -614,7 +620,26 @@ class ServingFleet:
         self._preemption: tuple[Any, int] | None = None
         self._chaos_shrink: tuple[int, int] | None = None
         self._chaos_kill: tuple[int, int] | None = None
+        # disaggregated-serving chaos arms (resilience/chaos.py):
+        # kill_prefill_mid_handoff arms the replica idx to die with
+        # exported-but-unimported pages in flight; corrupt_handoff_payload
+        # arms a byte flip on the next shipment (the checksum must catch)
+        self._chaos_kill_handoff: int | None = None
+        self._chaos_corrupt_handoff: bool = False
         self._rounds = 0
+        # replica roles (docs/design/elasticity.md "Disaggregated
+        # serving"): "prefill" replicas take new requests' first-token
+        # leg, "decode" replicas run continuations; "unified" (default)
+        # does both — an all-unified fleet behaves exactly as before
+        self._roles: dict[int, str] = {}
+        # fleet-wide prefix directory: content-chain block key → live
+        # replica idx whose allocator holds it READY. Rebuilt each
+        # scheduling round from the live replicas (a dead owner drops
+        # out on the next sync; a stale entry is harmless — export
+        # returns None and the request falls back to local prefill),
+        # cleared fleet-wide whenever the publisher's generation moves
+        self._prefix_dir: dict[bytes, int] = {}
+        self._dir_seen_version: int | None = None
         # bound by FleetAutopilot.attach (resilience/autopilot.py):
         # polled once per scheduling round, BEFORE any chunk dispatches
         # — the control loop acts only at this boundary cadence
@@ -638,6 +663,10 @@ class ServingFleet:
                 if (f := fleet_ref()) is not None else float("nan"),
             "serve/fleet_kv_pages_in_use":
                 lambda: f._kv_pages("pages_in_use")
+                if (f := fleet_ref()) is not None else float("nan"),
+            # fleet prefix directory size (disaggregated serving)
+            "serve/fleet_prefix_entries":
+                lambda: float(len(f._prefix_dir))
                 if (f := fleet_ref()) is not None else float("nan"),
         }
         for name, fn in self._gauge_fns.items():
@@ -726,11 +755,18 @@ class ServingFleet:
                 "dead": idx in self.dead,
                 "ready": bool(getattr(b, "ready", False)),
                 "active": int(b.active),
+                "role": self._role(idx),
             }
+        roles: dict[str, int] = {}
+        for i in self._live:
+            roles[self._role(i)] = roles.get(self._role(i), 0) + 1
         out = {
             "replicas": replicas,
             "overflow": len(self._overflow),
             "ready": self.ready,
+            # live-replica count per fleet role: the disaggregated
+            # provisioning view (what the role-aware autopilot scales)
+            "roles": roles,
         }
         if self._autopilot is not None:
             out["autopilot"] = self._autopilot.status()
@@ -765,10 +801,22 @@ class ServingFleet:
 
     # -- replica lifecycle ---------------------------------------------
 
-    def add_replica(self, batcher) -> int:
+    _ROLES = ("prefill", "decode", "unified")
+
+    def add_replica(self, batcher, *, role: str = "unified") -> int:
+        """Register a replica under a fleet role. ``prefill`` replicas
+        take new requests' first-token leg and hand off via KV page
+        shipment; ``decode`` replicas run the continuations; ``unified``
+        (the default) does both — a fleet of unified replicas behaves
+        exactly as before this distinction existed."""
+        if role not in self._ROLES:
+            raise ValueError(
+                f"role must be one of {self._ROLES}, got {role!r}"
+            )
         idx = self._next_idx
         self._next_idx += 1
         self._replicas[idx] = batcher
+        self._roles[idx] = role
         self._live.add(idx)
         # replica conflation fix (docs/design/observability.md): each
         # replica's serve instruments get a fleet-assigned namespace
@@ -792,16 +840,23 @@ class ServingFleet:
         self._tele.gauge("serve/fleet_replicas").set(len(self._live))
         return idx
 
-    def grow(self, make_batcher: Callable[[PyTree], Any]) -> int:
+    def grow(
+        self, make_batcher: Callable[[PyTree], Any], *,
+        role: str = "unified",
+    ) -> int:
         """Cold-start a replacement replica from the latest *published*
         weights — the recovery half of a preemption shrink. The factory
-        receives the published param tree and returns a batcher."""
+        receives the published param tree and returns a batcher;
+        ``role`` assigns the new replica's fleet pool (the role-aware
+        autopilot grows prefill and decode pools independently)."""
         if self._publisher is None or self._publisher.latest_params is None:
             raise RuntimeError(
                 "grow() cold-starts replicas from the latest published "
                 "weights; attach a WeightPublisher and publish first"
             )
-        idx = self.add_replica(make_batcher(self._publisher.latest_params))
+        idx = self.add_replica(
+            make_batcher(self._publisher.latest_params), role=role
+        )
         self._tele.counter("serve/fleet_grows").add(1)
         return idx
 
@@ -838,14 +893,25 @@ class ServingFleet:
 
         frid = self._next_frid
         self._next_frid += 1
+        # with any live prefill-role replica the request runs its
+        # first-token leg there and hands off (docs/design/elasticity.md
+        # "Disaggregated serving"); an all-unified/decode fleet serves
+        # it in one place, exactly as before roles existed
+        disagg = any(self._role(i) == "prefill" for i in self._live)
         req = _FleetRequest(
             [int(x) for x in prompt], int(max_new_tokens),
             time.perf_counter() + deadline_s
             if deadline_s is not None else None,
             trace_id=mint_trace_id(),
             priority=int(priority),
+            stage="prefill" if disagg else "direct",
         )
         self._reqs[frid] = req
+        # front-door placements consult the fleet prefix directory, so
+        # refresh it HERE, not just at step boundaries — a shared prompt
+        # submitted right after its twin finished must still ship pages
+        # instead of recomputing ("once per fleet", not "once per round")
+        self._sync_prefix_dir()
         try:
             placed = self._try_place(frid)
         except BaseException:
@@ -867,13 +933,62 @@ class ServingFleet:
             )
         return frid
 
-    def _try_place(self, frid: int, *, exclude: frozenset = frozenset()) -> bool:
+    def _role(self, i: int) -> str:
+        return self._roles.get(i, "unified")
+
+    def _capacity_short(self, i: int, total_tokens: int) -> bool:
+        """Would replica ``i``'s page pool head-of-line-block a request
+        of this token footprint even after the next deferred flush?
+        Contiguous replicas are never short (admission is slot-bounded
+        there); prefix hits and LRU eviction could only help, so this
+        is a conservative RANKING signal, not an admission gate."""
+        kv = getattr(self._replicas[i], "_kv", None)
+        if kv is None:
+            return False
+        return kv.pages_needed(total_tokens) > kv.pages_free_after_flush()
+
+    def _place_order(
+        self, req: _FleetRequest, *, exclude: frozenset = frozenset()
+    ) -> list[int]:
+        """Placement candidates, best first: role pool (a prefill-stage
+        request prefers prefill replicas, a continuation prefers
+        decode, unified serves either; the off-role pools stay as
+        fallbacks — availability beats role purity), then KV capacity
+        (a paged replica whose pool cannot map the request ranks behind
+        one with headroom instead of accepting a head-of-line wait),
+        then least-loaded."""
+        if req.stage == "prefill":
+            pools = ("prefill", "unified", "decode")
+            remaining = 1
+        else:
+            pools = ("decode", "unified", "prefill")
+            remaining = max(req.max_new_tokens - len(req.prefix), 1)
+        total = len(req.prompt) + len(req.prefix) + remaining - 1
+        return sorted(
+            (i for i in self._live if i not in exclude),
+            key=lambda i: (
+                pools.index(self._role(i)),
+                self._capacity_short(i, total),
+                self._replicas[i].active,
+                i,
+            ),
+        )
+
+    def _try_place(
+        self, frid: int, *, exclude: frozenset = frozenset(),
+        prefer: int | None = None,
+    ) -> bool:
         from d9d_tpu.loop.serve import QueueFullError
 
         req = self._reqs[frid]
         remaining = req.max_new_tokens - len(req.prefix)
         if remaining <= 0:
             return True  # fully emitted before its last replica died
+        if req.stage == "prefill":
+            # the prefill leg fills the prompt's pages and emits the
+            # FIRST token (TTFT happens here); the remaining budget
+            # runs on the decode side after the handoff
+            remaining = 1
         deadline_s = None
         if req.deadline_t is not None:
             # preserve the ABSOLUTE deadline across migrations: the
@@ -888,12 +1003,21 @@ class ServingFleet:
                 )
                 req.replica = req.local_rid = None
                 return True  # retired: partial prefix kept, like PR 5
-        order = sorted(
-            (i for i in self._live if i not in exclude),
-            key=lambda i: self._replicas[i].active,
-        )
+        order = self._place_order(req, exclude=exclude)
+        if prefer is not None and prefer in order:
+            order.remove(prefer)
+            order.insert(0, prefer)
         prompt = req.prompt + req.prefix
+        shipped = False
         for i in order:
+            if not shipped:
+                # fleet prefix directory: before the first (best)
+                # candidate prefills a prompt another replica already
+                # holds, ship those pages over instead of recomputing —
+                # a shared prompt prefills once per FLEET. One attempt
+                # per placement; failures just mean a local prefill.
+                shipped = True
+                self._maybe_ship_prefix(prompt, i)
             try:
                 rid = self._replicas[i].submit(
                     prompt,
@@ -909,6 +1033,39 @@ class ServingFleet:
             return True
         req.replica = req.local_rid = None
         return False
+
+    def _maybe_ship_prefix(self, prompt: list[int], target: int) -> None:
+        """Local prefix miss + fleet-directory hit: ship the cached
+        pages from their live owner into ``target`` before the prompt
+        admits there. Every failure (stale directory entry, dead or
+        mid-chunk owner, version skew, checksum, pool pressure) counts
+        a miss and degrades to a local prefill — never an error."""
+        tb = self._replicas[target]
+        kv = getattr(tb, "_kv", None)
+        if kv is None or not kv.prefix_cache_enabled or not self._prefix_dir:
+            return
+        ps = kv.page_size
+        cap = (len(prompt) - 1) // ps  # admission's max hit run
+        if cap <= 0:
+            return
+        tokens = prompt[: cap * ps]
+        if len(kv.export_prefix(tokens)) >= cap:
+            return  # full local hit: nothing a shipment could add
+        keys = kv._chain_keys(tokens, cap)
+        owner = None
+        for d in range(cap - 1, -1, -1):  # deepest cached block wins
+            cand = self._prefix_dir.get(keys[d])
+            if cand is not None and cand in self._live and cand != target:
+                owner = cand
+                break
+        if owner is None:
+            self._tele.counter("serve/fleet_prefix_misses").add(1)
+            return
+        ship = self._replicas[owner].export_kv_pages(tokens)
+        if ship is not None and tb.import_kv_pages(ship):
+            self._tele.counter("serve/fleet_prefix_hits").add(1)
+        else:
+            self._tele.counter("serve/fleet_prefix_misses").add(1)
 
     def shed_queued(self, n: int) -> list[int]:
         """Retire up to ``n`` QUEUED (never-admitted) fleet requests as
@@ -986,7 +1143,21 @@ class ServingFleet:
             raise KeyError(f"unknown fleet request id {frid}")
         if req.replica is None:
             return len(req.prefix) >= req.max_new_tokens
-        return req.local_rid in self._replicas[req.replica].done
+        b = self._replicas[req.replica]
+        if req.local_rid not in b.done:
+            return False
+        if req.stage == "prefill" and req.local_rid not in b.failed:
+            # the prefill LEG is done but the request is not: the
+            # handoff (step()._poll_handoffs) still owes the decode
+            # placement — unless the first token already exhausted the
+            # budget, or EOS landed on it
+            emitted = len(req.prefix) + len(b.outputs.get(req.local_rid, []))
+            if emitted >= req.max_new_tokens:
+                return True
+            eos = getattr(b, "_eos", None)
+            out = b.outputs.get(req.local_rid, [])
+            return bool(out) and eos is not None and out[-1] == eos
+        return True
 
     def outputs(self, frid: int) -> list[int]:
         """Emitted tokens for a fleet request: dead-replica prefix plus
@@ -1060,11 +1231,127 @@ class ServingFleet:
             idx = self._chaos_shrink[0]
             self._chaos_shrink = None
             self.shrink(idx)
+        self._sync_prefix_dir()
+        self._poll_handoffs()
         for frid in [self._overflow.popleft() for _ in range(len(self._overflow))]:
             if not self._try_place(frid):
                 self._overflow.append(frid)
         for i in sorted(self._live):
             self._replicas[i].step_chunk()
+
+    # -- disaggregated serving: prefix directory + handoff -------------
+
+    def _sync_prefix_dir(self) -> None:
+        """Rebuild the fleet prefix directory from the live paged
+        replicas' READY entries (dead/retired owners drop out here).
+        A weight publish moves the generation: the directory clears
+        fleet-wide and repopulates NEXT round, once the replicas have
+        applied the publish at their own boundaries — and the shipment
+        weights-version pin keeps even the in-between window safe."""
+        if self._publisher is not None:
+            v = self._publisher.version
+            if v != self._dir_seen_version:
+                self._dir_seen_version = v
+                if self._prefix_dir:
+                    self._prefix_dir = {}
+                    self._tele.counter(
+                        "serve/fleet_prefix_invalidations"
+                    ).add(1)
+                return
+        dir_: dict[bytes, int] = {}
+        for i in sorted(self._live):
+            kv = getattr(self._replicas[i], "_kv", None)
+            if kv is None or not kv.prefix_cache_enabled:
+                continue
+            for key, e in kv._entries.items():
+                if e.ready and key not in dir_:
+                    dir_[key] = i
+        self._prefix_dir = dir_
+
+    def _poll_handoffs(self) -> None:
+        """Advance prefill-stage requests whose first-token leg is done:
+        harvest the leg's tokens into the continuation prefix, flip the
+        stage to decode, and hand off (page shipment + placement). A
+        leg that already exhausted its budget or hit EOS is complete —
+        it retires through the normal finished() path untouched."""
+        for frid, req in list(self._reqs.items()):
+            if (
+                req.stage != "prefill" or req.replica is None
+                or frid in self.failed
+            ):
+                continue
+            src = req.replica
+            b = self._replicas[src]
+            if req.local_rid not in b.done or req.local_rid in b.failed:
+                continue
+            out = list(b.outputs.get(req.local_rid, []))
+            eos = getattr(b, "_eos", None)
+            if len(req.prefix) + len(out) >= req.max_new_tokens or (
+                out and eos is not None and out[-1] == eos
+            ):
+                continue  # complete at the prefill leg: nothing to hand off
+            self._by_replica.pop((src, req.local_rid), None)
+            req.prefix = req.prefix + out
+            req.replica = req.local_rid = None
+            req.stage = "decode"
+            self._handoff(frid, req, src)
+
+    def _handoff(self, frid: int, req: _FleetRequest, src: int) -> None:
+        """One prefill→decode handoff: export the prompt's READY prefix
+        pages from the prefill replica, import them into the chosen
+        decode target, place the continuation there. The original trace
+        id, absolute deadline, priority tier and weights-version pin
+        all ride along. EVERY failure — dead source, dirty boundary,
+        version skew, corrupt shipment, pool pressure — degrades to the
+        placement below, which re-prefills from prompt + harvested
+        tokens token-identically (the PR 8/10 kill-recovery contract):
+        fallback, not failure, is the contract."""
+        prompt = req.prompt + req.prefix
+        order = self._place_order(req)
+        targets = [i for i in order if i != src] or order
+        target = targets[0] if targets else None
+        ship = None
+        src_b = self._replicas.get(src)
+        if target is not None and src in self._live and src_b is not None:
+            tkv = getattr(self._replicas[target], "_kv", None)
+            if tkv is not None and getattr(src_b, "_kv", None) is not None:
+                cap = (len(prompt) - 1) // tkv.page_size
+                if cap > 0:
+                    ship = src_b.export_kv_pages(
+                        prompt[: cap * tkv.page_size]
+                    )
+        if self._chaos_kill_handoff == src:
+            # chaos: the prefill replica dies with exported-but-
+            # unimported pages in flight — the shipment is lost with
+            # it; its other in-flight requests recover via continuation
+            self._chaos_kill_handoff = None
+            ship = None
+            self._live.discard(src)
+            self._tele.gauge("serve/fleet_replicas").set(len(self._live))
+            self._recover_killed(src)
+        if ship is not None and self._chaos_corrupt_handoff:
+            # chaos: flip one payload byte — the per-page checksum must
+            # catch it BEFORE the importer mutates anything
+            self._chaos_corrupt_handoff = False
+            name = sorted(ship.payload)[0]
+            raw = ship.payload[name].copy()
+            raw.view(np.uint8).flat[0] ^= 0xFF
+            ship.payload[name] = raw
+        imported = False
+        if ship is not None and target is not None:
+            imported = self._replicas[target].import_kv_pages(ship)
+        if imported:
+            self._tele.counter("serve/fleet_handoffs").add(1)
+        else:
+            self._tele.counter("serve/fleet_handoff_fallbacks").add(1)
+        self._trace(
+            req.trace_id, "handoff",
+            from_replica=src, to_replica=target,
+            pages=ship.n_pages if (ship is not None and imported) else 0,
+            fallback=not imported, prefix_tokens=len(req.prefix),
+        )
+        if not self._try_place(frid, prefer=target):
+            self._overflow.append(frid)
 
     def drain(self, max_rounds: int = 10_000) -> dict[int, list[int]]:
         """Run scheduling rounds until every live fleet request
@@ -1144,6 +1431,12 @@ class ServingFleet:
         b = self._replicas[idx]
         self.dead.add(idx)
         self._tele.counter("serve/fleet_replica_deaths").add(1)
+        # the dead replica's prefix pages die with it: drop its directory
+        # entries NOW so no waiter wedges on a dead owner — shipping falls
+        # back to local prefill until the next directory rebuild
+        self._prefix_dir = {
+            k: i for k, i in self._prefix_dir.items() if i != idx
+        }
         recovered = 0
         for frid, req in self._reqs.items():
             if req.replica != idx or req.local_rid in b.done:

@@ -81,6 +81,7 @@ _REQUEST_STATE = {
     "admit": "running",
     "first_token": "decoding",
     "migrate": "migrating",
+    "handoff": "handing_off",
     "continuation": "recovering",
 }
 _REQUEST_TERMINAL = frozenset({"finish", "expired", "failed", "rejected"})

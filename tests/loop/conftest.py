@@ -1,0 +1,5 @@
+"""Loop-suite fixtures: re-export the paged toy serving factory (the
+pageable deterministic model lives with the chaos fixtures; the KV
+handoff shipment tests here exercise the same batcher surface)."""
+
+from tests.resilience.conftest import paged_toy_factory  # noqa: F401

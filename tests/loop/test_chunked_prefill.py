@@ -107,6 +107,7 @@ def test_ragged_chunked_matches_unchunked(backend, monkeypatch):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
 def test_pallas_decode_backend_chunked(monkeypatch):
     """Continuation chunks through the flash-decode kernel (env-forced,
     interpret mode on CPU) must match the eager routing."""

@@ -213,6 +213,7 @@ class TestDecodeParity:
             assert (np.asarray(out[:, j]) == np.asarray(nxt)).all(), j
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
 
+    @pytest.mark.slow  # >10s compile-bound on the 2-core rig; e2e tier covers it
     def test_ragged_prompts_match_per_row_unpadded(self):
         """Left-padded batch + prompt_lengths must generate exactly what
         each row generates alone, unpadded (rope positions and the
@@ -245,6 +246,7 @@ class TestDecodeParity:
         np.testing.assert_array_equal(got[0], want[0][0])
         np.testing.assert_array_equal(got[1], want[1][0])
 
+    @pytest.mark.slow  # ~9s compile-bound on the 2-core rig; e2e tier covers it
     def test_ragged_prompts_flash_prefill_backend(self):
         """The ragged contract through the Pallas flash backend (what the
         prefill fast path runs on TPU; interpret mode here): segment ids

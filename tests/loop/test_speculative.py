@@ -69,7 +69,12 @@ def test_perfect_draft_matches_generate(k):
     np.testing.assert_array_equal(got, want)
 
 
-@pytest.mark.parametrize("k", [2, 4])
+@pytest.mark.parametrize(
+    "k",
+    # both params are ~8s compile-bound on the 2-core rig; K=2 stays in
+    # tier-1 as the rejection/rewind parity pin, K=4 rides the slow tier
+    [2, pytest.param(4, marks=pytest.mark.slow)],
+)
 def test_disagreeing_draft_matches_generate(k):
     """A differently-initialized draft disagrees often — rejections and
     per-row rewinds must preserve exact target-greedy output."""
@@ -85,6 +90,7 @@ def test_disagreeing_draft_matches_generate(k):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow  # ~9s compile-bound on the 2-core rig
 def test_eos_freezes_rows():
     model, params = _dense(seed=0)
     draft, draft_params = _dense(seed=7)

@@ -146,6 +146,7 @@ class TestFusedParity:
             96, 192, 64, 32, 16, 4, num_experts=8  # over budget
         )
 
+    @pytest.mark.slow  # ~10s compile-bound on the 2-core rig
     def test_gradients_match_reference(self):
         x, ids, probs, wg, wu, wd = _problem(seed=3)
         e = wg.shape[0]
@@ -180,6 +181,7 @@ class TestFusedParity:
                 np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
             )
 
+    @pytest.mark.slow  # ~9s compile-bound on the 2-core rig
     def test_under_remat(self):
         """jax.checkpoint replays the custom fwd; grads stay exact."""
         x, ids, probs, wg, wu, wd = _problem(seed=5)

@@ -170,3 +170,100 @@ def toy_batcher_factory():
 def toy_expected(prompt, n):
     """The tokens ToyDecodeLM greedy-decodes after ``prompt``."""
     return [(prompt[-1] + 1 + i) % SERVE_VOCAB for i in range(n)]
+
+
+class PagedToyLM(nn.Module):
+    """Deterministic PAGEABLE decode model: next token = (sum of every
+    token seen so far) % vocab, computed FROM the KV cache content.
+
+    Unlike ToyDecodeLM (whose ``mem`` leaf is unpageable and whose
+    output ignores the cache), this stores tokens in a ``cached_key``
+    leaf written/read through the shared paged-cache helpers
+    (d9d_tpu/nn/attention.py) — the serving loop pages it, the prefix
+    cache stays enabled, and the emissions depend on EVERY cached slot.
+    So a handoff that ships wrong/corrupt page payloads changes the
+    output stream: token-identity pins here verify shipped content,
+    not just bookkeeping.
+    """
+
+    vocab: int = SERVE_VOCAB
+    decode_max_length: int = 32
+
+    @nn.compact
+    def __call__(self, tokens, positions, labels=None, mask=None):
+        from d9d_tpu.nn.attention import (
+            _decode_cache_append_heads_major,
+            _decode_cache_index,
+            _decode_page_table,
+            _gather_pages_heads_major,
+            _gather_pages_heads_major_quant,
+        )
+
+        b, t = tokens.shape
+        idx = _decode_cache_index(self)
+        start = idx.value
+        table = _decode_page_table(self)
+        v = tokens[..., None, None].astype(jnp.float32)  # [B, T, H=1, D=1]
+        pool = _decode_cache_append_heads_major(
+            self, v, "cached_key", self.decode_max_length, start,
+            page_table=table,
+        )
+        if table is not None:
+            if self.has_variable("cache", "cached_key_scale"):
+                cache = _gather_pages_heads_major_quant(
+                    pool, self.get_variable("cache", "cached_key_scale"),
+                    table, jnp.float32,
+                )
+            else:
+                cache = _gather_pages_heads_major(pool, table)
+        else:
+            cache = pool
+        vals = cache[:, 0, :, 0]  # [B, S] cached token values
+        idx.value = start + t
+        # logits for each of the t new positions: the running sum over
+        # all slots written so far (slot order == time order per row)
+        s = jnp.broadcast_to(jnp.reshape(start, (-1, 1)), (b, t))
+        end = s + jnp.arange(t)[None, :]  # inclusive last slot per pos
+        slots = jnp.arange(vals.shape[1])
+        valid = slots[None, None, :] <= end[..., None]  # [B, T, S]
+        tot = jnp.sum(jnp.where(valid, vals[:, None, :], 0.0), axis=-1)
+        # round before the mod: int8-quantized pools dequantize to the
+        # token value ± float epsilon, and truncation would alias it.
+        # 1 + (sum % (vocab-1)) has no absorbing state — the stream
+        # keeps evolving, so any cache corruption shows up in tokens
+        nxt = 1 + jnp.mod(jnp.round(tot).astype(jnp.int32), self.vocab - 1)
+        return jax.nn.one_hot(nxt, self.vocab) * 20.0
+
+    def logits(self, tokens, positions, mask=None):
+        return self(tokens, positions)
+
+
+def paged_toy_expected(prompt, n, vocab=SERVE_VOCAB):
+    """The tokens PagedToyLM greedy-decodes after ``prompt``."""
+    total = sum(prompt)
+    out = []
+    for _ in range(n):
+        nxt = 1 + total % (vocab - 1)
+        out.append(nxt)
+        total += nxt
+    return out
+
+
+@pytest.fixture
+def paged_toy_factory():
+    """Factory for paged-serving batchers over PagedToyLM (prefix cache
+    live, page payloads observable)."""
+    from d9d_tpu.loop.serve import ContinuousBatcher
+
+    model = PagedToyLM()
+    z = jnp.zeros((2, 1), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), z, z).get("params", {})
+
+    def make(**kwargs):
+        kwargs.setdefault("batch_size", 2)
+        kwargs.setdefault("chunk_size", 4)
+        kwargs.setdefault("page_size", 4)
+        kwargs.setdefault("num_pages", 17)
+        return ContinuousBatcher(model, dict(params), **kwargs)
+
+    return make

@@ -81,6 +81,7 @@ def _train(tmp_path):
     return history, list(iter_events(path))  # iter_events schema-validates
 
 
+@pytest.mark.slow  # >10s compile-bound on the 2-core rig (full tiny train run)
 def test_phase_timeline_covers_wall_and_reports_throughput(tmp_path):
     history, events = _train(tmp_path)
 

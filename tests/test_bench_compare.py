@@ -212,7 +212,11 @@ def test_live_micro_gate_against_committed_baseline(devices):
     """THE tripwire: run the CPU serving microbench and gate it against
     the committed baseline. Structural metrics (dispatches/1k tokens,
     steady-state compiles, recompiles, emitted tokens) are exact; only
-    tok_per_s carries a wide collapse-only tolerance."""
+    tok_per_s carries a wide collapse-only tolerance. Gates through
+    gate_with_exporter_rescue — the same path as the CLI — so the
+    exporter_overhead_frac 2-core-contention flake gets its one
+    isolated re-measure here too instead of failing the suite on
+    wall-clock noise."""
     from d9d_tpu.telemetry import Telemetry, set_telemetry, recompile_guard
     from d9d_tpu.telemetry import introspect
 
@@ -221,7 +225,7 @@ def test_live_micro_gate_against_committed_baseline(devices):
     current = bc.run_micro()
     with open(BASELINE) as fh:
         baseline = json.load(fh)
-    ok, lines = bc.compare(current, baseline)
+    ok, lines, _rerun = bc.gate_with_exporter_rescue(current, baseline)
     assert ok, "\n".join(lines)
     # and the run itself must be introspection-clean
     assert current["metrics"]["serve_micro.steady_state_compiles"] == 0

@@ -161,8 +161,8 @@ def test_gate_paths_are_the_committed_ones():
     assert DEFAULT_BASELINE.exists()
     manifest = manifest_mod.load(DEFAULT_BASELINE)
     # the committed contracts this PR pre-registered stay committed
-    for context in ("train", "train_zero", "serve", "spec_decode",
-                    "pp_opt", "pp_fused"):
+    for context in ("train", "train_zero", "serve", "serve_disagg",
+                    "spec_decode", "pp_opt", "pp_fused"):
         assert context in manifest["expectations"], context
     # every baseline entry carries a human reason (load enforces it; the
     # explicit loop keeps the failure message naming the entry)

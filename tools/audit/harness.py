@@ -6,8 +6,10 @@ This is the standing certification harness the tier-1 gate
 every executable shape the repo dispatches in production is compiled
 here once, at tiny config, with artifact capture on — non-PP train
 step, ZeRO dp_replicate>1 train step, the serving fused-K and legacy
-step paths, the speculative-decode round, the PipelinedOptimizer
-per-stage update programs, and the fused MPMD pipeline runs
+step paths, the disaggregated prefill->decode fleet (whose handoff
+must add zero executables), the speculative-decode round, the
+PipelinedOptimizer per-stage update programs, and the fused MPMD
+pipeline runs
 (``pp_fused/r{R}/run{K}``). Each leg runs under its own capture context
 so the manifest can pre-register per-configuration contracts (the same
 ``train_step`` name carries "no collectives" plain and the exact
@@ -208,6 +210,58 @@ def leg_serve_quant() -> None:
     legacy.drain()
 
 
+def leg_serve_disagg() -> None:
+    """The disaggregated prefill->decode fleet: the handoff plane is
+    host-side page shipment (export -> checksum -> import via device
+    transfer), so the contract this leg certifies is mostly negative —
+    a fleet round that hands a request off compiles exactly the same
+    serving executables as a unified paged replica (serve/fused_k*,
+    zero collectives), and a steady-state handed-off request adds NO
+    tracked executables: the transfer never grows the dispatch set."""
+    from tools.bench_serve import build_model
+
+    from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.resilience import ServingFleet
+    from d9d_tpu.telemetry import get_telemetry, introspect
+
+    model, params, cfg = build_model(tiny=True)
+
+    def make() -> ContinuousBatcher:
+        return ContinuousBatcher(
+            model, dict(params), batch_size=2, chunk_size=4,
+            page_size=4, num_pages=33,
+        )
+
+    fleet = ServingFleet()
+    fleet.add_replica(make(), role="prefill")
+    fleet.add_replica(make(), role="decode")
+    prompt = [1, 2, 3, 4, 5, 6]  # spans a full page: a real handoff
+    fleet.submit(prompt, max_new_tokens=10)
+    fleet.drain()
+    snap = get_telemetry().registry.snapshot()["counters"]
+    if not snap.get("serve/fleet_handoffs", 0):
+        raise RuntimeError(
+            "disagg audit leg fell back to re-prefill instead of "
+            "shipping pages — it certified nothing; counters: "
+            f"handoffs={snap.get('serve/fleet_handoffs', 0)} "
+            f"fallbacks={snap.get('serve/fleet_handoff_fallbacks', 0)}"
+        )
+
+    # steady state: a second handed-off request must hit the compiled
+    # set — the page shipment itself is not allowed to introduce (or
+    # recompile) a single tracked executable
+    mark = len(introspect.inventory())
+    fleet.submit(prompt, max_new_tokens=10)
+    fleet.drain()
+    added = [r.name for r in introspect.inventory()[mark:]]
+    if added:
+        raise RuntimeError(
+            "the steady-state handoff round compiled new tracked "
+            f"executables {added} — page transfer must stay host-side"
+        )
+    fleet.close()
+
+
 def leg_spec_decode() -> None:
     """The fused speculative round (serve/spec_round): draft + verify
     as one executable, zero collectives."""
@@ -378,6 +432,7 @@ LEGS: dict[str, Callable[[], None]] = {
     "train_zero": leg_train_zero,
     "serve": leg_serve,
     "serve_quant": leg_serve_quant,
+    "serve_disagg": leg_serve_disagg,
     "spec_decode": leg_spec_decode,
     "pp_opt": leg_pp_opt,
     "pp_fused": leg_pp_fused,

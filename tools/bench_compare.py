@@ -524,6 +524,13 @@ def run_micro() -> dict:
             # and fused results must stay bit-identical to the legacy
             # action-loop executor
             **run_pp_micro(),
+            # disaggregated serving leg: 1-prefill + 1-decode fleet vs a
+            # unified replica over the same shared-prefix workload —
+            # handoffs must be token-invisible (exact_vs_unified) and
+            # checksum-clean, and every cross-replica prefix shipment
+            # attempt must land (docs/design/elasticity.md
+            # "Disaggregated serving")
+            **run_disagg_micro(),
         },
     }
 
@@ -598,6 +605,82 @@ def rerun_exporter_overhead() -> float:
         monitor.detach()
         exp.close()
     return round((dt_exp - dt) / dt, 4)
+
+
+def gate_with_exporter_rescue(current: dict, baseline: dict):
+    """``compare`` plus the one sanctioned retry: when
+    ``serve_micro.exporter_overhead_frac`` is the SOLE failing metric,
+    re-measure that leg once in isolation (``rerun_exporter_overhead``)
+    and compare again. Every other failure — and any failure that rides
+    alongside it — stays fatal on the first pass. Shared by the
+    ``--run-micro`` CLI gate and the in-suite tripwire test so both
+    paths carry identical flake semantics. Returns
+    ``(ok, lines, exporter_rerun)``; ``current`` is updated in place
+    with the re-measured value when the rescue fires."""
+    ok, lines = compare(current, baseline)
+    if ok:
+        return ok, lines, False
+    failing = [ln for ln in lines if ln.startswith("FAIL")]
+    if not failing or not all(
+        "serve_micro.exporter_overhead_frac" in ln for ln in failing
+    ):
+        return ok, lines, False
+    current["metrics"]["serve_micro.exporter_overhead_frac"] = (
+        rerun_exporter_overhead()
+    )
+    ok, lines = compare(current, baseline)
+    return ok, lines, True
+
+
+def run_disagg_micro() -> dict:
+    """The disaggregated-serving leg (docs/design/elasticity.md
+    "Disaggregated serving"): the SAME shared-prefix workload through a
+    single unified replica and through a 1-prefill + 1-decode
+    role-split fleet. Gated facts: the split fleet's tokens are EXACTLY
+    the unified replica's (a prefill→decode handoff is invisible in the
+    token stream), every full-page prompt actually handed off, zero
+    continuation fallbacks, zero checksum failures, and every fleet
+    prefix-directory shipment attempt landed."""
+    from tools.bench_serve import (
+        build_model,
+        make_shared_prefix_workload,
+        run_fleet,
+    )
+
+    model, params, cfg = build_model(tiny=True)
+    shared = make_shared_prefix_workload(
+        vocab=cfg.vocab_size, requests=MICRO["requests"], seed=0,
+        prefix_len=2 * 16 + 2, tail_lo=2, tail_hi=6,
+        gen_lo=MICRO["gen_lo"], gen_hi=MICRO["gen_hi"],
+        mean_interarrival=MICRO["gen_hi"] / MICRO["batch_size"],
+    )
+    rows = {}
+    outs = {}
+    for label, roles in (
+        ("unified", ("unified",)),
+        ("split", ("prefill", "decode")),
+    ):
+        rows[label], outs[label] = run_fleet(
+            model, params, shared, roles=roles,
+            batch_size=MICRO["batch_size"], chunk_size=MICRO["chunk_k"],
+            page_size=16,
+        )
+    split = rows["split"]
+    attempts = split["fleet_prefix_hits"] + split["fleet_prefix_misses"]
+    return {
+        "disagg_micro.exact_vs_unified": int(
+            outs["split"] == outs["unified"]
+        ),
+        "disagg_micro.emitted_tokens": split["tokens"],
+        "disagg_micro.handoffs": split["handoffs"],
+        "disagg_micro.handoff_fallbacks": split["handoff_fallbacks"],
+        "disagg_micro.handoff_pages": split["handoff_pages"],
+        "disagg_micro.checksum_failures": split["checksum_failures"],
+        "disagg_micro.fleet_prefix_hit_rate": (
+            round(split["fleet_prefix_hits"] / attempts, 4)
+            if attempts else 1.0
+        ),
+    }
 
 
 TRAIN_MICRO = dict(steps=6, cadence=3, num_microbatches=2)
@@ -1033,24 +1116,20 @@ def main(argv=None) -> int:
         print(f"cannot read baseline {args.baseline}: {e}", file=sys.stderr)
         return 2
 
-    ok, lines = compare(current, baseline)
     exporter_rerun = False
-    if not ok and args.run_micro:
+    if args.run_micro:
         # the one known-flaky wall-clock leg: when it is the ONLY
         # failure, re-measure it once in isolation instead of failing
         # (docs/design/observability.md "Perf-regression gate").
         # --current snapshots never re-run — their rc must stay a pure
         # function of the file's contents.
-        failing = [ln for ln in lines if ln.startswith("FAIL")]
-        if failing and all(
-            "serve_micro.exporter_overhead_frac" in ln for ln in failing
-        ):
+        ok, lines, exporter_rerun = gate_with_exporter_rescue(
+            current, baseline
+        )
+        if exporter_rerun:
             print(EXPORTER_CONTENTION_CAVEAT)
-            current["metrics"]["serve_micro.exporter_overhead_frac"] = (
-                rerun_exporter_overhead()
-            )
-            exporter_rerun = True
-            ok, lines = compare(current, baseline)
+    else:
+        ok, lines = compare(current, baseline)
     for line in lines:
         print(line)
     print(json.dumps({
@@ -1107,6 +1186,14 @@ def default_thresholds(metrics: dict) -> dict:
             ".autopilot_canary_promotes",
             ".autopilot_exact_vs_plain",
             ".numerics_rows",
+            # disaggregated serving: token identity across the handoff,
+            # the handoff traffic actually flowing (a silently-degraded
+            # fleet that re-prefills everything would otherwise pass),
+            # and every prefix shipment attempt landing
+            ".exact_vs_unified",
+            ".handoffs",
+            ".handoff_pages",
+            ".fleet_prefix_hit_rate",
             # fused PP: bit-exactness vs the legacy oracle and the
             # structural dispatch reduction must never fall below the
             # measured (deterministic) values — the ISSUE 16 ≥5× gate

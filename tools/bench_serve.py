@@ -217,6 +217,125 @@ def run_mode(model, params, workload, *, batch_size, chunk_size, overlap,
     }, outputs
 
 
+def run_fleet(model, params, workload, *, roles, batch_size, chunk_size,
+              page_size, **batcher_kwargs):
+    """Drive the arrival schedule through a ``ServingFleet`` with one
+    replica per entry of ``roles`` — the disaggregated counterpart of
+    ``run_mode`` (arrivals released against the fleet's scheduling
+    round, outputs keyed by arrival index for cross-leg identity)."""
+    from d9d_tpu.loop.serve import ContinuousBatcher
+    from d9d_tpu.resilience import ServingFleet
+    from d9d_tpu.telemetry import get_telemetry
+
+    def make():
+        return ContinuousBatcher(
+            model, dict(params), batch_size=batch_size,
+            chunk_size=chunk_size, page_size=page_size, **batcher_kwargs,
+        )
+
+    fleet = ServingFleet()
+    for role in roles:
+        fleet.add_replica(make(), role=role)
+    # warmup: compile the chunk executables outside the timed window
+    warm = fleet.submit(
+        workload[0][1], max_new_tokens=2 * (chunk_size or 1) + 2
+    )
+    fleet.drain()
+    get_telemetry().reset_instruments()
+
+    pending = list(workload)
+    frids = {}
+    clock = 0
+    t0 = time.perf_counter()
+    while pending or not all(fleet.finished(f) for f in frids.values()):
+        while pending and pending[0][0] <= clock:
+            _, prompt, gen = pending.pop(0)
+            frids[len(frids)] = fleet.submit(prompt, max_new_tokens=gen)
+        fleet.step()
+        clock += chunk_size or 1
+    dt = time.perf_counter() - t0
+    outputs = {i: fleet.outputs(f) for i, f in frids.items()}
+    tokens = sum(len(t) for t in outputs.values())
+    snap = get_telemetry().registry.snapshot()["counters"]
+    for i in fleet.live_replicas:
+        fleet._replicas[i]._kv.check_invariants()
+    fleet.close()
+    del warm
+    return {
+        "roles": "+".join(roles),
+        "tok_per_s": tokens / dt,
+        "tokens": tokens,
+        "wall_s": dt,
+        "handoffs": int(snap.get("serve/fleet_handoffs", 0)),
+        "handoff_fallbacks": int(
+            snap.get("serve/fleet_handoff_fallbacks", 0)
+        ),
+        "handoff_pages": int(snap.get("serve/handoff_pages", 0)),
+        "handoff_bytes": int(snap.get("serve/handoff_bytes", 0)),
+        "checksum_failures": int(
+            snap.get("serve/handoff_checksum_failures", 0)
+        ),
+        "fleet_prefix_hits": int(snap.get("serve/fleet_prefix_hits", 0)),
+        "fleet_prefix_misses": int(
+            snap.get("serve/fleet_prefix_misses", 0)
+        ),
+    }, outputs
+
+
+def run_disagg(args, model, cfg, params):
+    """``--disagg``: the SAME shared-prefix mixed-length workload
+    through a single unified replica and through a 1-prefill +
+    1-decode role-split fleet. The split fleet must emit identical
+    tokens (handoffs and cross-replica prefix shipments are invisible
+    in the token stream) — the printed summary carries the identity
+    bit, the handoff traffic, and the fleet prefix hit rate."""
+    k = args.ks[-1] if args.ks else 8
+    page_size = 16 if args.tiny else 64
+    n_req = args.requests or (8 if args.tiny else 24)
+    gen_hi = 24 if args.tiny else 128
+    shared = make_shared_prefix_workload(
+        vocab=cfg.vocab_size, requests=n_req, seed=1,
+        prefix_len=(3 * page_size) + 2, tail_lo=2,
+        tail_hi=8 if args.tiny else 32,
+        gen_lo=4, gen_hi=gen_hi,
+        mean_interarrival=gen_hi / args.batch_size,
+    )
+    legs = {}
+    outs = {}
+    for label, roles in (
+        ("disagg_unified", ("unified",)),
+        ("disagg_split", ("prefill", "decode")),
+    ):
+        row, out = run_fleet(
+            model, params, shared, roles=roles,
+            batch_size=args.batch_size, chunk_size=k,
+            page_size=page_size,
+        )
+        legs[label], outs[label] = row, out
+        print(json.dumps({"mode": label, **{
+            kk: (round(v, 3) if isinstance(v, float) else v)
+            for kk, v in row.items()
+        }}), flush=True)
+    split = legs["disagg_split"]
+    attempts = split["fleet_prefix_hits"] + split["fleet_prefix_misses"]
+    print(json.dumps({
+        "disagg_summary": {
+            "exact_vs_unified": outs["disagg_split"]
+            == outs["disagg_unified"],
+            "handoffs": split["handoffs"],
+            "handoff_fallbacks": split["handoff_fallbacks"],
+            "checksum_failures": split["checksum_failures"],
+            "fleet_prefix_hit_rate": round(
+                split["fleet_prefix_hits"] / attempts, 3
+            ) if attempts else 1.0,
+            "speedup_vs_unified": round(
+                split["tok_per_s"]
+                / max(legs["disagg_unified"]["tok_per_s"], 1e-9), 3
+            ),
+        }
+    }), flush=True)
+
+
 def main():
     import os
 
@@ -232,6 +351,12 @@ def main():
         "int8 weights + int8 KV) against the wide paged leg",
     )
     ap.add_argument(
+        "--disagg", action="store_true",
+        help="run ONLY the disaggregated serving leg: one unified "
+        "replica vs a 1-prefill + 1-decode fleet over the same "
+        "shared-prefix workload (token identity + handoff traffic)",
+    )
+    ap.add_argument(
         "--telemetry-out", default=os.environ.get("D9D_TELEMETRY_DIR"),
         help="directory for the schema-versioned telemetry JSONL event "
         "log (TTFT/TPOT/queue-wait/slot-util histograms per mode); "
@@ -240,6 +365,9 @@ def main():
     args = ap.parse_args()
 
     model, params, cfg = build_model(args.tiny)
+    if args.disagg:
+        run_disagg(args, model, cfg, params)
+        return
     n_req = args.requests or (8 if args.tiny else 24)
     gen_hi = 24 if args.tiny else 128
     workload = make_workload(

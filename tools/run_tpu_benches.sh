@@ -378,6 +378,18 @@ run_leg "serving low-precision (int8 weights + int8 KV pages)" \
   bench_results/serve_quant.jsonl \
   python tools/bench_serve.py --batch-size 4 --ks 8 --quant
 
+# r20: disaggregated serving ON CHIP — the same shared-prefix workload
+# through one unified replica and a 1-prefill + 1-decode role-split
+# fleet (resilience/elastic.py roles + KV page shipment). The summary
+# records token identity across the handoff, the handoff page/byte
+# traffic the device-pool pulls actually moved, checksum cleanliness,
+# and the fleet prefix hit rate; the CPU tier gates the same structural
+# facts (disagg_micro.* in BENCH_BASELINE.json), this leg prices the
+# cross-replica transfer on real HBM.
+run_leg "serving disaggregated (prefill->decode fleet + page shipment)" \
+  bench_results/serve_disagg.jsonl \
+  python tools/bench_serve.py --batch-size 4 --ks 8 --disagg
+
 # single-run files: truncate unconditionally (resume mode re-running these
 # legs should overwrite, matching the pre-run_leg `tee` semantics)
 : > bench_results/kernels.jsonl
